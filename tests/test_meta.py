@@ -1,0 +1,45 @@
+import pytest
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+
+def test_partition_sizes_even():
+    assert meta.partition_sizes(8, 4) == [2, 2, 2, 2]
+
+
+def test_partition_sizes_uneven():
+    assert meta.partition_sizes(10, 4) == [3, 3, 2, 2]
+    assert meta.partition_sizes(3, 5) == [1, 1, 1, 0, 0]
+
+
+def test_partition_range_covers():
+    for length in (0, 1, 7, 16, 101):
+        for parts in (1, 2, 3, 5, 8):
+            rs = meta.partition_range(5, 5 + length, parts)
+            assert len(rs) == parts
+            assert rs[0][0] == 5
+            assert rs[-1][1] == 5 + length
+            for (s0, e0), (s1, e1) in zip(rs, rs[1:]):
+                assert e0 == s1
+                assert s0 <= e0
+
+
+def test_owner_of_matches_partition():
+    for length in (1, 7, 16, 101):
+        for parts in (1, 2, 3, 5, 8):
+            rs = meta.partition_range(0, length, parts)
+            for r, (s, e) in enumerate(rs):
+                for i in range(s, e):
+                    assert meta.owner_of(i, 0, length, parts) == r
+
+
+def test_owner_of_out_of_range():
+    with pytest.raises(Mp4jError):
+        meta.owner_of(10, 0, 10, 2)
+
+
+def test_padded_block():
+    assert meta.padded_block(10, 4) == 3
+    assert meta.padded_block(8, 4) == 2
+    assert meta.padded_block(1, 8) == 1

@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.utils import native
+
+
+ALL_OPS = [Operators.SUM, Operators.PROD, Operators.MAX, Operators.MIN]
+NP_REF = {
+    "SUM": np.add,
+    "PROD": np.multiply,
+    "MAX": np.maximum,
+    "MIN": np.minimum,
+}
+
+
+@pytest.mark.parametrize("op", ALL_OPS, ids=lambda o: o.name)
+@pytest.mark.parametrize("operand", Operands.NUMERIC, ids=lambda o: o.name)
+def test_identity(op, operand):
+    ident = op.identity(operand.dtype)
+    x = np.array([3, 1, 2], dtype=operand.dtype)
+    got = op.np_fn(np.full_like(x, ident), x)
+    np.testing.assert_array_equal(got, x)
+
+
+@pytest.mark.parametrize("op", ALL_OPS, ids=lambda o: o.name)
+@pytest.mark.parametrize("operand", Operands.NUMERIC, ids=lambda o: o.name)
+def test_native_reduce_matches_numpy(op, operand, rng):
+    if operand.dtype.kind == "f":
+        a = rng.standard_normal(257).astype(operand.dtype)
+        b = rng.standard_normal(257).astype(operand.dtype)
+    else:
+        a = rng.integers(1, 5, 257).astype(operand.dtype)
+        b = rng.integers(1, 5, 257).astype(operand.dtype)
+    expect = NP_REF[op.name](a, b)
+    acc = a.copy()
+    native.reduce_into(op, acc, b)
+    np.testing.assert_array_equal(acc, expect)
+
+
+def test_native_backend_is_active():
+    # The image has g++; the C++ hot loop must actually be in use.
+    native._load()
+    assert native.HAVE_NATIVE
+
+
+def test_merge_unique_u64():
+    a = np.array([1, 3, 5, 7], dtype=np.uint64)
+    b = np.array([2, 3, 6, 7, 9], dtype=np.uint64)
+    got = native.merge_unique_u64(a, b)
+    np.testing.assert_array_equal(got, np.array([1, 2, 3, 5, 6, 7, 9],
+                                                dtype=np.uint64))
+
+
+def test_merge_unique_u64_random(rng):
+    a = np.unique(rng.integers(0, 1000, 300).astype(np.uint64))
+    b = np.unique(rng.integers(0, 1000, 300).astype(np.uint64))
+    got = native.merge_unique_u64(a, b)
+    np.testing.assert_array_equal(got, np.union1d(a, b))
+
+
+def test_custom_operator():
+    absmax = Operator.custom("ABSMAX",
+                             lambda x, y: np.where(np.abs(x) >= np.abs(y), x, y),
+                             0.0)
+    a = np.array([-5.0, 1.0, 2.0])
+    b = np.array([3.0, -4.0, -1.0])
+    got = absmax(a, b)
+    np.testing.assert_array_equal(got, [-5.0, -4.0, 2.0])
+    acc = a.copy()
+    native.reduce_into(absmax, acc, b)  # falls back to np_fn
+    np.testing.assert_array_equal(acc, [-5.0, -4.0, 2.0])
+
+
+def test_by_name():
+    assert Operators.by_name("sum") is Operators.SUM
+    with pytest.raises(Mp4jError):
+        Operators.by_name("nope")

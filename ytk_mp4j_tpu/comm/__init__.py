@@ -1,0 +1,3 @@
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+
+__all__ = ["TpuCommCluster"]

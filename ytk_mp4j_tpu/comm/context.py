@@ -1,0 +1,54 @@
+"""Abstract comm API surface.
+
+Defines the per-rank slave contract shared by every backend, mirroring the
+reference's slave API (SURVEY.md section 2: ``ProcessCommSlave`` /
+``ThreadCommSlave`` expose rank/size, 7 collectives x {array, map},
+``barrier()``, ``info()/error()``, ``close(code)``).
+
+Backends (SURVEY.md section 7 build order):
+
+- :class:`~ytk_mp4j_tpu.comm.tpu_comm.TpuCommCluster` — the TPU path; a
+  single-controller SPMD driver rather than a per-rank object (idiomatic
+  JAX), exposing cluster-level collectives over all ranks at once.
+- ``comm.process_comm.ProcessCommSlave`` — CPU socket reference path
+  (recursive halving/doubling, the reference's semantics); phase 3.
+- ``comm.thread_comm.ThreadCommSlave`` — thread-level nesting over a
+  process slave; phase 6.
+"""
+
+from __future__ import annotations
+
+import abc
+import sys
+import time
+
+
+class CommSlave(abc.ABC):
+    """Per-rank communication endpoint."""
+
+    @property
+    @abc.abstractmethod
+    def rank(self) -> int: ...
+
+    @property
+    @abc.abstractmethod
+    def slave_num(self) -> int: ...
+
+    @abc.abstractmethod
+    def barrier(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self, code: int = 0) -> None: ...
+
+    # -- centralized logging (reference: info()/error() forwarded to the
+    # master's console, SURVEY.md section 3e). Default: local stderr with a
+    # rank prefix; socket backends override to forward to the master.
+    def info(self, msg: str) -> None:
+        print(self._fmt("INFO", msg), file=sys.stderr, flush=True)
+
+    def error(self, msg: str) -> None:
+        print(self._fmt("ERROR", msg), file=sys.stderr, flush=True)
+
+    def _fmt(self, level: str, msg: str) -> str:
+        ts = time.strftime("%H:%M:%S")
+        return f"[{ts}][rank {self.rank}/{self.slave_num}][{level}] {msg}"

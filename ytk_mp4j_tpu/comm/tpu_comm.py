@@ -1,0 +1,354 @@
+"""TPU cluster driver — the device-path backend.
+
+Single-controller SPMD driver over a :class:`jax.sharding.Mesh`: the
+reference's N socket slaves become N mesh devices, and each collective is
+one jitted ``shard_map`` program whose body is an XLA ICI collective
+(``ops.collectives``). Where the reference runs log2(n) Kryo-socket
+rounds per collective (SURVEY.md section 3b), this backend emits a single
+``psum`` / ``psum_scatter`` / ``all_gather`` and lets XLA schedule ICI DMA.
+
+Driver-mode semantics: collective methods take a list of ``n`` per-rank
+numpy arrays (the check-suite shape, SURVEY.md section 4), stage them onto
+the mesh with the axis sharding, run the jitted collective, and write
+results back IN PLACE into the per-rank arrays — matching the reference's
+in-place buffer semantics. The per-shard functional layer
+(``ops.collectives``) is the API for use inside user jit code.
+
+Uneven ranges and sub-ranges ``[from, to)`` are handled by host-side
+packing into equal static blocks padded with the operator identity, so
+the jitted core sees only static shapes (XLA requirement).
+
+Precision: device compute uses the operand dtype; 64-bit operands require
+``jax.config.jax_enable_x64`` (the differential test rig enables it on
+CPU). Without x64, 64-bit operands are rejected rather than silently
+downcast.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operand, Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.ops import collectives as coll
+from ytk_mp4j_tpu.parallel.mesh import make_mesh, DEFAULT_AXIS
+
+
+def _x64_enabled() -> bool:
+    return bool(jax.config.jax_enable_x64)
+
+
+class TpuCommCluster:
+    """SPMD collectives over ``n`` devices of a mesh.
+
+    Parameters
+    ----------
+    n: number of ranks (devices); defaults to all devices. Non-powers-of-2
+       are supported (mesh over a device subset).
+    mesh: use an existing 1-D mesh instead.
+    """
+
+    def __init__(self, n: int | None = None, mesh: Mesh | None = None,
+                 axis_name: str = DEFAULT_AXIS):
+        if mesh is None:
+            mesh = make_mesh(n, axis_name)
+        if len(mesh.axis_names) != 1:
+            raise Mp4jError("TpuCommCluster needs a 1-D mesh; use "
+                            "HierComm for 2-D meshes")
+        self.mesh = mesh
+        self.axis_name = mesh.axis_names[0]
+        self.n = mesh.shape[self.axis_name]
+        self._row_sharding = NamedSharding(mesh, P(self.axis_name))
+        self._jits: dict = {}
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    @property
+    def slave_num(self) -> int:
+        return self.n
+
+    def _check_operand(self, operand: Operand):
+        if not operand.is_numeric:
+            raise Mp4jError(
+                f"{operand.name} operands are host-only; use the socket / "
+                "in-process backend (SURVEY.md section 7 phase 4)")
+        if operand.dtype.itemsize == 8 and not _x64_enabled():
+            raise Mp4jError(
+                f"{operand.name} needs jax_enable_x64 (64-bit dtypes are "
+                "not enabled on this backend)")
+
+    def _check_root(self, root: int):
+        if not (0 <= root < self.n):
+            raise Mp4jError(f"root {root} out of range [0, {self.n})")
+
+    def _norm_arrays(self, arrs, operand: Operand, lo: int, hi: int | None):
+        if len(arrs) != self.n:
+            raise Mp4jError(f"expected {self.n} per-rank arrays, got {len(arrs)}")
+        out = [operand.check_array(a) for a in arrs]
+        shape0 = out[0].shape
+        for a in out:
+            if a.shape != shape0:
+                raise Mp4jError("per-rank arrays must share a shape")
+        if hi is None:
+            hi = shape0[0] if out[0].ndim == 1 else out[0].size
+        if lo != 0 or hi != (shape0[0] if out[0].ndim == 1 else out[0].size):
+            if out[0].ndim != 1:
+                raise Mp4jError("[from, to) ranges require 1-D arrays")
+        if not (0 <= lo <= hi <= (shape0[0] if out[0].ndim == 1 else out[0].size)):
+            raise Mp4jError(f"range [{lo}, {hi}) out of bounds")
+        return out, lo, hi
+
+    def _stack(self, blocks: list[np.ndarray]):
+        """Stack per-rank equal blocks into a device array sharded by rank."""
+        stacked = np.stack(blocks, axis=0)
+        return jax.device_put(stacked, self._row_sharding)
+
+    def _jit(self, key, build):
+        fn = self._jits.get(key)
+        if fn is None:
+            fn = build()
+            self._jits[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # dense collectives (reference: *Array methods, SURVEY.md section 2)
+    # ------------------------------------------------------------------
+    def allreduce_array(self, arrs, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        from_: int = 0, to: int | None = None):
+        """Element-wise reduce ``arr[from_:to]`` across ranks, in place."""
+        self._check_operand(operand)
+        arrs, lo, hi = self._norm_arrays(arrs, operand, from_, to)
+        if hi == lo:
+            return arrs
+        flat = [a[lo:hi] if a.ndim == 1 else a.reshape(-1) for a in arrs]
+        L = flat[0].size
+
+        def build():
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=P(self.axis_name), out_specs=P(self.axis_name))
+            def f(x):  # x: [1, L]
+                return coll.allreduce(x, operator, self.axis_name)
+            return jax.jit(f)
+
+        fn = self._jit(("allreduce", L, operand.dtype, operator), build)
+        res = np.asarray(fn(self._stack(flat)))
+        for r, a in enumerate(arrs):
+            if a.ndim == 1:
+                a[lo:hi] = res[r]
+            else:
+                np.copyto(a, res[r].reshape(a.shape))
+        return arrs
+
+    def reduce_array(self, arrs, operand: Operand = Operands.FLOAT,
+                     operator: Operator = Operators.SUM, root: int = 0,
+                     from_: int = 0, to: int | None = None):
+        """Reduce into ``root``'s array; other ranks' buffers unchanged."""
+        self._check_operand(operand)
+        self._check_root(root)
+        arrs, lo, hi = self._norm_arrays(arrs, operand, from_, to)
+        if hi == lo:
+            return arrs
+        flat = [a[lo:hi] if a.ndim == 1 else a.reshape(-1) for a in arrs]
+        L = flat[0].size
+
+        def build():
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=P(self.axis_name), out_specs=P(self.axis_name))
+            def f(x):
+                return coll.reduce(x, operator, root, self.axis_name)
+            return jax.jit(f)
+
+        fn = self._jit(("reduce", L, operand.dtype, operator), build)
+        res = np.asarray(fn(self._stack(flat)))
+        a = arrs[root]
+        if a.ndim == 1:
+            a[lo:hi] = res[root]
+        else:
+            np.copyto(a, res[root].reshape(a.shape))
+        return arrs
+
+    def broadcast_array(self, arrs, operand: Operand = Operands.FLOAT,
+                        root: int = 0, from_: int = 0, to: int | None = None):
+        """Copy ``root``'s ``arr[from_:to]`` into every rank's array."""
+        self._check_operand(operand)
+        self._check_root(root)
+        arrs, lo, hi = self._norm_arrays(arrs, operand, from_, to)
+        if hi == lo:
+            return arrs
+        flat = [a[lo:hi] if a.ndim == 1 else a.reshape(-1) for a in arrs]
+        L = flat[0].size
+
+        def build():
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=P(self.axis_name), out_specs=P(self.axis_name))
+            def f(x):
+                return coll.broadcast(x, root, self.axis_name)
+            return jax.jit(f)
+
+        fn = self._jit(("broadcast", L, operand.dtype, root), build)
+        res = np.asarray(fn(self._stack(flat)))
+        for r, a in enumerate(arrs):
+            if a.ndim == 1:
+                a[lo:hi] = res[r]
+            else:
+                np.copyto(a, res[r].reshape(a.shape))
+        return arrs
+
+    # -- segment-based family. ``ranges`` gives each rank's owned segment
+    # of a common full-length array (reference: per-rank from/to counts in
+    # ArrayMetaData, SURVEY.md section 2). Default: block partition of the
+    # whole array via meta.partition_range.
+    def _norm_ranges(self, arrs, ranges):
+        L = arrs[0].shape[0]
+        if ranges is None:
+            ranges = meta.partition_range(0, L, self.n)
+        if len(ranges) != self.n:
+            raise Mp4jError(f"need {self.n} ranges, got {len(ranges)}")
+        prev = None
+        for (s, e) in ranges:
+            if not (0 <= s <= e <= L):
+                raise Mp4jError(f"range ({s}, {e}) out of bounds for {L}")
+            if prev is not None and s != prev:
+                raise Mp4jError("ranges must be contiguous in rank order")
+            prev = e
+        return ranges
+
+    @staticmethod
+    def _max_block(ranges) -> int:
+        return max(1, max(e - s for s, e in ranges))
+
+    def _run_segment_gather(self, arrs, operand: Operand, ranges):
+        """Shared core of (all)gather: pad each rank's segment to the max
+        block, all_gather on device, return the [n, B] result."""
+        if arrs[0].ndim != 1:
+            raise Mp4jError("segment collectives require 1-D arrays")
+        ranges = self._norm_ranges(arrs, ranges)
+        B = self._max_block(ranges)
+        blocks = []
+        for r, (s, e) in enumerate(ranges):
+            b = np.zeros(B, dtype=operand.dtype)
+            b[: e - s] = arrs[r][s:e]
+            blocks.append(b)
+
+        def build():
+            @partial(shard_map, mesh=self.mesh, check_vma=False,
+                     in_specs=P(self.axis_name), out_specs=P(None, None))
+            def f(x):  # x: [1, B] -> [n, B] replicated
+                return coll.allgather(x, self.axis_name, tiled=True)
+            return jax.jit(f)
+
+        fn = self._jit(("allgather", B, operand.dtype), build)
+        return np.asarray(fn(self._stack(blocks))), ranges
+
+    def allgather_array(self, arrs, operand: Operand = Operands.FLOAT,
+                        ranges=None):
+        """Each rank owns ``arr[ranges[rank]]``; afterwards every rank's
+        array holds all segments."""
+        self._check_operand(operand)
+        arrs, _, _ = self._norm_arrays(arrs, operand, 0, None)
+        res, ranges = self._run_segment_gather(arrs, operand, ranges)
+        for a in arrs:
+            for r, (s, e) in enumerate(ranges):
+                a[s:e] = res[r, : e - s]
+        return arrs
+
+    def gather_array(self, arrs, operand: Operand = Operands.FLOAT,
+                     root: int = 0, ranges=None):
+        """Root's array receives every rank's segment; others unchanged."""
+        self._check_operand(operand)
+        self._check_root(root)
+        arrs, _, _ = self._norm_arrays(arrs, operand, 0, None)
+        res, ranges = self._run_segment_gather(arrs, operand, ranges)
+        a = arrs[root]
+        for r, (s, e) in enumerate(ranges):
+            a[s:e] = res[r, : e - s]
+        return arrs
+
+    def scatter_array(self, arrs, operand: Operand = Operands.FLOAT,
+                      root: int = 0, ranges=None):
+        """Rank r receives segment ``ranges[r]`` of ``root``'s array."""
+        self._check_operand(operand)
+        self._check_root(root)
+        arrs, _, _ = self._norm_arrays(arrs, operand, 0, None)
+        if arrs[0].ndim != 1:
+            raise Mp4jError("segment collectives require 1-D arrays")
+        ranges = self._norm_ranges(arrs, ranges)
+        B = self._max_block(ranges)
+        # Root's segments, staged sharded onto the mesh: in the
+        # single-controller runtime the host->device shard placement IS the
+        # scatter; a broadcast+slice on device would move the same bytes
+        # twice. (The SPMD functional layer has a true in-jit scatter.)
+        blocks = []
+        src = arrs[root]
+        for (s, e) in ranges:
+            b = np.zeros(B, dtype=operand.dtype)
+            b[: e - s] = src[s:e]
+            blocks.append(b)
+        dev = self._stack(blocks)  # [n, B] sharded by rank
+        res = np.asarray(dev)
+        for r, (s, e) in enumerate(ranges):
+            arrs[r][s:e] = res[r, : e - s]
+        return arrs
+
+    def reduce_scatter_array(self, arrs, operand: Operand = Operands.FLOAT,
+                             operator: Operator = Operators.SUM, ranges=None):
+        """Every rank contributes its full array; rank r ends with segment
+        ``ranges[r]`` of the element-wise reduction (other positions
+        unchanged)."""
+        self._check_operand(operand)
+        arrs, _, _ = self._norm_arrays(arrs, operand, 0, None)
+        if arrs[0].ndim != 1:
+            raise Mp4jError("segment collectives require 1-D arrays")
+        ranges = self._norm_ranges(arrs, ranges)
+        lo, hi = ranges[0][0], ranges[-1][1]
+        B = meta.padded_block(hi - lo, self.n)
+        pad = self.n * B
+        ident = operator.identity(operand.dtype)
+        blocks = []
+        for r in range(self.n):
+            b = np.full(pad, ident, dtype=operand.dtype)
+            b[: hi - lo] = arrs[r][lo:hi]
+            blocks.append(b)
+
+        def build():
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=P(self.axis_name), out_specs=P(self.axis_name))
+            def f(x):  # x: [1, n*B]
+                y = coll.reduce_scatter(x[0], operator, self.axis_name)
+                return y[None]  # [1, B]
+            return jax.jit(f)
+
+        fn = self._jit(("reduce_scatter", pad, operand.dtype, operator),
+                       build)
+        res = np.asarray(fn(self._stack(blocks)))  # [n, B]
+        # Padded-block layout: device block r covers [lo + r*B, lo + (r+1)*B).
+        # Write each rank's owned (uneven) range from the covering blocks.
+        full = res.reshape(-1)[: hi - lo]
+        for r, (s, e) in enumerate(ranges):
+            arrs[r][s:e] = full[s - lo: e - lo]
+        return arrs
+
+    # ------------------------------------------------------------------
+    def barrier(self):
+        """Synchronize: run a trivial device collective to completion."""
+        def build():
+            @partial(shard_map, mesh=self.mesh, in_specs=P(self.axis_name),
+                     out_specs=P(self.axis_name))
+            def f(x):
+                return x + coll.barrier(self.axis_name)
+            return jax.jit(f)
+        fn = self._jit(("barrier",), build)
+        tok = jax.device_put(np.zeros((self.n, 1), np.int32),
+                             self._row_sharding)
+        np.asarray(fn(tok))

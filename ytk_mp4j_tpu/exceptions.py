@@ -1,0 +1,10 @@
+"""Library-wide exception type.
+
+Mirrors the reference's single checked exception ``Mp4jException``
+(SURVEY.md section 2, expected path ``exception/Mp4jException.java`` [U]).
+"""
+
+
+class Mp4jError(Exception):
+    """Raised for any mp4j-level failure (rendezvous, transport, shape/type
+    mismatches, collective misuse)."""

@@ -1,0 +1,109 @@
+"""Operand (element type + container) system.
+
+The reference describes WHAT is being communicated with operand objects
+from a factory (``Operands.DOUBLE_OPERAND()`` etc., SURVEY.md section 2
+[U]); element types are double, float, int, long, short, byte, String and
+generic Object (user serializer). Containers are dense arrays with a
+``[from, to)`` range, or sparse ``Map<K, V>``.
+
+TPU-first redesign: numeric operands map to numpy/jax dtypes and are
+eligible for the device (ICI) path; ``STRING`` and ``OBJECT`` operands are
+host-only (not TPU-representable) and always travel the socket /
+in-process path with pickle standing in for Kryo — mirroring the
+reference's Kryo-only handling of those types (SURVEY.md section 7 phase 4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+
+@dataclass(frozen=True)
+class Operand:
+    name: str
+    dtype: np.dtype | None  # None => host-only (STRING / OBJECT)
+    # Optional user codec for OBJECT operands (stands in for a user Kryo
+    # serializer): (dumps, loads) over bytes.
+    dumps: Callable[[Any], bytes] | None = None
+    loads: Callable[[bytes], Any] | None = None
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.dtype is not None
+
+    def check_array(self, arr) -> np.ndarray:
+        """Validate/coerce a host array for this operand."""
+        if not self.is_numeric:
+            raise Mp4jError(f"{self.name} operand has no dense-array form")
+        a = np.asarray(arr)
+        if a.dtype != self.dtype:
+            raise Mp4jError(
+                f"array dtype {a.dtype} does not match operand {self.name} "
+                f"({self.dtype})"
+            )
+        return a
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Operand({self.name})"
+
+
+class Operands:
+    """Factory namespace mirroring the reference's ``Operands`` class."""
+
+    DOUBLE = Operand("DOUBLE", np.dtype(np.float64))
+    FLOAT = Operand("FLOAT", np.dtype(np.float32))
+    INT = Operand("INT", np.dtype(np.int32))
+    LONG = Operand("LONG", np.dtype(np.int64))
+    SHORT = Operand("SHORT", np.dtype(np.int16))
+    BYTE = Operand("BYTE", np.dtype(np.int8))
+    STRING = Operand("STRING", None)
+
+    # Factory-method spellings for parity with the reference API shape.
+    @staticmethod
+    def DOUBLE_OPERAND() -> Operand:
+        return Operands.DOUBLE
+
+    @staticmethod
+    def FLOAT_OPERAND() -> Operand:
+        return Operands.FLOAT
+
+    @staticmethod
+    def INT_OPERAND() -> Operand:
+        return Operands.INT
+
+    @staticmethod
+    def LONG_OPERAND() -> Operand:
+        return Operands.LONG
+
+    @staticmethod
+    def SHORT_OPERAND() -> Operand:
+        return Operands.SHORT
+
+    @staticmethod
+    def BYTE_OPERAND() -> Operand:
+        return Operands.BYTE
+
+    @staticmethod
+    def STRING_OPERAND() -> Operand:
+        return Operands.STRING
+
+    @staticmethod
+    def OBJECT_OPERAND(dumps=None, loads=None) -> Operand:
+        """Generic object operand with an optional user codec (the Kryo
+        analogue). Defaults to pickle."""
+        return Operand("OBJECT", None, dumps=dumps, loads=loads)
+
+    NUMERIC = (DOUBLE, FLOAT, INT, LONG, SHORT, BYTE)
+
+    @classmethod
+    def by_dtype(cls, dtype) -> Operand:
+        dt = np.dtype(dtype)
+        for op in cls.NUMERIC:
+            if op.dtype == dt:
+                return op
+        raise Mp4jError(f"no operand for dtype {dt}")

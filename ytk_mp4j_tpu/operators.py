@@ -1,0 +1,141 @@
+"""Reduction operator system.
+
+The reference exposes per-element-type operator constants
+``Operators.Double.SUM`` etc. plus user-defined operator interfaces
+(``IDoubleOperator`` ...) — SURVEY.md section 2, confirmed op set
+``{SUM, MAX, MIN, PROD}`` from BASELINE.json. Operators must be
+commutative + associative binary reductions.
+
+TPU-first redesign: an :class:`Operator` is dtype-generic (element type
+lives on the :class:`~ytk_mp4j_tpu.operands.Operand`, not the operator).
+Each operator carries
+
+- ``np_fn``   — a numpy ufunc-style binary used by the CPU socket path's
+  merge hot loop (the native C++ kernel covers the builtin four; numpy is
+  the fallback and the path for user-defined operators),
+- ``jnp_fn``  — a jax binary used when the TPU path must tree-reduce a
+  gathered axis (PROD and user-defined ops have no native ICI collective),
+- ``lax_collective`` — name of the bandwidth-optimal XLA primitive when
+  one exists (``psum`` / ``pmax`` / ``pmin``), else ``None``,
+- ``identity(dtype)`` — the identity element, needed for padding so that
+  padded lanes never perturb results.
+
+User-defined operators: ``Operator.custom(name, fn, identity)`` with a
+single polymorphic binary ``fn`` working on both numpy and jax arrays
+(jnp and np share the ufunc surface, so one callable usually serves both).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+# native_code ids must match csrc/mp4j_native.cpp OpCode.
+_NATIVE_SUM, _NATIVE_PROD, _NATIVE_MAX, _NATIVE_MIN = 0, 1, 2, 3
+
+
+@dataclass(frozen=True)
+class Operator:
+    name: str
+    np_fn: Callable[[Any, Any], Any]
+    jnp_fn: Callable[[Any, Any], Any]
+    lax_collective: str | None
+    _identity: Callable[[np.dtype], Any]
+    native_code: int | None = None
+
+    def identity(self, dtype) -> Any:
+        """Identity element as a 0-d numpy scalar of ``dtype``."""
+        return np.asarray(self._identity(np.dtype(dtype)), dtype=dtype)[()]
+
+    def __call__(self, a, b):
+        return self.np_fn(a, b)
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Operator({self.name})"
+
+    @staticmethod
+    def custom(
+        name: str,
+        fn: Callable[[Any, Any], Any],
+        identity: Any,
+        jnp_fn: Callable[[Any, Any], Any] | None = None,
+    ) -> "Operator":
+        """A user-defined commutative/associative reduction.
+
+        ``fn`` must accept two arrays (numpy in the socket path, traced jax
+        arrays in the TPU path unless a separate ``jnp_fn`` is given) and
+        return their element-wise reduction. ``identity`` is the value such
+        that ``fn(identity, x) == x``; it is used for static-shape padding.
+        """
+        return Operator(
+            name=name,
+            np_fn=fn,
+            jnp_fn=jnp_fn if jnp_fn is not None else fn,
+            lax_collective=None,
+            _identity=lambda dt, _i=identity: _i,
+            native_code=None,
+        )
+
+
+def _sum_identity(dt: np.dtype):
+    return 0
+
+
+def _prod_identity(dt: np.dtype):
+    return 1
+
+
+def _max_identity(dt: np.dtype):
+    if dt.kind == "f":
+        return -np.inf
+    return np.iinfo(dt).min
+
+
+def _min_identity(dt: np.dtype):
+    if dt.kind == "f":
+        return np.inf
+    return np.iinfo(dt).max
+
+
+def _make_builtins():
+    import jax.numpy as jnp  # deferred so numpy-only users avoid jax import
+
+    sum_ = Operator("SUM", np.add, jnp.add, "psum", _sum_identity, _NATIVE_SUM)
+    prod = Operator(
+        "PROD", np.multiply, jnp.multiply, None, _prod_identity, _NATIVE_PROD
+    )
+    max_ = Operator("MAX", np.maximum, jnp.maximum, "pmax", _max_identity, _NATIVE_MAX)
+    min_ = Operator("MIN", np.minimum, jnp.minimum, "pmin", _min_identity, _NATIVE_MIN)
+    return sum_, prod, max_, min_
+
+
+class Operators:
+    """Namespace of builtin operators, mirroring the reference's
+    ``Operators`` constants container (SURVEY.md section 2 [U])."""
+
+    SUM: Operator
+    PROD: Operator
+    MAX: Operator
+    MIN: Operator
+
+    _ALL: dict[str, Operator] = {}
+
+    @classmethod
+    def by_name(cls, name: str) -> Operator:
+        try:
+            return cls._ALL[name.upper()]
+        except KeyError:
+            raise Mp4jError(f"unknown operator {name!r}") from None
+
+
+Operators.SUM, Operators.PROD, Operators.MAX, Operators.MIN = _make_builtins()
+Operators._ALL = {
+    "SUM": Operators.SUM,
+    "PROD": Operators.PROD,
+    "MAX": Operators.MAX,
+    "MIN": Operators.MIN,
+}

@@ -1,0 +1,3 @@
+from ytk_mp4j_tpu.ops import collectives
+
+__all__ = ["collectives"]

@@ -1,0 +1,138 @@
+"""Functional TPU collectives — the per-shard layer.
+
+These functions run INSIDE ``shard_map`` (or any context where a named
+mesh axis is in scope) and lower directly to XLA ICI collectives. They are
+the TPU-native replacement for the reference's recursive-halving /
+recursive-doubling socket algorithms (SURVEY.md section 3b): where the
+reference hand-schedules log2(n) socket rounds, we emit one XLA op and let
+the compiler schedule ICI DMA.
+
+Semantics of each collective match the reference's capability list
+(SURVEY.md section 1): allreduce / reduce / broadcast / allgather /
+gather / scatter / reduce_scatter, over a named axis. Operators with a
+native XLA reduction (SUM / MAX / MIN) use ``lax.psum / pmax / pmin``;
+PROD and user-defined operators tree-reduce a gathered axis (XLA fuses the
+reduction; correctness for any associative+commutative ``jnp_fn``).
+
+All functions are shape-polymorphic and jit-safe: no data-dependent
+control flow, static axis sizes.
+"""
+
+from __future__ import annotations
+
+from functools import partial, reduce as _functools_reduce
+
+import jax
+from jax import lax
+import jax.numpy as jnp
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operators import Operator, Operators
+
+
+def _axis_size(axis_name) -> int:
+    return lax.axis_size(axis_name)
+
+
+def _tree_reduce_gathered(x, operator: Operator, axis_name):
+    """Generic-operator reduction: all_gather then pairwise tree-reduce.
+
+    Used when no native XLA collective exists (PROD, user-defined). The
+    gather is bandwidth n*|x| vs the optimal |x|*2(n-1)/n, acceptable for
+    the rare generic-op path; SUM/MAX/MIN never take it.
+    """
+    g = lax.all_gather(x, axis_name, axis=0, tiled=False)  # [n, ...]
+    n = g.shape[0]
+    parts = [g[i] for i in range(n)]
+    # Balanced pairwise tree keeps float error O(log n), like the
+    # reference's recursive halving combine order.
+    while len(parts) > 1:
+        nxt = []
+        for i in range(0, len(parts) - 1, 2):
+            nxt.append(operator.jnp_fn(parts[i], parts[i + 1]))
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+def allreduce(x, operator: Operator = Operators.SUM, axis_name="mp4j"):
+    """Element-wise reduce across the axis; every member gets the result."""
+    if operator.lax_collective == "psum":
+        return lax.psum(x, axis_name)
+    if operator.lax_collective == "pmax":
+        return lax.pmax(x, axis_name)
+    if operator.lax_collective == "pmin":
+        return lax.pmin(x, axis_name)
+    return _tree_reduce_gathered(x, operator, axis_name)
+
+
+def reduce(x, operator: Operator = Operators.SUM, root: int = 0,
+           axis_name="mp4j"):
+    """Reduce across the axis; only ``root``'s output is meaningful.
+
+    XLA has no rooted-reduce primitive over ICI; the allreduce is the
+    bandwidth-optimal lowering and non-root results are simply unused (the
+    compiler may DCE per-device work it can prove dead).
+    """
+    return allreduce(x, operator, axis_name)
+
+
+def broadcast(x, root: int = 0, axis_name="mp4j"):
+    """Every member receives ``root``'s ``x``. Numeric dtypes only."""
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis_name)
+
+
+def allgather(x, axis_name="mp4j", tiled: bool = True):
+    """Concatenate every member's ``x`` along dim 0 (``tiled=True``), or
+    stack on a new leading axis (``tiled=False``)."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=tiled)
+
+
+def gather(x, root: int = 0, axis_name="mp4j", tiled: bool = True):
+    """Root obtains the concatenation; non-root outputs are unused."""
+    return allgather(x, axis_name, tiled=tiled)
+
+
+def scatter(x, root: int = 0, axis_name="mp4j"):
+    """Each member receives its block of ``root``'s ``x``.
+
+    ``x.shape[0]`` must be divisible by the axis size (pad at the host
+    layer; see ``meta.padded_block``).
+    """
+    n = _axis_size(axis_name)
+    if x.shape[0] % n != 0:
+        raise Mp4jError(
+            f"scatter dim0 {x.shape[0]} not divisible by axis size {n}")
+    full = broadcast(x, root, axis_name)
+    block = x.shape[0] // n
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(full, idx * block, block, axis=0)
+
+
+def reduce_scatter(x, operator: Operator = Operators.SUM, axis_name="mp4j"):
+    """Element-wise reduce then split: member i receives block i of the
+    reduction. ``x.shape[0]`` must be divisible by the axis size."""
+    n = _axis_size(axis_name)
+    if x.shape[0] % n != 0:
+        raise Mp4jError(
+            f"reduce_scatter dim0 {x.shape[0]} not divisible by axis size {n}")
+    if operator.lax_collective == "psum":
+        return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    full = allreduce(x, operator, axis_name)
+    block = x.shape[0] // n
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(full, idx * block, block, axis=0)
+
+
+def barrier(axis_name="mp4j"):
+    """A synchronization token: a trivial psum every member must join.
+
+    Under XLA's execution model devices are implicitly synchronized by the
+    collective schedule, so this exists for API parity with the
+    reference's ``barrier()`` (SURVEY.md section 2) and as an ordering
+    device in multi-step programs.
+    """
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)

@@ -1,0 +1,7 @@
+from ytk_mp4j_tpu.parallel.mesh import (
+    make_mesh,
+    make_hier_mesh,
+    device_count,
+)
+
+__all__ = ["make_mesh", "make_hier_mesh", "device_count"]

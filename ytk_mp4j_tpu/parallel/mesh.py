@@ -1,0 +1,58 @@
+"""Mesh construction helpers.
+
+The reference's "cluster shape" is (process count x thread count)
+(SURVEY.md section 2: two-level process x thread data parallelism). The
+TPU-native analogue is a :class:`jax.sharding.Mesh` with one axis for flat
+collectives or two axes (``inter`` x ``intra``) for the hierarchical path,
+where ``intra`` maps to ICI within a slice and ``inter`` to DCN across
+slices/hosts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+DEFAULT_AXIS = "mp4j"
+INTER_AXIS = "inter"  # across slices / hosts (DCN-like)
+INTRA_AXIS = "intra"  # within a slice (ICI-like)
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(n: int | None = None, axis_name: str = DEFAULT_AXIS,
+              devices=None) -> Mesh:
+    """A 1-D mesh over ``n`` devices (default: all available).
+
+    ``n`` may be any value <= device_count, including non-powers-of-2 —
+    the reference supports non-power-of-2 slave counts (SURVEY.md section
+    3b step 4) and so do we, by meshing a device subset.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n is None:
+        n = len(devices)
+    if n < 1 or n > len(devices):
+        raise Mp4jError(f"cannot build mesh of {n} from {len(devices)} devices")
+    return Mesh(np.asarray(devices[:n]), (axis_name,))
+
+
+def make_hier_mesh(inter: int, intra: int,
+                   axis_names: tuple[str, str] = (INTER_AXIS, INTRA_AXIS),
+                   devices=None) -> Mesh:
+    """A 2-D (inter x intra) mesh mirroring the reference's
+    process x thread nesting (SURVEY.md section 3d)."""
+    if devices is None:
+        devices = jax.devices()
+    need = inter * intra
+    if need < 1 or need > len(devices):
+        raise Mp4jError(
+            f"cannot build {inter}x{intra} mesh from {len(devices)} devices")
+    arr = np.asarray(devices[:need]).reshape(inter, intra)
+    return Mesh(arr, axis_names)

@@ -1,0 +1,129 @@
+"""ctypes loader/builder for the native C++ hot loops.
+
+Compiles ``csrc/mp4j_native.cpp`` with g++ on first use (cached by source
+mtime) and exposes
+
+- :func:`reduce_into` — ``acc = op(acc, src)`` element-wise, the socket
+  path's merge hot loop,
+- :func:`merge_unique_u64` — sorted-u64 key union for the sparse map path.
+
+Falls back to numpy transparently if the toolchain is unavailable; the
+active backend is reported by :data:`HAVE_NATIVE`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc", "mp4j_native.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_SO = os.path.join(_BUILD_DIR, "libmp4j_native.so")
+
+# Must match csrc/mp4j_native.cpp DType.
+_DTYPE_CODES = {
+    np.dtype(np.float64): 0,
+    np.dtype(np.float32): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.int16): 4,
+    np.dtype(np.int8): 5,
+}
+
+_lock = threading.Lock()
+_lib = None
+# Tri-state: None = not attempted, True = loaded, False = unavailable
+# (negative result is cached so the hot loop never retries the build).
+HAVE_NATIVE: bool | None = None
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
+        _SRC, "-o", _SO + ".tmp",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_SO + ".tmp", _SO)
+    return _SO
+
+
+def _load():
+    global _lib, HAVE_NATIVE
+    with _lock:
+        if HAVE_NATIVE is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_build())
+        except (OSError, subprocess.CalledProcessError):
+            HAVE_NATIVE = False
+            return None
+        lib.mp4j_reduce.restype = ctypes.c_int
+        lib.mp4j_reduce.argtypes = [
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.mp4j_merge_unique_u64.restype = ctypes.c_int64
+        lib.mp4j_merge_unique_u64.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        _lib = lib
+        HAVE_NATIVE = True
+        return _lib
+
+
+def reduce_into(operator, acc: np.ndarray, src: np.ndarray) -> None:
+    """In-place ``acc[i] = operator(acc[i], src[i])``.
+
+    Uses the C++ kernel for builtin operators on contiguous same-dtype
+    buffers; numpy otherwise (user-defined operators always go through
+    their ``np_fn``).
+    """
+    if acc.shape != src.shape:
+        raise Mp4jError(f"shape mismatch {acc.shape} vs {src.shape}")
+    lib = _load()
+    if (
+        lib is not None
+        and operator.native_code is not None
+        and acc.dtype == src.dtype
+        and acc.dtype in _DTYPE_CODES
+        and acc.flags.c_contiguous
+        and src.flags.c_contiguous
+        and acc.flags.writeable
+    ):
+        rc = lib.mp4j_reduce(
+            _DTYPE_CODES[acc.dtype],
+            operator.native_code,
+            acc.ctypes.data_as(ctypes.c_void_p),
+            src.ctypes.data_as(ctypes.c_void_p),
+            acc.size,
+        )
+        if rc == 0:
+            return
+    np.copyto(acc, operator.np_fn(acc, src))
+
+
+def merge_unique_u64(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union-merge two ascending uint64 arrays, dropping duplicates."""
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    lib = _load()
+    if lib is None:
+        return np.union1d(a, b)
+    out = np.empty(a.size + b.size, dtype=np.uint64)
+    n = lib.mp4j_merge_unique_u64(
+        a.ctypes.data_as(ctypes.c_void_p), a.size,
+        b.ctypes.data_as(ctypes.c_void_p), b.size,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out[:n]
