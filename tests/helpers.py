@@ -1,0 +1,69 @@
+"""Shared test helpers: numpy reference reductions, seeded input
+generation, and the master+slave-threads socket harness."""
+
+import threading
+
+import numpy as np
+
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+
+NP_REF = {
+    "SUM": np.add,
+    "PROD": np.multiply,
+    "MAX": np.maximum,
+    "MIN": np.minimum,
+}
+
+
+def make_inputs(n, length, operand, rng):
+    if operand.dtype.kind == "f":
+        return [rng.standard_normal(length).astype(operand.dtype)
+                for _ in range(n)]
+    return [rng.integers(1, 4, length).astype(operand.dtype)
+            for _ in range(n)]
+
+
+def expected_reduce(arrs, op_name):
+    ref = NP_REF[op_name]
+    out = arrs[0].copy()
+    for a in arrs[1:]:
+        out = ref(out, a)
+    return out
+
+
+def run_slaves(n, fn, timeout=60.0):
+    """Start a master + n slave threads; fn(slave, rank) runs per rank.
+    Returns per-rank results; raises the first slave error; asserts the
+    master's aggregate exit code is 0."""
+    master = Master(n, timeout=timeout).serve_in_thread()
+    results = [None] * n
+    errors = []
+
+    def worker():
+        slave = None
+        try:
+            slave = ProcessCommSlave("127.0.0.1", master.port,
+                                     timeout=timeout)
+            results[slave.rank] = fn(slave, slave.rank)
+            slave.close(0)
+        except Exception as e:  # pragma: no cover - surfaced via errors
+            errors.append(e)
+            if slave is not None:
+                try:
+                    slave.close(1)
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "slave thread hung"
+    if errors:
+        raise errors[0]
+    master.join(timeout)
+    assert master.final_code == 0
+    return results

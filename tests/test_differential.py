@@ -1,0 +1,94 @@
+"""Differential tests: TPU (XLA) path vs CPU socket reference path.
+
+The build plan's core correctness argument (SURVEY.md section 7 phase 3):
+the socket path re-implements the reference's semantics, and the TPU path
+must agree with it on identical inputs — exactly for integer operands,
+to float tolerance for floating ones (reduction orders legitimately
+differ: ring order vs XLA's).
+"""
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+from helpers import run_slaves as socket_run
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return TpuCommCluster(4)
+
+
+@pytest.mark.parametrize("op", ["SUM", "PROD", "MAX", "MIN"])
+@pytest.mark.parametrize("operand", [Operands.DOUBLE, Operands.INT],
+                         ids=lambda o: o.name)
+def test_allreduce_differential(cluster, operand, op, rng):
+    n = 4
+    if operand.dtype.kind == "f":
+        alls = [rng.standard_normal(33).astype(operand.dtype)
+                for _ in range(n)]
+    else:
+        alls = [rng.integers(1, 4, 33).astype(operand.dtype)
+                for _ in range(n)]
+    operator = Operators.by_name(op)
+
+    sock = socket_run(
+        n, lambda s, r: s.allreduce_array(alls[r].copy(), operand, operator))
+    tpu = [a.copy() for a in alls]
+    cluster.allreduce_array(tpu, operand, operator)
+
+    for got_s, got_t in zip(sock, tpu):
+        if operand.dtype.kind == "f":
+            np.testing.assert_allclose(got_t, got_s, rtol=1e-9)
+        else:
+            np.testing.assert_array_equal(got_t, got_s)
+
+
+def test_reduce_scatter_differential(cluster, rng):
+    n = 4
+    operand = Operands.DOUBLE
+    L = 29
+    alls = [rng.standard_normal(L).astype(operand.dtype) for _ in range(n)]
+    ranges = meta.partition_range(0, L, n)
+
+    sock = socket_run(
+        n, lambda s, r: s.reduce_scatter_array(alls[r].copy(), operand,
+                                               Operators.SUM))
+    tpu = [a.copy() for a in alls]
+    cluster.reduce_scatter_array(tpu, operand, Operators.SUM)
+
+    for r, (s, e) in enumerate(ranges):
+        np.testing.assert_allclose(tpu[r][s:e], sock[r][s:e], rtol=1e-9)
+
+
+def test_allgather_differential(cluster, rng):
+    n = 4
+    operand = Operands.LONG
+    L = 21
+    alls = [rng.integers(0, 100, L).astype(operand.dtype) for _ in range(n)]
+
+    sock = socket_run(
+        n, lambda s, r: s.allgather_array(alls[r].copy(), operand))
+    tpu = [a.copy() for a in alls]
+    cluster.allgather_array(tpu, operand)
+
+    for got_s, got_t in zip(sock, tpu):
+        np.testing.assert_array_equal(got_t, got_s)
+
+
+def test_broadcast_differential(cluster, rng):
+    n = 4
+    operand = Operands.FLOAT
+    alls = [rng.standard_normal(15).astype(operand.dtype) for _ in range(n)]
+
+    sock = socket_run(
+        n, lambda s, r: s.broadcast_array(alls[r].copy(), operand, root=2))
+    tpu = [a.copy() for a in alls]
+    cluster.broadcast_array(tpu, operand, root=2)
+
+    for got_s, got_t in zip(sock, tpu):
+        np.testing.assert_array_equal(got_t, got_s)

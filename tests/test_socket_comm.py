@@ -1,0 +1,186 @@
+"""Socket (CPU reference) path tests.
+
+"Multi-node without a cluster" (SURVEY.md section 4): a real master plus N
+real slaves over loopback TCP. Slaves run in threads for speed (each has
+its own sockets; blocking socket I/O releases the GIL), plus one
+subprocess-based run of the checkprocess program for true process-level
+coverage.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+
+from helpers import expected_reduce, make_inputs, run_slaves
+
+
+def make_all(n, length, operand, seed=7):
+    return make_inputs(n, length, operand, np.random.default_rng(seed))
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5])
+@pytest.mark.parametrize("op", ["SUM", "MAX"])
+def test_allreduce_ring(n, op):
+    operand = Operands.DOUBLE
+    alls = make_all(n, 41, operand)
+    want = expected_reduce(alls, op)
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.allreduce_array(arr, operand, Operators.by_name(op))
+        return arr
+
+    for got in run_slaves(n, fn):
+        np.testing.assert_allclose(got, want)
+
+
+def test_allreduce_subrange_int():
+    n = 4
+    operand = Operands.INT
+    alls = make_all(n, 20, operand)
+    want = expected_reduce(alls, "SUM")
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.allreduce_array(arr, operand, Operators.SUM, from_=5, to=15)
+        return arr
+
+    for r, got in enumerate(run_slaves(n, fn)):
+        np.testing.assert_array_equal(got[5:15], want[5:15])
+        np.testing.assert_array_equal(got[:5], alls[r][:5])
+        np.testing.assert_array_equal(got[15:], alls[r][15:])
+
+
+@pytest.mark.parametrize("n", [3, 4])
+def test_reduce_scatter_and_allgather(n):
+    operand = Operands.DOUBLE
+    L = 23
+    alls = make_all(n, L, operand)
+    want = expected_reduce(alls, "SUM")
+    ranges = meta.partition_range(0, L, n)
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.reduce_scatter_array(arr, operand, Operators.SUM)
+        s, e = ranges[r]
+        seg = arr[s:e].copy()
+        # then allgather the reduced segments back to the full array
+        slave.allgather_array(arr, operand)
+        return seg, arr
+
+    for r, (seg, full) in enumerate(run_slaves(n, fn)):
+        s, e = ranges[r]
+        np.testing.assert_allclose(seg, want[s:e])
+        np.testing.assert_allclose(full, want)
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_reduce_broadcast(root):
+    n = 4
+    operand = Operands.FLOAT
+    alls = make_all(n, 17, operand)
+    want = expected_reduce(alls, "SUM")
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.reduce_array(arr, operand, Operators.SUM, root=root)
+        out1 = arr.copy()
+        arr2 = alls[r].copy()
+        slave.broadcast_array(arr2, operand, root=root)
+        return out1, arr2
+
+    res = run_slaves(n, fn)
+    np.testing.assert_allclose(res[root][0], want, rtol=1e-5)
+    for r, (reduced, bcast) in enumerate(res):
+        if r != root:
+            np.testing.assert_array_equal(reduced, alls[r])
+        np.testing.assert_array_equal(bcast, alls[root])
+
+
+def test_gather_scatter():
+    n = 5
+    operand = Operands.LONG
+    L = 19
+    alls = make_all(n, L, operand)
+    ranges = meta.partition_range(0, L, n)
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.gather_array(arr, operand, root=0)
+        g = arr.copy()
+        arr2 = alls[r].copy()
+        slave.scatter_array(arr2, operand, root=0)
+        return g, arr2
+
+    res = run_slaves(n, fn)
+    want_g = np.concatenate([alls[q][s:e] for q, (s, e) in enumerate(ranges)])
+    np.testing.assert_array_equal(res[0][0], want_g)
+    for r, (_, sc) in enumerate(res):
+        s, e = ranges[r]
+        np.testing.assert_array_equal(sc[s:e], alls[0][s:e])
+
+
+def test_custom_operator_socket():
+    n = 3
+    absmax = Operator.custom(
+        "ABSMAX", lambda x, y: np.where(np.abs(x) >= np.abs(y), x, y), 0.0)
+    operand = Operands.DOUBLE
+    alls = make_all(n, 16, operand)
+    stacked = np.stack(alls)
+    idx = np.abs(stacked).argmax(axis=0)
+    want = stacked[idx, np.arange(stacked.shape[1])]
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.allreduce_array(arr, operand, absmax)
+        return arr
+
+    for got in run_slaves(n, fn):
+        np.testing.assert_allclose(got, want)
+
+
+def test_barrier_and_logging(capfd):
+    n = 3
+
+    def fn(slave, r):
+        slave.info(f"hello from {r}")
+        slave.barrier()
+        slave.barrier()
+        return r
+
+    assert run_slaves(n, fn) == [0, 1, 2]
+
+
+def test_rendezvous_timeout():
+    import pytest as _pytest
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    m = Master(2, timeout=0.5)
+    with _pytest.raises(Mp4jError):
+        m._rendezvous()
+
+
+@pytest.mark.slow
+def test_checkprocess_subprocess():
+    """True multi-process run of the check program (the reference's check
+    suite shape): 1 master + 3 slave processes over loopback."""
+    master = Master(3, timeout=60.0).serve_in_thread()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "ytk_mp4j_tpu.check.checkprocess",
+             "--master", f"127.0.0.1:{master.port}", "--length", "65"],
+            cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for _ in range(3)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"checkprocess failed:\n{out}\n{err}"
+    master.join(10)
+    assert master.final_code == 0

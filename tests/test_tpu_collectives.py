@@ -14,28 +14,7 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 
-NP_REF = {
-    "SUM": np.add,
-    "PROD": np.multiply,
-    "MAX": np.maximum,
-    "MIN": np.minimum,
-}
-
-
-def make_inputs(n, length, operand, rng):
-    if operand.dtype.kind == "f":
-        return [rng.standard_normal(length).astype(operand.dtype)
-                for _ in range(n)]
-    return [rng.integers(1, 4, length).astype(operand.dtype)
-            for _ in range(n)]
-
-
-def expected_reduce(arrs, op_name):
-    ref = NP_REF[op_name]
-    out = arrs[0].copy()
-    for a in arrs[1:]:
-        out = ref(out, a)
-    return out
+from helpers import expected_reduce, make_inputs
 
 
 def assert_close(got, want, operand):

@@ -1,0 +1,170 @@
+"""Rendezvous master — the control plane.
+
+The reference runs a master process that slaves connect to: it assigns
+ranks, distributes the slave roster (rank -> host:port), serves as the
+centralized log sink for ``info()/error()``, coordinates barriers, and
+aggregates exit codes at ``close(code)`` (SURVEY.md sections 2, 3a, 3e).
+
+This is that master, rebuilt in Python over the framed-socket transport.
+It can run embedded (a thread, for tests and single-host jobs) or as a
+CLI: ``python -m ytk_mp4j_tpu.comm.master --port P --slaves N``.
+
+Failure model matches the reference: fail-stop, fixed slave count, no
+elastic recovery (SURVEY.md section 5) — but rendezvous has an optional
+timeout as a cheap diagnosability win over indefinite hangs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import threading
+import time
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.transport.channel import Channel
+
+# control-plane message kinds (slave -> master)
+REGISTER = "register"
+LOG = "log"
+BARRIER = "barrier"
+CLOSE = "close"
+
+
+class Master:
+    """Rank assignment, roster exchange, log sink, barrier, exit codes."""
+
+    def __init__(self, slave_num: int, port: int = 0, host: str = "",
+                 log_stream=None, timeout: float | None = 120.0):
+        self.slave_num = slave_num
+        self.timeout = timeout
+        self.log_stream = log_stream if log_stream is not None else sys.stderr
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host or "0.0.0.0", port))
+        self._server.listen(slave_num * 2)
+        self.port = self._server.getsockname()[1]
+        self._channels: list[Channel] = []      # by rank after rendezvous
+        self._exit_codes: dict[int, int] = {}
+        self._barrier_waiting: dict[int, list[int]] = {}  # gen -> ranks
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self.final_code: int | None = None
+
+    # ------------------------------------------------------------------
+    def serve(self) -> int:
+        """Run rendezvous then the control loop; returns aggregate exit
+        code (0 iff every slave closed with 0)."""
+        self._rendezvous()
+        threads = []
+        for rank, ch in enumerate(self._channels):
+            t = threading.Thread(target=self._serve_slave, args=(rank, ch),
+                                 daemon=True, name=f"master-slave{rank}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        self._server.close()
+        codes = [self._exit_codes.get(r, 1) for r in range(self.slave_num)]
+        self.final_code = max(codes) if codes else 0
+        return self.final_code
+
+    def serve_in_thread(self) -> "Master":
+        self._thread = threading.Thread(target=self.serve, daemon=True,
+                                        name="mp4j-master")
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float | None = None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ------------------------------------------------------------------
+    def _rendezvous(self):
+        """Accept slave registrations; assign ranks in registration order
+        (pinned free choice — the reference's exact rule is unverified);
+        broadcast the roster to all."""
+        deadline = None if self.timeout is None else time.time() + self.timeout
+        pending = []  # (channel, (host, listen_port))
+        self._server.settimeout(1.0)
+        while len(pending) < self.slave_num:
+            if deadline is not None and time.time() > deadline:
+                raise Mp4jError(
+                    f"rendezvous timeout: {len(pending)}/{self.slave_num} "
+                    "slaves registered")
+            try:
+                sock, addr = self._server.accept()
+            except socket.timeout:
+                continue
+            ch = Channel(sock)
+            kind, payload = ch.recv()
+            if kind != REGISTER:
+                ch.close()
+                continue
+            listen_port = payload["listen_port"]
+            host = payload.get("host") or addr[0]
+            pending.append((ch, (host, listen_port)))
+        roster = [hp for _, hp in pending]
+        for rank, (ch, _) in enumerate(pending):
+            ch.send_obj({"rank": rank, "roster": roster})
+            self._channels.append(ch)
+
+    def _serve_slave(self, rank: int, ch: Channel):
+        try:
+            while True:
+                kind, payload = ch.recv()
+                if kind == LOG:
+                    self._log(rank, payload["level"], payload["msg"])
+                elif kind == BARRIER:
+                    self._barrier(rank, payload["gen"], ch)
+                elif kind == CLOSE:
+                    with self._lock:
+                        self._exit_codes[rank] = payload["code"]
+                    ch.send_obj("closed")
+                    ch.close()
+                    return
+                else:
+                    self._log(rank, "ERROR", f"unknown message {kind!r}")
+        except Exception as e:
+            # fail-stop: a dead slave (reset, EOF, corrupt frame) marks a
+            # nonzero exit code; the master keeps serving the others
+            self._log(rank, "ERROR", f"slave connection lost: {e!r}")
+            with self._lock:
+                self._exit_codes.setdefault(rank, 1)
+
+    def _log(self, rank: int, level: str, msg: str):
+        ts = time.strftime("%H:%M:%S")
+        print(f"[{ts}][rank {rank}/{self.slave_num}][{level}] {msg}",
+              file=self.log_stream, flush=True)
+
+    def _barrier(self, rank: int, gen: int, ch: Channel):
+        release = False
+        with self._lock:
+            waiting = self._barrier_waiting.setdefault(gen, [])
+            waiting.append(rank)
+            if len(waiting) == self.slave_num:
+                release = True
+        if release:
+            # release everyone waiting on this generation
+            for r, c in enumerate(self._channels):
+                c.send_obj(("barrier_release", gen))
+            with self._lock:
+                del self._barrier_waiting[gen]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="ytk-mp4j-tpu rendezvous master")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--slaves", type=int, required=True)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    m = Master(args.slaves, port=args.port, timeout=args.timeout)
+    print(f"mp4j master listening on port {m.port} for {args.slaves} slaves",
+          file=sys.stderr, flush=True)
+    return m.serve()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,402 @@
+"""Process-level slave — the CPU socket reference path.
+
+Faithful to the reference's design (SURVEY.md sections 2, 3a-3c): each
+slave owns a listen socket plus lazily-established peer TCP connections,
+registers with the rendezvous master to obtain its rank and the roster,
+and implements all 7 collectives over {dense array, sparse map} operands
+with in-place buffer semantics. ``info()/error()`` forward to the
+master's console; ``barrier()``/``close(code)`` coordinate through the
+master (SURVEY.md section 3e).
+
+Algorithms: bandwidth-optimal ring reduce-scatter / ring allgather (and
+their composition for allreduce), binomial trees for broadcast/reduce,
+direct sends for rooted gather/scatter. The reference uses MPICH-style
+recursive halving/doubling (BASELINE.json); rings are chosen here because
+they handle any rank count and uneven segments uniformly (no
+power-of-2 fold) at the same asymptotic bandwidth — semantics are
+identical, which is what the differential suite checks.
+
+The per-round element-wise merge (the reference's CPU hot loop, SURVEY.md
+section 3b step 2) runs through the native C++ kernel
+(``utils.native.reduce_into``).
+
+This path is also the semantic oracle the TPU path is differentially
+tested against, and the baseline the >=10x TPU bandwidth claim is
+measured against (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm import master as master_mod
+from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operand, Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.transport.channel import Channel, connect
+from ytk_mp4j_tpu.utils import native
+
+
+class ProcessCommSlave(CommSlave):
+    """A rank in a multi-process (TCP) mp4j job.
+
+    Construction blocks until all expected slaves have registered with
+    the master (reference behavior, SURVEY.md section 3a).
+    """
+
+    def __init__(self, master_host: str, master_port: int,
+                 listen_host: str = "127.0.0.1",
+                 timeout: float | None = 120.0):
+        self._timeout = timeout
+        # own listen socket on an ephemeral port
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((listen_host, 0))
+        self._server.listen(64)
+        self._listen_port = self._server.getsockname()[1]
+        self._listen_host = listen_host
+
+        # register with master; blocks until roster is complete
+        self._master = connect(master_host, master_port, timeout=timeout)
+        self._master.send_obj((master_mod.REGISTER, {
+            "listen_port": self._listen_port, "host": listen_host}))
+        reply = self._master.recv()
+        self._rank = reply["rank"]
+        self._roster = reply["roster"]
+        self._n = len(self._roster)
+
+        # peer channels: canonical rule — the HIGHER rank connects to the
+        # lower rank's listen socket; one duplex channel per pair.
+        self._peers: dict[int, Channel] = {}
+        self._peer_cv = threading.Condition()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"mp4j-accept-r{self._rank}")
+        self._accept_thread.start()
+        # paired send/recv helper (avoids head-of-line deadlock on large
+        # simultaneous exchanges)
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"mp4j-send-r{self._rank}")
+        self._barrier_gen = 0
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # identity / control plane
+    # ------------------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def slave_num(self) -> int:
+        return self._n
+
+    def info(self, msg: str) -> None:
+        self._master.send_obj((master_mod.LOG, {"level": "INFO", "msg": msg}))
+
+    def error(self, msg: str) -> None:
+        self._master.send_obj((master_mod.LOG, {"level": "ERROR", "msg": msg}))
+
+    def barrier(self) -> None:
+        gen = self._barrier_gen
+        self._barrier_gen += 1
+        self._master.send_obj((master_mod.BARRIER, {"gen": gen}))
+        reply = self._master.recv()
+        if reply != ("barrier_release", gen):
+            raise Mp4jError(f"barrier protocol violation: {reply!r}")
+
+    def close(self, code: int = 0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._master.send_obj((master_mod.CLOSE, {"code": code}))
+        try:
+            self._master.recv()  # "closed" ack
+        except Mp4jError:
+            pass
+        self._master.close()
+        for ch in self._peers.values():
+            ch.close()
+        self._server.close()
+        self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    # peer transport
+    # ------------------------------------------------------------------
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                return  # server closed
+            try:
+                ch = Channel(sock)
+                peer_rank = ch.recv()
+            except Exception:
+                # a peer (or stray connection) died mid-handshake; the
+                # accept loop must survive to serve the healthy peers
+                sock.close()
+                continue
+            with self._peer_cv:
+                self._peers[peer_rank] = ch
+                self._peer_cv.notify_all()
+
+    def _channel(self, peer: int) -> Channel:
+        if peer == self._rank or not (0 <= peer < self._n):
+            raise Mp4jError(f"bad peer {peer}")
+        with self._peer_cv:
+            ch = self._peers.get(peer)
+            if ch is not None:
+                return ch
+            if peer < self._rank:
+                # Creation is serialized under the cv so a concurrent
+                # send+recv pair (ring _sendrecv) can't dial the same peer
+                # twice and orphan one connection. The outbound connect
+                # does not depend on our own accept loop, so holding the
+                # lock here cannot deadlock.
+                host, port = self._roster[peer]
+                ch = connect(host, port, timeout=self._timeout)
+                ch.send_obj(self._rank)
+                self._peers[peer] = ch
+                self._peer_cv.notify_all()
+                return ch
+            # lower rank waits for the higher rank to dial in
+            ok = self._peer_cv.wait_for(
+                lambda: peer in self._peers, timeout=self._timeout)
+            if not ok:
+                raise Mp4jError(f"timeout waiting for peer {peer} to connect")
+            return self._peers[peer]
+
+    def _send(self, peer: int, data) -> None:
+        ch = self._channel(peer)
+        if isinstance(data, np.ndarray):
+            ch.send_array(data)
+        else:
+            ch.send_obj(data)
+
+    def _recv(self, peer: int):
+        return self._channel(peer).recv()
+
+    def _sendrecv(self, send_peer: int, recv_peer: int, data):
+        """Send and receive concurrently (paired exchange, ring step)."""
+        fut = self._pool.submit(self._send, send_peer, data)
+        out = self._recv(recv_peer)
+        fut.result()
+        return out
+
+    # ------------------------------------------------------------------
+    # dense-array helpers
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _merge(operator: Operator, operand: Operand, acc, src):
+        """acc = op(acc, src), element-wise; native fast path for numeric."""
+        if isinstance(acc, np.ndarray) and isinstance(src, np.ndarray):
+            native.reduce_into(operator, acc, src)
+            return acc
+        return [operator.np_fn(a, b) for a, b in zip(acc, src)]
+
+    def _norm_range(self, arr, operand: Operand, lo: int, hi: int | None):
+        if operand.is_numeric:
+            arr = operand.check_array(arr)
+            if arr.ndim != 1:
+                raise Mp4jError("socket path supports 1-D arrays")
+        length = len(arr)
+        if hi is None:
+            hi = length
+        if not (0 <= lo <= hi <= length):
+            raise Mp4jError(f"range [{lo}, {hi}) out of bounds for {length}")
+        return arr, lo, hi
+
+    # ------------------------------------------------------------------
+    # collectives: dense arrays
+    # ------------------------------------------------------------------
+    def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        from_: int = 0, to: int | None = None):
+        """Ring reduce-scatter + ring allgather over ``arr[from_:to]``."""
+        arr, lo, hi = self._norm_range(arr, operand, from_, to)
+        if self._n == 1 or hi == lo:
+            return arr
+        segs = meta.partition_range(lo, hi, self._n)
+        self._ring_reduce_scatter(arr, segs, operand, operator)
+        self._ring_allgather(arr, segs)
+        return arr
+
+    def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,
+                             operator: Operator = Operators.SUM, ranges=None):
+        """Rank r ends with segment ``ranges[r]`` of the reduction."""
+        arr, lo, hi = self._norm_range(arr, operand, 0, None)
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), self._n)
+        if self._n == 1:
+            return arr
+        self._ring_reduce_scatter(arr, ranges, operand, operator)
+        return arr
+
+    def allgather_array(self, arr, operand: Operand = Operands.FLOAT,
+                        ranges=None):
+        """Each rank owns ``arr[ranges[rank]]``; all segments everywhere."""
+        arr, _, _ = self._norm_range(arr, operand, 0, None)
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), self._n)
+        if self._n == 1:
+            return arr
+        self._ring_allgather(arr, ranges)
+        return arr
+
+    def _ring_reduce_scatter(self, arr, segs, operand, operator):
+        """After n-1 ring steps, rank r holds segment r fully reduced.
+
+        Step s: send chunk (r-1-s) mod n (the chunk merged last step),
+        receive chunk (r-2-s) mod n from the left, merge with the local
+        contribution (native hot loop).
+        """
+        n, r = self._n, self._rank
+        right, left = (r + 1) % n, (r - 1) % n
+        carry = None  # accumulated chunk in flight
+        for s in range(n - 1):
+            send_idx = (r - 1 - s) % n
+            ss, se = segs[send_idx]
+            out = carry if carry is not None else arr[ss:se]
+            recv = self._sendrecv(right, left, np.ascontiguousarray(out)
+                                  if isinstance(out, np.ndarray) else out)
+            ri_s, ri_e = segs[(r - 2 - s) % n]
+            local = arr[ri_s:ri_e]
+            if isinstance(local, np.ndarray):
+                recv = np.asarray(recv).copy()
+                native.reduce_into(operator, recv, local)
+                carry = recv
+            else:
+                carry = [operator.np_fn(a, b) for a, b in zip(recv, local)]
+        # carry is now my fully-reduced segment (index r)
+        ms, me = segs[r]
+        arr[ms:me] = carry
+        return arr
+
+    def _ring_allgather(self, arr, segs):
+        """After n-1 ring steps every rank holds all segments."""
+        n, r = self._n, self._rank
+        right, left = (r + 1) % n, (r - 1) % n
+        for s in range(n - 1):
+            ss, se = segs[(r - s) % n]
+            chunk = arr[ss:se]
+            recv = self._sendrecv(
+                right, left,
+                np.ascontiguousarray(chunk)
+                if isinstance(chunk, np.ndarray) else chunk)
+            rs, re = segs[(r - 1 - s) % n]
+            arr[rs:re] = recv
+        return arr
+
+    def reduce_array(self, arr, operand: Operand = Operands.FLOAT,
+                     operator: Operator = Operators.SUM, root: int = 0,
+                     from_: int = 0, to: int | None = None):
+        """Binomial-tree reduce into ``root``'s buffer."""
+        self._check_root(root)
+        arr, lo, hi = self._norm_range(arr, operand, from_, to)
+        if self._n == 1 or hi == lo:
+            return arr
+        vr = (self._rank - root) % self._n
+        acc = arr[lo:hi]
+        if isinstance(acc, np.ndarray):
+            acc = acc.copy()
+        else:
+            acc = list(acc)
+        mask = 1
+        while mask < self._n:
+            if vr & mask:
+                peer = ((vr - mask) + root) % self._n
+                self._send(peer, acc if not isinstance(acc, np.ndarray)
+                           else np.ascontiguousarray(acc))
+                break
+            else:
+                src_vr = vr + mask
+                if src_vr < self._n:
+                    recv = self._recv((src_vr + root) % self._n)
+                    acc = self._merge(operator, operand, acc, recv)
+            mask <<= 1
+        if self._rank == root:
+            arr[lo:hi] = acc
+        return arr
+
+    def broadcast_array(self, arr, operand: Operand = Operands.FLOAT,
+                        root: int = 0, from_: int = 0, to: int | None = None):
+        """Binomial-tree broadcast of ``root``'s ``arr[from_:to]``."""
+        self._check_root(root)
+        arr, lo, hi = self._norm_range(arr, operand, from_, to)
+        if self._n == 1 or hi == lo:
+            return arr
+        vr = (self._rank - root) % self._n
+        mask = 1
+        have = vr == 0
+        while mask < self._n:
+            if have:
+                # every holder (vr < mask) sends to vr + mask this round
+                dst_vr = vr + mask
+                if dst_vr < self._n:
+                    chunk = arr[lo:hi]
+                    self._send((dst_vr + root) % self._n,
+                               np.ascontiguousarray(chunk)
+                               if isinstance(chunk, np.ndarray) else chunk)
+            elif mask <= vr < 2 * mask:
+                recv = self._recv(((vr - mask) + root) % self._n)
+                arr[lo:hi] = recv
+                have = True
+            mask <<= 1
+        return arr
+
+    def gather_array(self, arr, operand: Operand = Operands.FLOAT,
+                     root: int = 0, ranges=None):
+        """Every rank's segment lands in ``root``'s buffer (direct sends)."""
+        self._check_root(root)
+        arr, _, _ = self._norm_range(arr, operand, 0, None)
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), self._n)
+        if self._n == 1:
+            return arr
+        if self._rank == root:
+            for peer in range(self._n):
+                if peer == root:
+                    continue
+                s, e = ranges[peer]
+                recv = self._recv(peer)
+                arr[s:e] = recv
+        else:
+            s, e = ranges[self._rank]
+            chunk = arr[s:e]
+            self._send(root, np.ascontiguousarray(chunk)
+                       if isinstance(chunk, np.ndarray) else chunk)
+        return arr
+
+    def scatter_array(self, arr, operand: Operand = Operands.FLOAT,
+                      root: int = 0, ranges=None):
+        """Rank r receives segment ``ranges[r]`` of ``root``'s buffer."""
+        self._check_root(root)
+        arr, _, _ = self._norm_range(arr, operand, 0, None)
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), self._n)
+        if self._n == 1:
+            return arr
+        if self._rank == root:
+            for peer in range(self._n):
+                if peer == root:
+                    continue
+                s, e = ranges[peer]
+                chunk = arr[s:e]
+                self._send(peer, np.ascontiguousarray(chunk)
+                           if isinstance(chunk, np.ndarray) else chunk)
+        else:
+            s, e = ranges[self._rank]
+            arr[s:e] = self._recv(root)
+        return arr
+
+    # ------------------------------------------------------------------
+    def _check_root(self, root: int):
+        if not (0 <= root < self._n):
+            raise Mp4jError(f"root {root} out of range [0, {self._n})")

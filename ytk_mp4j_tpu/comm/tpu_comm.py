@@ -94,6 +94,11 @@ class TpuCommCluster:
     def _norm_arrays(self, arrs, operand: Operand, lo: int, hi: int | None):
         if len(arrs) != self.n:
             raise Mp4jError(f"expected {self.n} per-rank arrays, got {len(arrs)}")
+        for a in arrs:
+            if not isinstance(a, np.ndarray):
+                raise Mp4jError(
+                    "per-rank buffers must be numpy arrays (results are "
+                    f"written back in place); got {type(a).__name__}")
         out = [operand.check_array(a) for a in arrs]
         shape0 = out[0].shape
         for a in out:
@@ -284,21 +289,15 @@ class TpuCommCluster:
         if arrs[0].ndim != 1:
             raise Mp4jError("segment collectives require 1-D arrays")
         ranges = self._norm_ranges(arrs, ranges)
-        B = self._max_block(ranges)
-        # Root's segments, staged sharded onto the mesh: in the
-        # single-controller runtime the host->device shard placement IS the
-        # scatter; a broadcast+slice on device would move the same bytes
-        # twice. (The SPMD functional layer has a true in-jit scatter.)
-        blocks = []
+        # In the single-controller runtime every rank's buffer lives in
+        # host memory, so scatter is a pure host copy of root's segments —
+        # a device round-trip would move the same bytes twice for zero
+        # effect. (The SPMD functional layer has a true in-jit scatter for
+        # multi-host use inside jitted programs.)
         src = arrs[root]
-        for (s, e) in ranges:
-            b = np.zeros(B, dtype=operand.dtype)
-            b[: e - s] = src[s:e]
-            blocks.append(b)
-        dev = self._stack(blocks)  # [n, B] sharded by rank
-        res = np.asarray(dev)
         for r, (s, e) in enumerate(ranges):
-            arrs[r][s:e] = res[r, : e - s]
+            if r != root:
+                arrs[r][s:e] = src[s:e]
         return arrs
 
     def reduce_scatter_array(self, arrs, operand: Operand = Operands.FLOAT,

@@ -20,9 +20,6 @@ control flow, static axis sizes.
 
 from __future__ import annotations
 
-from functools import partial, reduce as _functools_reduce
-
-import jax
 from jax import lax
 import jax.numpy as jnp
 

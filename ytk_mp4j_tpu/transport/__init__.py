@@ -1,0 +1,3 @@
+from ytk_mp4j_tpu.transport.channel import Channel
+
+__all__ = ["Channel"]

@@ -1,0 +1,103 @@
+"""Socket message framing for the CPU reference path.
+
+The reference frames messages over raw ``java.net.Socket`` streams with
+Kryo for objects and raw ``DataOutputStream`` writes for primitive arrays
+(SURVEY.md section 2 "Serialization" [U]). Here:
+
+- numeric numpy arrays take the fast path: a small dtype/shape header,
+  then the raw buffer (no pickling; zero-copy on receive into a
+  preallocated array),
+- everything else (maps, strings, objects, control tuples) is pickled —
+  pickle stands in for Kryo.
+
+Frame layout: ``u8 tag | u64 payload_len | payload``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+
+import numpy as np
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+TAG_OBJ = 0
+TAG_ARRAY = 1
+
+_HDR = struct.Struct("<BQ")
+
+
+class Channel:
+    """A framed, blocking, bidirectional message channel over a socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    # -- low level ------------------------------------------------------
+    def _send_all(self, *bufs: bytes | memoryview):
+        for b in bufs:
+            self.sock.sendall(b)
+
+    def _recv_exact(self, n: int) -> bytearray:
+        out = bytearray(n)
+        view = memoryview(out)
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise Mp4jError("peer closed connection mid-message")
+            got += r
+        return out
+
+    # -- objects --------------------------------------------------------
+    def send_obj(self, obj) -> None:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        self._send_all(_HDR.pack(TAG_OBJ, len(payload)), payload)
+
+    # -- arrays (fast path) --------------------------------------------
+    def send_array(self, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        header = pickle.dumps((arr.dtype.str, arr.shape))
+        payload_len = len(header) + 4 + arr.nbytes
+        self._send_all(
+            _HDR.pack(TAG_ARRAY, payload_len),
+            struct.pack("<I", len(header)),
+            header,
+            memoryview(arr).cast("B"),
+        )
+
+    # -- unified receive ------------------------------------------------
+    def recv(self):
+        hdr = self._recv_exact(_HDR.size)
+        tag, ln = _HDR.unpack(bytes(hdr))
+        if tag == TAG_OBJ:
+            return pickle.loads(self._recv_exact(ln))
+        if tag == TAG_ARRAY:
+            (hlen,) = struct.unpack("<I", bytes(self._recv_exact(4)))
+            dtype_str, shape = pickle.loads(self._recv_exact(hlen))
+            nbytes = ln - 4 - hlen
+            buf = self._recv_exact(nbytes)
+            return np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
+        raise Mp4jError(f"unknown frame tag {tag}")
+
+    def recv_array(self) -> np.ndarray:
+        out = self.recv()
+        if not isinstance(out, np.ndarray):
+            raise Mp4jError(f"expected array frame, got {type(out)}")
+        return out
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def connect(host: str, port: int, timeout: float | None = None) -> Channel:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    return Channel(sock)

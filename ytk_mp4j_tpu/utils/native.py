@@ -58,6 +58,8 @@ def _build() -> str:
 
 def _load():
     global _lib, HAVE_NATIVE
+    if HAVE_NATIVE is not None:  # lock-free fast path for the hot loop
+        return _lib
     with _lock:
         if HAVE_NATIVE is not None:
             return _lib
