@@ -1,0 +1,245 @@
+"""Map (sparse) collectives: TPU cluster + socket backends + differential.
+
+The reference's Map<K, V> collective family (SURVEY.md section 3c):
+key-union semantics with operator merge on shared keys; hash partitioning
+(meta.key_partition) for the scatter family on both backends.
+"""
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+from helpers import run_slaves
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return TpuCommCluster(4)
+
+
+def make_maps(n, rng, n_keys=20, fill=0.6):
+    keys = [f"feat:{i}" for i in range(n_keys)]
+    maps = []
+    for r in range(n):
+        m = {}
+        for k in keys:
+            if rng.random() < fill:
+                m[k] = float(rng.standard_normal())
+        maps.append(m)
+    return maps
+
+
+def expected_map_reduce(maps, op_name):
+    ref = {"SUM": np.add, "PROD": np.multiply, "MAX": np.maximum,
+           "MIN": np.minimum}[op_name]
+    out = {}
+    for m in maps:
+        for k, v in m.items():
+            out[k] = ref(out[k], v) if k in out else v
+    return {k: float(v) for k, v in out.items()}
+
+
+def assert_map_close(got, want):
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-9)
+
+
+# ---------------------------------------------------------------- TPU path
+@pytest.mark.parametrize("op", ["SUM", "PROD", "MAX", "MIN"])
+def test_tpu_allreduce_map(cluster, op, rng):
+    maps = make_maps(4, rng)
+    want = expected_map_reduce(maps, op)
+    cluster.allreduce_map(maps, Operands.DOUBLE, Operators.by_name(op))
+    for m in maps:
+        assert_map_close(m, want)
+
+
+def test_tpu_reduce_map(cluster, rng):
+    maps = make_maps(4, rng)
+    origs = [dict(m) for m in maps]
+    want = expected_map_reduce(maps, "SUM")
+    cluster.reduce_map(maps, Operands.DOUBLE, Operators.SUM, root=2)
+    assert_map_close(maps[2], want)
+    for r in (0, 1, 3):
+        assert maps[r] == origs[r]
+
+
+def test_tpu_reduce_scatter_map(cluster, rng):
+    maps = make_maps(4, rng)
+    want = expected_map_reduce(maps, "SUM")
+    cluster.reduce_scatter_map(maps, Operands.DOUBLE, Operators.SUM)
+    seen = {}
+    for r, m in enumerate(maps):
+        for k, v in m.items():
+            assert meta.key_partition(k, 4) == r
+            seen[k] = v
+    assert_map_close(seen, want)
+
+
+def test_tpu_allgather_map(cluster, rng):
+    maps = [{f"k{r}:{i}": float(i) for i in range(3)} for r in range(4)]
+    union = {}
+    for m in maps:
+        union.update(m)
+    cluster.allgather_map(maps, Operands.DOUBLE)
+    for m in maps:
+        assert m == union
+
+
+def test_tpu_allgather_map_dup_rejected(cluster):
+    maps = [{"same": 1.0} for _ in range(4)]
+    with pytest.raises(Mp4jError):
+        cluster.allgather_map(maps, Operands.DOUBLE)
+
+
+def test_tpu_gather_scatter_broadcast_map(cluster, rng):
+    maps = [{f"k{r}:{i}": float(r * 10 + i) for i in range(2)}
+            for r in range(4)]
+    union = {}
+    for m in maps:
+        union.update(m)
+    gm = [dict(m) for m in maps]
+    cluster.gather_map(gm, Operands.DOUBLE, root=1)
+    assert gm[1] == union
+    assert gm[0] == maps[0]
+
+    bm = [dict(m) for m in maps]
+    cluster.broadcast_map(bm, Operands.DOUBLE, root=3)
+    for m in bm:
+        assert m == maps[3]
+
+    sm = [dict(m) for m in maps]
+    src = dict(sm[0])
+    cluster.scatter_map(sm, Operands.DOUBLE, root=0)
+    rebuilt = {}
+    for r, m in enumerate(sm):
+        for k, v in m.items():
+            assert meta.key_partition(k, 4) == r
+            rebuilt[k] = v
+    assert rebuilt == src
+
+
+def test_tpu_map_vector_values(cluster, rng):
+    maps = [{"a": np.array([1.0, 2.0]), "b": np.array([1.0, 1.0])},
+            {"a": np.array([10.0, 20.0])},
+            {"c": np.array([5.0, 5.0])},
+            {}]
+    cluster.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+    for m in maps:
+        np.testing.assert_allclose(m["a"], [11.0, 22.0])
+        np.testing.assert_allclose(m["b"], [1.0, 1.0])
+        np.testing.assert_allclose(m["c"], [5.0, 5.0])
+
+
+def test_tpu_empty_maps(cluster):
+    maps = [{} for _ in range(4)]
+    cluster.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+    assert all(m == {} for m in maps)
+
+
+# ------------------------------------------------------------- socket path
+@pytest.mark.parametrize("op", ["SUM", "MAX"])
+def test_socket_allreduce_map(op, rng):
+    n = 4
+    maps = make_maps(n, rng)
+    want = expected_map_reduce(maps, op)
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.by_name(op))
+        return d
+
+    for got in run_slaves(n, fn):
+        assert_map_close(got, want)
+
+
+def test_socket_reduce_scatter_map(rng):
+    n = 3
+    maps = make_maps(n, rng)
+    want = expected_map_reduce(maps, "SUM")
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        slave.reduce_scatter_map(d, Operands.DOUBLE, Operators.SUM)
+        return d
+
+    seen = {}
+    for r, got in enumerate(run_slaves(n, fn)):
+        for k, v in got.items():
+            assert meta.key_partition(k, n) == r
+            seen[k] = v
+    assert_map_close(seen, want)
+
+
+def test_socket_gather_scatter_broadcast_map():
+    n = 3
+    maps = [{f"k{r}:{i}": float(r + i) for i in range(2)} for r in range(n)]
+    union = {}
+    for m in maps:
+        union.update(m)
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        slave.gather_map(d, Operands.DOUBLE, root=0)
+        g = dict(d)
+        d2 = dict(maps[r])
+        slave.broadcast_map(d2, Operands.DOUBLE, root=1)
+        d3 = dict(maps[0]) if r == 0 else {}
+        slave.scatter_map(d3, Operands.DOUBLE, root=0)
+        return g, d2, d3
+
+    res = run_slaves(n, fn)
+    assert res[0][0] == union
+    for r, (g, b, sc) in enumerate(res):
+        assert b == maps[1]
+        for k in sc:
+            assert meta.key_partition(k, n) == r
+
+
+def test_socket_allgather_map():
+    n = 3
+    maps = [{f"k{r}": float(r)} for r in range(n)]
+    union = {}
+    for m in maps:
+        union.update(m)
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        slave.allgather_map(d, Operands.DOUBLE)
+        return d
+
+    for got in run_slaves(n, fn):
+        assert got == union
+
+
+# ------------------------------------------------------------ differential
+@pytest.mark.parametrize("op", ["SUM", "PROD", "MAX", "MIN"])
+def test_map_differential(cluster, op, rng):
+    n = 4
+    maps = make_maps(n, rng, n_keys=31)
+    operator = Operators.by_name(op)
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        slave.allreduce_map(d, Operands.DOUBLE, operator)
+        return d
+
+    sock = run_slaves(n, fn)
+    tpu = [dict(m) for m in maps]
+    cluster.allreduce_map(tpu, Operands.DOUBLE, operator)
+    for got_s, got_t in zip(sock, tpu):
+        assert set(got_s) == set(got_t)
+        for k in got_s:
+            np.testing.assert_allclose(got_t[k], got_s[k], rtol=1e-9)
+
+
+def test_tpu_map_mixed_value_shapes_rejected(cluster):
+    maps = [{"a": 1.0}, {"b": np.ones(3)}, {}, {}]
+    with pytest.raises(Mp4jError):
+        cluster.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)

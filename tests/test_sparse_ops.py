@@ -1,0 +1,102 @@
+"""Device-level sparse collective ops (ops.sparse) on the virtual mesh."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.ops import sparse as sp
+from ytk_mp4j_tpu.parallel import make_mesh
+
+
+def run_sparse_allreduce(per_rank, capacity, operator, vshape=()):
+    """per_rank: list of (idx list, val list) per rank."""
+    n = len(per_rank)
+    mesh = make_mesh(n)
+    Lmax = max(len(i) for i, _ in per_rank)
+    idx = np.full((n, Lmax), sp.SENTINEL, dtype=np.int32)
+    ident = operator.identity(np.float64)
+    val = np.full((n, Lmax) + vshape, ident, dtype=np.float64)
+    for r, (ii, vv) in enumerate(per_rank):
+        for j, (i, v) in enumerate(zip(ii, vv)):
+            idx[r, j] = i
+            val[r, j] = v
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("mp4j"), P("mp4j")),
+             out_specs=(P(None), P(None)))
+    def f(i, v):
+        return sp.sparse_allreduce(i[0], v[0], capacity, operator, "mp4j")
+
+    oi, ov = f(idx, val)
+    return np.asarray(oi), np.asarray(ov)
+
+
+def test_sparse_allreduce_sum_union():
+    per_rank = [([1, 5, 9], [1.0, 2.0, 3.0]),
+                ([5, 7], [10.0, 20.0]),
+                ([1, 9, 11], [100.0, 200.0, 300.0])]
+    oi, ov = run_sparse_allreduce(per_rank, capacity=8, operator=Operators.SUM)
+    got = {int(i): float(v) for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert got == {1: 101.0, 5: 12.0, 7: 20.0, 9: 203.0, 11: 300.0}
+
+
+def test_sparse_allreduce_exact_capacity():
+    # union exactly fills capacity; sentinel segment must be dropped
+    per_rank = [([0, 1], [1.0, 2.0]), ([2, 3], [3.0, 4.0])]
+    oi, ov = run_sparse_allreduce(per_rank, capacity=4,
+                                  operator=Operators.SUM)
+    got = {int(i): float(v) for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert got == {0: 1.0, 1: 2.0, 2: 3.0, 3: 4.0}
+
+
+def test_sparse_allreduce_max():
+    per_rank = [([3, 4], [5.0, -2.0]), ([3, 6], [1.0, 9.0])]
+    oi, ov = run_sparse_allreduce(per_rank, capacity=4,
+                                  operator=Operators.MAX)
+    got = {int(i): float(v) for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert got == {3: 5.0, 4: -2.0, 6: 9.0}
+
+
+def test_sparse_allreduce_custom_operator():
+    absmax = Operator.custom(
+        "ABSMAX", lambda x, y: jnp.where(jnp.abs(x) >= jnp.abs(y), x, y),
+        0.0)
+    per_rank = [([0, 2], [-5.0, 1.0]), ([0, 2], [3.0, -4.0]),
+                ([7], [2.0])]
+    oi, ov = run_sparse_allreduce(per_rank, capacity=4, operator=absmax)
+    got = {int(i): float(v) for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert got == {0: -5.0, 2: -4.0, 7: 2.0}
+
+
+def test_sparse_allreduce_vector_values():
+    per_rank = [([2], [[1.0, 2.0]]), ([2, 4], [[10.0, 20.0], [5.0, 6.0]])]
+    oi, ov = run_sparse_allreduce(per_rank, capacity=4,
+                                  operator=Operators.SUM, vshape=(2,))
+    got = {int(i): list(v) for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert got == {2: [11.0, 22.0], 4: [5.0, 6.0]}
+
+
+def test_sparse_to_dense():
+    idx = jnp.array([0, 3, sp.SENTINEL], dtype=jnp.int32)
+    val = jnp.array([1.5, 2.5, 99.0])
+    out = sp.sparse_to_dense(idx, val, 5)
+    np.testing.assert_allclose(np.asarray(out), [1.5, 0, 0, 2.5, 0])
+
+
+def test_pad_to():
+    idx = jnp.array([4, 2], dtype=jnp.int32)
+    val = jnp.array([1.0, 2.0])
+    pi, pv = sp.pad_to(idx, val, 5, Operators.PROD)
+    assert pi.shape == (5,) and pv.shape == (5,)
+    assert int(pi[4]) == sp.SENTINEL
+    assert float(pv[3]) == 1.0  # PROD identity
+    with pytest.raises(ValueError):
+        sp.pad_to(idx, val, 1)

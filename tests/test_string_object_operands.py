@@ -1,0 +1,91 @@
+"""STRING / OBJECT operands (host-only path, SURVEY.md section 7 phase 4).
+
+Dense "arrays" of strings/objects are Python lists travelling pickled
+over the socket path (the Kryo analogue); the TPU backend rejects them
+with a clear error. Reduction requires an explicit (user) operator, as in
+the reference's user-defined-operator interfaces.
+"""
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+
+from helpers import run_slaves
+
+CONCAT = Operator.custom("CONCAT", lambda a, b: a + b, "")
+
+
+def test_string_allreduce_concat():
+    n = 3
+    alls = [[f"r{r}a", f"r{r}b", f"r{r}c", f"r{r}d"] for r in range(n)]
+
+    def fn(slave, r):
+        arr = list(alls[r])
+        slave.allreduce_array(arr, Operands.STRING, CONCAT)
+        return arr
+
+    want = ["r0ar1ar2a", "r0br1br2b", "r0cr1cr2c", "r0dr1dr2d"]
+    for got in run_slaves(n, fn):
+        assert got == want
+
+
+def test_string_broadcast_and_allgather():
+    n = 4
+    alls = [[f"s{r}-{i}" for i in range(8)] for r in range(n)]
+
+    def fn(slave, r):
+        arr = list(alls[r])
+        slave.broadcast_array(arr, Operands.STRING, root=2)
+        b = list(arr)
+        arr2 = list(alls[r])
+        slave.allgather_array(arr2, Operands.STRING)
+        return b, arr2
+
+    from ytk_mp4j_tpu import meta
+    ranges = meta.partition_range(0, 8, n)
+    want_ag = []
+    for q, (s, e) in enumerate(ranges):
+        want_ag.extend(alls[q][s:e])
+    for b, ag in run_slaves(n, fn):
+        assert b == alls[2]
+        assert ag == want_ag
+
+
+def test_object_operand_reduce():
+    n = 3
+    # objects: sets, merged with union
+    union_op = Operator.custom("UNION", lambda a, b: a | b, frozenset())
+    alls = [[{f"x{r}"}, {f"y{r}"}] for r in range(n)]
+
+    def fn(slave, r):
+        arr = [set(s) for s in alls[r]]
+        slave.reduce_array(arr, Operands.OBJECT_OPERAND(), union_op, root=0)
+        return arr
+
+    res = run_slaves(n, fn)
+    assert res[0] == [{"x0", "x1", "x2"}, {"y0", "y1", "y2"}]
+
+
+def test_string_map_socket():
+    n = 3
+    maps = [{f"k{r}": f"v{r}"} for r in range(n)]
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        slave.allreduce_map(d, Operands.STRING, CONCAT)
+        return d
+
+    want = {"k0": "v0", "k1": "v1", "k2": "v2"}
+    for got in run_slaves(n, fn):
+        assert got == want
+
+
+def test_string_rejected_on_tpu_path():
+    cluster = TpuCommCluster(2)
+    with pytest.raises(Mp4jError):
+        cluster.allreduce_array([np.zeros(2, np.float32)] * 2,
+                                Operands.STRING, Operators.SUM)

@@ -1,0 +1,275 @@
+"""ThreadCommSlave: standalone thread groups and hybrid process x thread
+jobs (the reference's two-level nesting, SURVEY.md section 3d)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+from helpers import expected_reduce, make_inputs
+
+
+def run_threads(slaves, fn, timeout=60.0):
+    """Run fn(slave, global_rank) on one thread per slave."""
+    results = [None] * len(slaves)
+    errors = []
+
+    def worker(sl):
+        try:
+            results[sl.thread_rank] = fn(sl, sl.rank)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(sl,), daemon=True)
+          for sl in slaves]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+        assert not t.is_alive(), "thread hung"
+    if errors:
+        raise errors[0]
+    return results
+
+
+def run_hybrid(P, T, fn, timeout=60.0):
+    """P processes (threads actually, each owning a ProcessCommSlave) x T
+    threads; returns {global_rank: result}."""
+    master = Master(P, timeout=timeout).serve_in_thread()
+    out = {}
+    out_lock = threading.Lock()
+    errors = []
+
+    def proc_worker():
+        try:
+            slaves = ThreadCommSlave.spawn_group(
+                T, "127.0.0.1", master.port, timeout=timeout)
+
+            def th(sl):
+                try:
+                    r = fn(sl, sl.rank)
+                    with out_lock:
+                        out[sl.rank] = r
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            ts = [threading.Thread(target=th, args=(sl,), daemon=True)
+                  for sl in slaves]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout)
+            for sl in slaves:
+                sl.close(0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ps = [threading.Thread(target=proc_worker, daemon=True)
+          for _ in range(P)]
+    for p in ps:
+        p.start()
+    for p in ps:
+        p.join(timeout * 2)
+        assert not p.is_alive(), "process worker hung"
+    if errors:
+        raise errors[0]
+    master.join(timeout)
+    assert master.final_code == 0
+    return out
+
+
+# ------------------------------------------------------------- standalone
+def test_standalone_allreduce(rng):
+    T = 4
+    slaves = ThreadCommSlave.spawn_group(T)
+    alls = make_inputs(T, 33, Operands.DOUBLE, rng)
+    want = expected_reduce(alls, "SUM")
+
+    def fn(sl, r):
+        arr = alls[r].copy()
+        sl.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        return arr
+
+    for got in run_threads(slaves, fn):
+        np.testing.assert_allclose(got, want)
+
+
+def test_standalone_ranks():
+    T = 3
+    slaves = ThreadCommSlave.spawn_group(T)
+    assert [s.rank for s in slaves] == [0, 1, 2]
+    assert all(s.slave_num == 3 for s in slaves)
+    assert all(s.thread_num == 3 for s in slaves)
+
+
+def test_standalone_thread_barrier_and_maps(rng):
+    T = 3
+    slaves = ThreadCommSlave.spawn_group(T)
+    maps = [{f"k{r}": 1.0, "shared": float(r)} for r in range(T)]
+
+    def fn(sl, r):
+        d = dict(maps[r])
+        sl.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        sl.thread_barrier()
+        return d
+
+    want = {"k0": 1.0, "k1": 1.0, "k2": 1.0, "shared": 3.0}
+    for got in run_threads(slaves, fn):
+        assert got == want
+
+
+# ----------------------------------------------------------------- hybrid
+@pytest.mark.parametrize("P,T", [(2, 2), (3, 2), (2, 3)])
+def test_hybrid_allreduce(P, T, rng):
+    N = P * T
+    alls = make_inputs(N, 29, Operands.DOUBLE, rng)
+    want = expected_reduce(alls, "SUM")
+
+    def fn(sl, r):
+        arr = alls[r].copy()
+        sl.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        return arr
+
+    out = run_hybrid(P, T, fn)
+    assert set(out) == set(range(N))
+    for r, got in out.items():
+        np.testing.assert_allclose(got, want)
+
+
+def test_hybrid_reduce_broadcast(rng):
+    P, T = 2, 2
+    N = P * T
+    alls = make_inputs(N, 15, Operands.DOUBLE, rng)
+    want = expected_reduce(alls, "MAX")
+    root = 3  # proc 1, thread 1
+
+    def fn(sl, r):
+        arr = alls[r].copy()
+        sl.reduce_array(arr, Operands.DOUBLE, Operators.MAX, root=root)
+        red = arr.copy()
+        arr2 = alls[r].copy()
+        sl.broadcast_array(arr2, Operands.DOUBLE, root=root)
+        return red, arr2
+
+    out = run_hybrid(P, T, fn)
+    np.testing.assert_allclose(out[root][0], want)
+    for r in range(N):
+        if r != root:
+            np.testing.assert_array_equal(out[r][0], alls[r])
+        np.testing.assert_array_equal(out[r][1], alls[root])
+
+
+def test_hybrid_allgather_reduce_scatter(rng):
+    P, T = 2, 2
+    N = P * T
+    L = 21
+    alls = make_inputs(N, L, Operands.DOUBLE, rng)
+    want = expected_reduce(alls, "SUM")
+    ranges = meta.partition_range(0, L, N)
+
+    def fn(sl, r):
+        arr = alls[r].copy()
+        sl.reduce_scatter_array(arr, Operands.DOUBLE, Operators.SUM)
+        s, e = ranges[r]
+        seg = arr[s:e].copy()
+        arr2 = np.zeros(L, dtype=np.float64)
+        s2, e2 = ranges[r]
+        arr2[s2:e2] = alls[r][s2:e2]
+        sl.allgather_array(arr2, Operands.DOUBLE)
+        return seg, arr2
+
+    out = run_hybrid(P, T, fn)
+    want_ag = np.zeros(L)
+    for q, (s, e) in enumerate(ranges):
+        want_ag[s:e] = alls[q][s:e]
+    for r in range(N):
+        s, e = ranges[r]
+        np.testing.assert_allclose(out[r][0], want[s:e])
+        np.testing.assert_array_equal(out[r][1], want_ag)
+
+
+def test_hybrid_gather_scatter(rng):
+    P, T = 2, 2
+    N = P * T
+    L = 13
+    alls = make_inputs(N, L, Operands.LONG, rng)
+    ranges = meta.partition_range(0, L, N)
+    root = 2  # proc 1, thread 0
+
+    def fn(sl, r):
+        arr = alls[r].copy()
+        sl.gather_array(arr, Operands.LONG, root=root)
+        g = arr.copy()
+        arr2 = alls[r].copy()
+        sl.scatter_array(arr2, Operands.LONG, root=root)
+        return g, arr2
+
+    out = run_hybrid(P, T, fn)
+    want_g = np.concatenate(
+        [alls[q][s:e] for q, (s, e) in enumerate(ranges)])
+    np.testing.assert_array_equal(out[root][0], want_g)
+    for r in range(N):
+        s, e = ranges[r]
+        np.testing.assert_array_equal(out[r][1][s:e], alls[root][s:e])
+
+
+def test_hybrid_maps(rng):
+    P, T = 2, 2
+    N = P * T
+    maps = [{f"k{r}": float(r), "shared": 1.0} for r in range(N)]
+
+    def fn(sl, r):
+        d = dict(maps[r])
+        sl.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        a = dict(d)
+        d2 = dict(maps[r])
+        sl.reduce_scatter_map(d2, Operands.DOUBLE, Operators.SUM)
+        return a, d2
+
+    out = run_hybrid(P, T, fn)
+    want = {"k0": 0.0, "k1": 1.0, "k2": 2.0, "k3": 3.0, "shared": 4.0}
+    rebuilt = {}
+    for r in range(N):
+        a, share = out[r]
+        assert a == want
+        for k, v in share.items():
+            assert meta.key_partition(k, N) == r
+            rebuilt[k] = v
+    assert rebuilt == want
+
+
+def test_hybrid_global_barrier_and_logging():
+    P, T = 2, 2
+
+    def fn(sl, r):
+        sl.info(f"hello {r}")
+        sl.barrier()
+        return r
+
+    out = run_hybrid(P, T, fn)
+    assert set(out) == {0, 1, 2, 3}
+
+
+def test_thread_maps_do_not_alias(rng):
+    """After a map collective, threads must own independent value
+    objects (in-place mutation on one thread must not leak)."""
+    T = 2
+    slaves = ThreadCommSlave.spawn_group(T)
+    outs = {}
+
+    def fn(sl, r):
+        d = {"k": np.array([1.0, 2.0])}
+        sl.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        outs[r] = d
+        return r
+
+    run_threads(slaves, fn)
+    assert outs[0]["k"] is not outs[1]["k"]
+    outs[0]["k"] += 100.0
+    np.testing.assert_allclose(outs[1]["k"], [2.0, 4.0])

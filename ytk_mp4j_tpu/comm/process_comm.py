@@ -219,10 +219,22 @@ class ProcessCommSlave(CommSlave):
     def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
                         operator: Operator = Operators.SUM,
                         from_: int = 0, to: int | None = None):
-        """Ring reduce-scatter + ring allgather over ``arr[from_:to]``."""
+        """Ring reduce-scatter + ring allgather over ``arr[from_:to]``.
+
+        Non-numeric (STRING/OBJECT list) operands take the rank-ordered
+        binomial tree instead: ring merge order is rotated per chunk,
+        which is only equivalent for commutative operators; list
+        reductions (e.g. concatenation) deserve deterministic rank order
+        and are latency- not bandwidth-bound anyway.
+        """
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
             return arr
+        if not operand.is_numeric:
+            self.reduce_array(arr, operand, operator, root=0,
+                              from_=from_, to=to)
+            return self.broadcast_array(arr, operand, root=0,
+                                        from_=from_, to=to)
         segs = meta.partition_range(lo, hi, self._n)
         self._ring_reduce_scatter(arr, segs, operand, operator)
         self._ring_allgather(arr, segs)
@@ -235,6 +247,19 @@ class ProcessCommSlave(CommSlave):
         if ranges is None:
             ranges = meta.partition_range(0, len(arr), self._n)
         if self._n == 1:
+            return arr
+        if not operand.is_numeric:
+            # rank-ordered tree + scatter (see allreduce_array). Rank 0's
+            # buffer is the tree root, so its positions OUTSIDE its owned
+            # range must be restored afterwards — every backend promises
+            # "other positions unchanged".
+            orig = list(arr) if self._rank == 0 else None
+            self.reduce_array(arr, operand, operator, root=0)
+            self.scatter_array(arr, operand, root=0, ranges=ranges)
+            if self._rank == 0:
+                s, e = ranges[0]
+                arr[:s] = orig[:s]
+                arr[e:] = orig[e:]
             return arr
         self._ring_reduce_scatter(arr, ranges, operand, operator)
         return arr
@@ -395,6 +420,136 @@ class ProcessCommSlave(CommSlave):
             s, e = ranges[self._rank]
             arr[s:e] = self._recv(root)
         return arr
+
+
+    # ------------------------------------------------------------------
+    # collectives: sparse maps (reference: *Map methods, SURVEY.md 3c)
+    #
+    # Dicts travel pickled (the Kryo analogue); merges apply the operator
+    # key-wise on shared keys. In-place semantics: the caller's dict is
+    # mutated. Values may be scalars, numpy arrays, strings, or arbitrary
+    # objects (with a suitable operator).
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _merge_maps(operator: Operator, acc: dict, src: dict) -> dict:
+        for k, v in src.items():
+            if k in acc:
+                acc[k] = operator.np_fn(acc[k], v)
+            else:
+                acc[k] = v
+        return acc
+
+    def allreduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      operator: Operator = Operators.SUM) -> dict:
+        """Key-union reduce; every rank ends with the merged map."""
+        self.reduce_map(d, operand, operator, root=0)
+        return self.broadcast_map(d, operand, root=0)
+
+    def reduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   operator: Operator = Operators.SUM, root: int = 0) -> dict:
+        """Binomial-tree key-wise merge into ``root``'s map."""
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        vr = (self._rank - root) % self._n
+        acc = dict(d)
+        mask = 1
+        while mask < self._n:
+            if vr & mask:
+                self._send(((vr - mask) + root) % self._n, acc)
+                break
+            else:
+                src_vr = vr + mask
+                if src_vr < self._n:
+                    recv = self._recv((src_vr + root) % self._n)
+                    acc = self._merge_maps(operator, acc, recv)
+            mask <<= 1
+        if self._rank == root:
+            d.clear()
+            d.update(acc)
+        return d
+
+    def broadcast_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      root: int = 0) -> dict:
+        """Binomial-tree broadcast of ``root``'s map."""
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        vr = (self._rank - root) % self._n
+        mask = 1
+        have = vr == 0
+        while mask < self._n:
+            if have:
+                dst_vr = vr + mask
+                if dst_vr < self._n:
+                    self._send((dst_vr + root) % self._n, d)
+            elif mask <= vr < 2 * mask:
+                recv = self._recv(((vr - mask) + root) % self._n)
+                d.clear()
+                d.update(recv)
+                have = True
+            mask <<= 1
+        return d
+
+    def gather_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   root: int = 0) -> dict:
+        """Disjoint union into ``root``'s map (duplicate keys raise)."""
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        if self._rank == root:
+            for peer in range(self._n):
+                if peer == root:
+                    continue
+                recv = self._recv(peer)
+                for k, v in recv.items():
+                    if k in d:
+                        raise Mp4jError(
+                            f"gather_map: duplicate key {k!r} from rank "
+                            f"{peer}; use reduce_map to combine")
+                    d[k] = v
+        else:
+            self._send(root, d)
+        return d
+
+    def allgather_map(self, d: dict, operand: Operand = Operands.DOUBLE) -> dict:
+        """Disjoint union everywhere (gather to 0 + broadcast)."""
+        self.gather_map(d, operand, root=0)
+        return self.broadcast_map(d, operand, root=0)
+
+    def scatter_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                    root: int = 0, partitioner=None) -> dict:
+        """Rank r keeps the subset of ``root``'s entries whose keys hash
+        to r (meta.key_partition — matches the TPU backend).
+
+        ``partitioner(key) -> rank`` overrides the placement rule (the
+        thread backend uses this to place by GLOBAL thread rank while
+        shipping each process only its threads' share)."""
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        if partitioner is None:
+            partitioner = lambda k: meta.key_partition(k, self._n)  # noqa: E731
+        if self._rank == root:
+            shares: list[dict] = [{} for _ in range(self._n)]
+            for k, v in d.items():
+                shares[partitioner(k)][k] = v
+            for peer in range(self._n):
+                if peer != root:
+                    self._send(peer, shares[peer])
+            d.clear()
+            d.update(shares[root])
+        else:
+            recv = self._recv(root)
+            d.clear()
+            d.update(recv)
+        return d
+
+    def reduce_scatter_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                           operator: Operator = Operators.SUM) -> dict:
+        """Key-union reduce, then each rank keeps its hash share."""
+        self.reduce_map(d, operand, operator, root=0)
+        return self.scatter_map(d, operand, root=0)
 
     # ------------------------------------------------------------------
     def _check_root(self, root: int):

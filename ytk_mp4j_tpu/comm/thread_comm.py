@@ -1,0 +1,587 @@
+"""Thread-level slave — hybrid process x thread parallelism.
+
+The reference's ``ThreadCommSlave`` (SURVEY.md sections 2, 3d): each of
+``thread_num`` threads in a process holds a slave object with a per-thread
+rank; collectives synchronize on an in-process barrier, reduce into
+thread 0's buffer through shared memory, run the process-level collective
+on thread 0, then fan results back out to all threads.
+
+Global rank layout is blocked: ``global_rank = proc_rank * thread_num +
+thread_rank``, so a process owns a contiguous global-rank range and
+segment collectives can coarsen thread ranges into per-process ranges for
+the process-level step.
+
+Construction: ``ThreadCommSlave.spawn_group(thread_num, master_host,
+master_port)`` builds the ``thread_num`` slave objects sharing one
+``ProcessCommSlave`` (or, with no master args, a standalone single-process
+thread group — useful for tests and pure-thread jobs).
+
+TPU mapping note (SURVEY.md 3d): this two-level hierarchy is the CPU
+analogue of the device mesh's inter x intra axes — the device-side
+equivalent is ``TpuCommCluster(mesh=make_hier_mesh(inter, intra))``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operand, Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.utils import native
+
+
+class _ThreadGroup:
+    """Shared state for the threads of one process."""
+
+    def __init__(self, thread_num: int, proc: ProcessCommSlave | None):
+        self.thread_num = thread_num
+        self.proc = proc
+        self.barrier = threading.Barrier(thread_num)
+        self.slots: list = [None] * thread_num
+        self.result = None
+        self.lock = threading.Lock()
+        # close bookkeeping: the underlying process slave closes when
+        # every thread's slave has closed (or immediately if only one
+        # close ever comes — see ThreadCommSlave.close)
+        self.pending_closes = thread_num
+        self.max_code = 0
+        self.closed = False
+
+    @property
+    def proc_rank(self) -> int:
+        return self.proc.rank if self.proc is not None else 0
+
+    @property
+    def proc_num(self) -> int:
+        return self.proc.slave_num if self.proc is not None else 1
+
+
+class ThreadCommSlave(CommSlave):
+    """One thread's endpoint in a hybrid process x thread job."""
+
+    def __init__(self, group: _ThreadGroup, thread_rank: int):
+        self._g = group
+        self._tr = thread_rank
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def spawn_group(cls, thread_num: int, master_host: str | None = None,
+                    master_port: int | None = None,
+                    **proc_kwargs) -> list["ThreadCommSlave"]:
+        """Create the ``thread_num`` slaves of this process. With master
+        args, also joins the process-level job (one ProcessCommSlave
+        shared by all threads, used from thread 0 only)."""
+        if thread_num < 1:
+            raise Mp4jError(f"thread_num must be >= 1, got {thread_num}")
+        proc = None
+        if master_host is not None:
+            if master_port is None:
+                raise Mp4jError("master_port required with master_host")
+            proc = ProcessCommSlave(master_host, master_port, **proc_kwargs)
+        g = _ThreadGroup(thread_num, proc)
+        return [cls(g, t) for t in range(thread_num)]
+
+    # ------------------------------------------------------------------
+    # identity
+    # ------------------------------------------------------------------
+    @property
+    def thread_rank(self) -> int:
+        return self._tr
+
+    @property
+    def thread_num(self) -> int:
+        return self._g.thread_num
+
+    @property
+    def rank(self) -> int:
+        """Global rank across all processes x threads (blocked layout)."""
+        return self._g.proc_rank * self._g.thread_num + self._tr
+
+    @property
+    def slave_num(self) -> int:
+        """Global endpoint count (process count x thread count)."""
+        return self._g.proc_num * self._g.thread_num
+
+    # ------------------------------------------------------------------
+    # control plane
+    # ------------------------------------------------------------------
+    def thread_barrier(self) -> None:
+        """Intra-process barrier (the reference's ``threadBarrier()``)."""
+        self._g.barrier.wait()
+
+    def barrier(self) -> None:
+        """Global barrier: threads sync, thread 0 joins the process-level
+        barrier, threads sync again."""
+        self.thread_barrier()
+        if self._tr == 0 and self._g.proc is not None:
+            self._g.proc.barrier()
+        self.thread_barrier()
+
+    def info(self, msg: str) -> None:
+        if self._g.proc is not None:
+            with self._g.lock:
+                self._g.proc.info(f"[t{self._tr}] {msg}")
+        else:
+            super().info(msg)
+
+    def error(self, msg: str) -> None:
+        if self._g.proc is not None:
+            with self._g.lock:
+                self._g.proc.error(f"[t{self._tr}] {msg}")
+        else:
+            super().error(msg)
+
+    def close(self, code: int = 0) -> None:
+        """Close the process-level connection (idempotent; safe to call
+        once per thread or once per process — no barrier, so a single
+        thread closing sequentially cannot deadlock). The highest code
+        seen before the underlying close wins."""
+        with self._g.lock:
+            self._g.max_code = max(self._g.max_code, int(code))
+            self._g.pending_closes -= 1
+            if self._g.pending_closes <= 0 and not self._g.closed:
+                self._g.closed = True
+                if self._g.proc is not None:
+                    self._g.proc.close(self._g.max_code)
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _fan_in_out(self, deposit, leader, collect):
+        """The hybrid pattern: all threads deposit, thread 0 runs
+        ``leader`` (merging + process collective), all threads collect."""
+        self._g.slots[self._tr] = deposit()
+        self.thread_barrier()
+        if self._tr == 0:
+            self._g.result = leader(self._g.slots)
+        self.thread_barrier()
+        out = collect(self._g.result)
+        # final barrier so thread 0 can't start the next collective and
+        # overwrite shared state while others are still reading
+        self.thread_barrier()
+        return out
+
+    def _coarse_ranges(self, ranges):
+        """Merge per-global-rank ranges into per-process ranges (blocked
+        layout makes each process's range contiguous)."""
+        T = self._g.thread_num
+        return [(ranges[p * T][0], ranges[p * T + T - 1][1])
+                for p in range(self._g.proc_num)]
+
+    @staticmethod
+    def _merge_into(operator, acc, src):
+        if isinstance(acc, np.ndarray):
+            native.reduce_into(operator, acc, src)
+        else:
+            for i in range(len(acc)):
+                acc[i] = operator.np_fn(acc[i], src[i])
+        return acc
+
+    @staticmethod
+    def _copied_map(m: dict) -> dict:
+        """Per-thread value copies: threads must never alias the same
+        mutable value objects after a map collective (in-place updates on
+        one thread would corrupt another's map)."""
+        return {k: (v.copy() if isinstance(v, np.ndarray) else v)
+                for k, v in m.items()}
+
+    def _decompose_root(self, root: int):
+        if not (0 <= root < self.slave_num):
+            raise Mp4jError(f"root {root} out of range [0, {self.slave_num})")
+        return divmod(root, self._g.thread_num)  # (proc, thread)
+
+    # ------------------------------------------------------------------
+    # dense-array collectives
+    # ------------------------------------------------------------------
+    def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        from_: int = 0, to: int | None = None):
+        """Intra-process tree into thread 0, process allreduce, fan out."""
+        hi = to if to is not None else len(arr)
+        lo = from_
+
+        def deposit():
+            return arr[lo:hi]
+
+        def leader(slots):
+            if isinstance(slots[0], np.ndarray):
+                acc = slots[0].copy()
+            else:
+                acc = list(slots[0])
+            for s in slots[1:]:
+                self._merge_into(operator, acc, s)
+            if self._g.proc is not None:
+                self._g.proc.allreduce_array(acc, operand, operator)
+            return acc
+
+        def collect(result):
+            arr[lo:hi] = result
+            return arr
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def reduce_array(self, arr, operand: Operand = Operands.FLOAT,
+                     operator: Operator = Operators.SUM, root: int = 0,
+                     from_: int = 0, to: int | None = None):
+        root_proc, root_thread = self._decompose_root(root)
+        hi = to if to is not None else len(arr)
+        lo = from_
+
+        def deposit():
+            return arr[lo:hi]
+
+        def leader(slots):
+            if isinstance(slots[0], np.ndarray):
+                acc = slots[0].copy()
+            else:
+                acc = list(slots[0])
+            for s in slots[1:]:
+                self._merge_into(operator, acc, s)
+            if self._g.proc is not None:
+                self._g.proc.reduce_array(acc, operand, operator,
+                                          root=root_proc)
+            return acc
+
+        def collect(result):
+            if (self._g.proc_rank == root_proc
+                    and self._tr == root_thread):
+                arr[lo:hi] = result
+            return arr
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def broadcast_array(self, arr, operand: Operand = Operands.FLOAT,
+                        root: int = 0, from_: int = 0,
+                        to: int | None = None):
+        root_proc, root_thread = self._decompose_root(root)
+        hi = to if to is not None else len(arr)
+        lo = from_
+
+        def deposit():
+            # only the root thread's payload matters
+            return arr[lo:hi]
+
+        def leader(slots):
+            if self._g.proc_rank == root_proc:
+                buf = slots[root_thread]
+                if isinstance(buf, np.ndarray):
+                    buf = buf.copy()
+                else:
+                    buf = list(buf)
+            else:
+                buf = slots[0]
+                if isinstance(buf, np.ndarray):
+                    buf = buf.copy()
+                else:
+                    buf = list(buf)
+            if self._g.proc is not None:
+                self._g.proc.broadcast_array(buf, operand, root=root_proc)
+            return buf
+
+        def collect(result):
+            arr[lo:hi] = result
+            return arr
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def allgather_array(self, arr, operand: Operand = Operands.FLOAT,
+                        ranges=None):
+        N = self.slave_num
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), N)
+        if len(ranges) != N:
+            raise Mp4jError(f"need {N} ranges, got {len(ranges)}")
+        my_s, my_e = ranges[self.rank]
+
+        def deposit():
+            return (my_s, my_e, arr[my_s:my_e])
+
+        def leader(slots):
+            if isinstance(slots[0][2], np.ndarray):
+                full = np.zeros(len(arr), dtype=operand.dtype)
+            else:
+                full = [None] * len(arr)
+            for (s, e, seg) in slots:
+                full[s:e] = seg
+            if self._g.proc is not None:
+                self._g.proc.allgather_array(
+                    full, operand, ranges=self._coarse_ranges(ranges))
+            return full
+
+        def collect(result):
+            lo = ranges[0][0]
+            hi = ranges[-1][1]
+            arr[lo:hi] = result[lo:hi]
+            return arr
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def gather_array(self, arr, operand: Operand = Operands.FLOAT,
+                     root: int = 0, ranges=None):
+        root_proc, root_thread = self._decompose_root(root)
+        N = self.slave_num
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), N)
+        my_s, my_e = ranges[self.rank]
+
+        def deposit():
+            return (my_s, my_e, arr[my_s:my_e])
+
+        def leader(slots):
+            if isinstance(slots[0][2], np.ndarray):
+                full = np.zeros(len(arr), dtype=operand.dtype)
+            else:
+                full = [None] * len(arr)
+            for (s, e, seg) in slots:
+                full[s:e] = seg
+            if self._g.proc is not None:
+                self._g.proc.gather_array(
+                    full, operand, root=root_proc,
+                    ranges=self._coarse_ranges(ranges))
+            return full
+
+        def collect(result):
+            if (self._g.proc_rank == root_proc
+                    and self._tr == root_thread):
+                lo, hi = ranges[0][0], ranges[-1][1]
+                arr[lo:hi] = result[lo:hi]
+            return arr
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def scatter_array(self, arr, operand: Operand = Operands.FLOAT,
+                      root: int = 0, ranges=None):
+        root_proc, root_thread = self._decompose_root(root)
+        N = self.slave_num
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), N)
+
+        def deposit():
+            return arr
+
+        def leader(slots):
+            if self._g.proc_rank == root_proc:
+                full = slots[root_thread]
+                if isinstance(full, np.ndarray):
+                    full = full.copy()
+                else:
+                    full = list(full)
+            else:
+                full = slots[0]
+                if isinstance(full, np.ndarray):
+                    full = full.copy()
+                else:
+                    full = list(full)
+            if self._g.proc is not None:
+                self._g.proc.scatter_array(
+                    full, operand, root=root_proc,
+                    ranges=self._coarse_ranges(ranges))
+            return full
+
+        def collect(result):
+            s, e = ranges[self.rank]
+            arr[s:e] = result[s:e]
+            return arr
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,
+                             operator: Operator = Operators.SUM,
+                             ranges=None):
+        N = self.slave_num
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), N)
+
+        def deposit():
+            return arr
+
+        def leader(slots):
+            if isinstance(slots[0], np.ndarray):
+                acc = slots[0].copy()
+            else:
+                acc = list(slots[0])
+            for s in slots[1:]:
+                self._merge_into(operator, acc, s)
+            if self._g.proc is not None:
+                self._g.proc.reduce_scatter_array(
+                    acc, operand, operator,
+                    ranges=self._coarse_ranges(ranges))
+            return acc
+
+        def collect(result):
+            s, e = ranges[self.rank]
+            arr[s:e] = result[s:e]
+            return arr
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    # ------------------------------------------------------------------
+    # map collectives
+    # ------------------------------------------------------------------
+    def allreduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      operator: Operator = Operators.SUM) -> dict:
+        def deposit():
+            return dict(d)
+
+        def leader(slots):
+            acc: dict = {}
+            for m in slots:
+                for k, v in m.items():
+                    acc[k] = operator.np_fn(acc[k], v) if k in acc else v
+            if self._g.proc is not None:
+                self._g.proc.allreduce_map(acc, operand, operator)
+            return acc
+
+        def collect(result):
+            d.clear()
+            d.update(self._copied_map(result))
+            return d
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def reduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   operator: Operator = Operators.SUM, root: int = 0) -> dict:
+        root_proc, root_thread = self._decompose_root(root)
+
+        def deposit():
+            return dict(d)
+
+        def leader(slots):
+            acc: dict = {}
+            for m in slots:
+                for k, v in m.items():
+                    acc[k] = operator.np_fn(acc[k], v) if k in acc else v
+            if self._g.proc is not None:
+                self._g.proc.reduce_map(acc, operand, operator,
+                                        root=root_proc)
+            return acc
+
+        def collect(result):
+            if (self._g.proc_rank == root_proc
+                    and self._tr == root_thread):
+                d.clear()
+                d.update(self._copied_map(result))
+            return d
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def broadcast_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      root: int = 0) -> dict:
+        root_proc, root_thread = self._decompose_root(root)
+
+        def deposit():
+            return dict(d)
+
+        def leader(slots):
+            buf = dict(slots[root_thread
+                             if self._g.proc_rank == root_proc else 0])
+            if self._g.proc is not None:
+                self._g.proc.broadcast_map(buf, operand, root=root_proc)
+            return buf
+
+        def collect(result):
+            d.clear()
+            d.update(self._copied_map(result))
+            return d
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def gather_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   root: int = 0) -> dict:
+        root_proc, root_thread = self._decompose_root(root)
+
+        def deposit():
+            return dict(d)
+
+        def leader(slots):
+            acc: dict = {}
+            total = 0
+            for m in slots:
+                total += len(m)
+                acc.update(m)
+            if len(acc) != total:
+                raise Mp4jError("gather_map requires disjoint keys")
+            if self._g.proc is not None:
+                self._g.proc.gather_map(acc, operand, root=root_proc)
+            return acc
+
+        def collect(result):
+            if (self._g.proc_rank == root_proc
+                    and self._tr == root_thread):
+                d.clear()
+                d.update(self._copied_map(result))
+            return d
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def allgather_map(self, d: dict,
+                      operand: Operand = Operands.DOUBLE) -> dict:
+        def deposit():
+            return dict(d)
+
+        def leader(slots):
+            acc: dict = {}
+            total = 0
+            for m in slots:
+                total += len(m)
+                acc.update(m)
+            if len(acc) != total:
+                raise Mp4jError("allgather_map requires disjoint keys")
+            if self._g.proc is not None:
+                self._g.proc.allgather_map(acc, operand)
+            return acc
+
+        def collect(result):
+            d.clear()
+            d.update(self._copied_map(result))
+            return d
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def scatter_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                    root: int = 0) -> dict:
+        """Rank r keeps the subset of ``root``'s entries whose keys hash
+        to global rank r (meta.key_partition over slave_num).
+
+        Each process receives only its own threads' share over the wire
+        (the process-level scatter places by ``global_rank // T``), then
+        threads split it through shared memory."""
+        root_proc, root_thread = self._decompose_root(root)
+        N = self.slave_num
+        T = self._g.thread_num
+
+        def deposit():
+            return dict(d)
+
+        def leader(slots):
+            buf = dict(slots[root_thread
+                             if self._g.proc_rank == root_proc else 0])
+            if self._g.proc is not None:
+                self._g.proc.scatter_map(
+                    buf, operand, root=root_proc,
+                    partitioner=lambda k: meta.key_partition(k, N) // T)
+            return buf
+
+        def collect(result):
+            mine = {k: v for k, v in result.items()
+                    if meta.key_partition(k, N) == self.rank}
+            d.clear()
+            d.update(self._copied_map(mine))
+            return d
+
+        return self._fan_in_out(deposit, leader, collect)
+
+    def reduce_scatter_map(self, d: dict,
+                           operand: Operand = Operands.DOUBLE,
+                           operator: Operator = Operators.SUM) -> dict:
+        """Key-union reduce, keep this global rank's hash share. Tree
+        reduce to global rank 0, then partitioned scatter (each process
+        only receives its threads' share)."""
+        self.reduce_map(d, operand, operator, root=0)
+        return self.scatter_map(d, operand, root=0)

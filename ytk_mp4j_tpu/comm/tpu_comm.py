@@ -40,6 +40,7 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.ops import collectives as coll
+from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.parallel.mesh import make_mesh, DEFAULT_AXIS
 
 
@@ -61,12 +62,20 @@ class TpuCommCluster:
                  axis_name: str = DEFAULT_AXIS):
         if mesh is None:
             mesh = make_mesh(n, axis_name)
-        if len(mesh.axis_names) != 1:
-            raise Mp4jError("TpuCommCluster needs a 1-D mesh; use "
-                            "HierComm for 2-D meshes")
         self.mesh = mesh
-        self.axis_name = mesh.axis_names[0]
-        self.n = mesh.shape[self.axis_name]
+        if len(mesh.axis_names) == 1:
+            # flat cluster: ranks along one axis
+            self.axis_name = mesh.axis_names[0]
+            self.n = mesh.shape[self.axis_name]
+        else:
+            # hierarchical cluster (e.g. inter x intra, the device-side
+            # analogue of process x thread nesting): ranks are row-major
+            # over all axes; collectives run over the axis tuple and XLA
+            # stages them across DCN/ICI
+            self.axis_name = tuple(mesh.axis_names)
+            self.n = 1
+            for a in mesh.axis_names:
+                self.n *= mesh.shape[a]
         self._row_sharding = NamedSharding(mesh, P(self.axis_name))
         self._jits: dict = {}
 
@@ -337,6 +346,180 @@ class TpuCommCluster:
         for r, (s, e) in enumerate(ranges):
             arrs[r][s:e] = full[s - lo: e - lo]
         return arrs
+
+
+    # ------------------------------------------------------------------
+    # sparse map collectives (reference: *Map methods, SURVEY.md 3c)
+    #
+    # Keys live on the host (strings are not TPU-representable — the
+    # reference likewise keeps them in Kryo land); values ride the device
+    # as packed (code, value) buffers through ops.sparse. In-place
+    # semantics: each rank's dict is mutated like the reference's maps.
+    # ------------------------------------------------------------------
+    def _norm_maps(self, maps, operand: Operand):
+        if len(maps) != self.n:
+            raise Mp4jError(f"expected {self.n} per-rank maps, got {len(maps)}")
+        for m in maps:
+            if not isinstance(m, dict):
+                raise Mp4jError(
+                    f"per-rank operands must be dicts, got {type(m).__name__}")
+        self._check_operand(operand)
+        return maps
+
+    def _encode_maps(self, maps, operand: Operand, operator: Operator):
+        """Union + sort keys, pack each rank's entries into SENTINEL-padded
+        (code, value) buffers of equal static length."""
+        keys = sorted(set().union(*[m.keys() for m in maps]))
+        code = {k: i for i, k in enumerate(keys)}
+        # pin the value shape from the first value anywhere, then check
+        # EVERY value (scalars have shape (), which must also match —
+        # mixed scalar/array maps would otherwise broadcast silently)
+        vshape = None
+        for m in maps:
+            for v in m.values():
+                vs = np.shape(v)
+                if vshape is None:
+                    vshape = vs
+                elif vs != vshape:
+                    raise Mp4jError(
+                        f"map values must share a shape; {vs} vs {vshape}")
+        if vshape is None:
+            vshape = ()
+        Lmax = max(1, max((len(m) for m in maps), default=0))
+        ident = operator.identity(operand.dtype)
+        idx = np.full((self.n, Lmax), sparse_ops.SENTINEL, dtype=np.int32)
+        val = np.full((self.n, Lmax) + vshape, ident, dtype=operand.dtype)
+        for r, m in enumerate(maps):
+            for j, (k, v) in enumerate(sorted(m.items())):
+                idx[r, j] = code[k]
+                val[r, j] = v
+        return keys, idx, val, vshape
+
+    def _device_sparse_allreduce(self, idx, val, capacity, operator):
+        Lmax = idx.shape[1]
+        vshape = val.shape[2:]
+
+        def build():
+            @partial(shard_map, mesh=self.mesh, check_vma=False,
+                     in_specs=(P(self.axis_name), P(self.axis_name)),
+                     out_specs=(P(None), P(None)))
+            def f(i, v):  # [1, L] / [1, L, *vshape] per shard
+                return sparse_ops.sparse_allreduce(
+                    i[0], v[0], capacity, operator, self.axis_name)
+            return jax.jit(f)
+
+        key = ("sparse_allreduce", Lmax, capacity, vshape,
+               val.dtype.str, operator)
+        fn = self._jit(key, build)
+        oi, ov = fn(jax.device_put(idx, self._row_sharding),
+                    jax.device_put(val, self._row_sharding))
+        return np.asarray(oi), np.asarray(ov)
+
+    def allreduce_map(self, maps, operand: Operand = Operands.DOUBLE,
+                      operator: Operator = Operators.SUM):
+        """Key-union reduce: every rank's dict becomes the union of all
+        keys with shared keys reduced by ``operator``."""
+        maps = self._norm_maps(maps, operand)
+        keys, idx, val, vshape = self._encode_maps(maps, operand, operator)
+        if not keys:
+            return maps
+        oi, ov = self._device_sparse_allreduce(idx, val, len(keys), operator)
+        merged = {}
+        for c, v in zip(oi, ov):
+            if c != sparse_ops.SENTINEL:
+                merged[keys[c]] = v.copy() if vshape else operand.dtype.type(v)
+        for m in maps:
+            m.clear()
+            m.update(merged)
+        return maps
+
+    def reduce_map(self, maps, operand: Operand = Operands.DOUBLE,
+                   operator: Operator = Operators.SUM, root: int = 0):
+        """Key-union reduce into ``root``'s dict; others unchanged."""
+        self._check_root(root)
+        maps = self._norm_maps(maps, operand)
+        keys, idx, val, vshape = self._encode_maps(maps, operand, operator)
+        if not keys:
+            return maps
+        oi, ov = self._device_sparse_allreduce(idx, val, len(keys), operator)
+        merged = {}
+        for c, v in zip(oi, ov):
+            if c != sparse_ops.SENTINEL:
+                merged[keys[c]] = v.copy() if vshape else operand.dtype.type(v)
+        maps[root].clear()
+        maps[root].update(merged)
+        return maps
+
+    def reduce_scatter_map(self, maps, operand: Operand = Operands.DOUBLE,
+                           operator: Operator = Operators.SUM):
+        """Key-union reduce, then each rank keeps the keys hashing to it
+        (meta.key_partition — identical placement on both backends)."""
+        maps = self._norm_maps(maps, operand)
+        keys, idx, val, vshape = self._encode_maps(maps, operand, operator)
+        if not keys:
+            return maps
+        oi, ov = self._device_sparse_allreduce(idx, val, len(keys), operator)
+        shares: list[dict] = [{} for _ in range(self.n)]
+        for c, v in zip(oi, ov):
+            if c != sparse_ops.SENTINEL:
+                k = keys[c]
+                shares[meta.key_partition(k, self.n)][k] = (
+                    v.copy() if vshape else operand.dtype.type(v))
+        for r, m in enumerate(maps):
+            m.clear()
+            m.update(shares[r])
+        return maps
+
+    def allgather_map(self, maps, operand: Operand = Operands.DOUBLE):
+        """Disjoint union: every rank's dict becomes the union of all
+        ranks' entries. Duplicate keys raise (ambiguous without an
+        operator). Composition of gather + broadcast, like the socket
+        backend."""
+        self.gather_map(maps, operand, root=0)
+        return self.broadcast_map(maps, operand, root=0)
+
+    def gather_map(self, maps, operand: Operand = Operands.DOUBLE,
+                   root: int = 0):
+        """Disjoint union into ``root``'s dict; others unchanged."""
+        self._check_root(root)
+        maps = self._norm_maps(maps, operand)
+        total = sum(len(m) for m in maps)
+        union: dict = {}
+        for m in maps:
+            union.update(m)
+        if len(union) != total:
+            raise Mp4jError("gather_map requires disjoint keys across "
+                            "ranks; use reduce_map to combine")
+        maps[root].clear()
+        maps[root].update(union)
+        return maps
+
+    def broadcast_map(self, maps, operand: Operand = Operands.DOUBLE,
+                      root: int = 0):
+        """Every rank's dict becomes a copy of ``root``'s."""
+        self._check_root(root)
+        maps = self._norm_maps(maps, operand)
+        src = dict(maps[root])
+        for r, m in enumerate(maps):
+            if r != root:
+                m.clear()
+                m.update(src)
+        return maps
+
+    def scatter_map(self, maps, operand: Operand = Operands.DOUBLE,
+                    root: int = 0):
+        """Rank r receives the subset of ``root``'s entries whose keys
+        hash to r (meta.key_partition)."""
+        self._check_root(root)
+        maps = self._norm_maps(maps, operand)
+        src = dict(maps[root])
+        shares: list[dict] = [{} for _ in range(self.n)]
+        for k, v in src.items():
+            shares[meta.key_partition(k, self.n)][k] = v
+        for r, m in enumerate(maps):
+            m.clear()
+            m.update(shares[r])
+        return maps
 
     # ------------------------------------------------------------------
     def barrier(self):

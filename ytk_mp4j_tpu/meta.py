@@ -64,6 +64,20 @@ def owner_of(index: int, lo: int, hi: int, parts: int) -> int:
     return rem + (off - cut) // base
 
 
+def key_partition(key, parts: int) -> int:
+    """Stable hash partition of a map key across ranks.
+
+    Used by scatter_map / reduce_scatter_map on BOTH backends so
+    differential tests see identical key placement. Python's builtin
+    ``hash`` is salted per-process (PYTHONHASHSEED), so a keyed-stable
+    blake2b digest of the key's string form is used instead.
+    """
+    import hashlib
+
+    h = hashlib.blake2b(repr(key).encode("utf-8"), digest_size=8)
+    return int.from_bytes(h.digest(), "little") % parts
+
+
 def padded_block(length: int, parts: int) -> int:
     """Per-rank block size when padding ``length`` up to a multiple of
     ``parts`` (used by the TPU path, which needs equal static shapes)."""

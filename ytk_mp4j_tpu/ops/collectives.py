@@ -14,6 +14,14 @@ native XLA reduction (SUM / MAX / MIN) use ``lax.psum / pmax / pmin``;
 PROD and user-defined operators tree-reduce a gathered axis (XLA fuses the
 reduction; correctness for any associative+commutative ``jnp_fn``).
 
+``axis_name`` may be a TUPLE of mesh axis names (e.g. ``("inter",
+"intra")``) for hierarchical two-level collectives over an inter x intra
+mesh — the device-side analogue of the reference's process x thread
+nesting (SURVEY.md section 3d). Members are then ranked in row-major
+(inter-major) order, matching the blocked global-rank layout of
+``ThreadCommSlave``. XLA fuses multi-axis psum/pmax/pmin into a staged
+ICI/DCN schedule.
+
 All functions are shape-polymorphic and jit-safe: no data-dependent
 control flow, static axis sizes.
 """
@@ -27,8 +35,24 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operators import Operator, Operators
 
 
+def _axes(axis_name) -> tuple:
+    return axis_name if isinstance(axis_name, tuple) else (axis_name,)
+
+
 def _axis_size(axis_name) -> int:
-    return lax.axis_size(axis_name)
+    n = 1
+    for a in _axes(axis_name):
+        n *= lax.axis_size(a)
+    return n
+
+
+def flat_index(axis_name):
+    """Row-major member index across one or more mesh axes."""
+    axes = _axes(axis_name)
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
 
 
 def _tree_reduce_gathered(x, operator: Operator, axis_name):
@@ -39,6 +63,8 @@ def _tree_reduce_gathered(x, operator: Operator, axis_name):
     the rare generic-op path; SUM/MAX/MIN never take it.
     """
     g = lax.all_gather(x, axis_name, axis=0, tiled=False)  # [n, ...]
+    if isinstance(axis_name, tuple) and g.ndim > x.ndim + 1:
+        g = g.reshape((-1,) + x.shape)  # collapse per-axis stacking
     n = g.shape[0]
     parts = [g[i] for i in range(n)]
     # Balanced pairwise tree keeps float error O(log n), like the
@@ -77,7 +103,7 @@ def reduce(x, operator: Operator = Operators.SUM, root: int = 0,
 
 def broadcast(x, root: int = 0, axis_name="mp4j"):
     """Every member receives ``root``'s ``x``. Numeric dtypes only."""
-    idx = lax.axis_index(axis_name)
+    idx = flat_index(axis_name)
     contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
     return lax.psum(contrib, axis_name)
 
@@ -105,7 +131,7 @@ def scatter(x, root: int = 0, axis_name="mp4j"):
             f"scatter dim0 {x.shape[0]} not divisible by axis size {n}")
     full = broadcast(x, root, axis_name)
     block = x.shape[0] // n
-    idx = lax.axis_index(axis_name)
+    idx = flat_index(axis_name)
     return lax.dynamic_slice_in_dim(full, idx * block, block, axis=0)
 
 
@@ -116,11 +142,11 @@ def reduce_scatter(x, operator: Operator = Operators.SUM, axis_name="mp4j"):
     if x.shape[0] % n != 0:
         raise Mp4jError(
             f"reduce_scatter dim0 {x.shape[0]} not divisible by axis size {n}")
-    if operator.lax_collective == "psum":
+    if operator.lax_collective == "psum" and not isinstance(axis_name, tuple):
         return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
     full = allreduce(x, operator, axis_name)
     block = x.shape[0] // n
-    idx = lax.axis_index(axis_name)
+    idx = flat_index(axis_name)
     return lax.dynamic_slice_in_dim(full, idx * block, block, axis=0)
 
 

@@ -1,0 +1,137 @@
+"""Device-native sparse collectives — the TPU replacement for the
+reference's ``Map<K, V>`` Kryo path.
+
+The reference's sparse allreduce serializes whole hash maps with Kryo and
+merges key-wise per socket round — an allocation-heavy host loop
+(SURVEY.md section 3c). The TPU-native design packs each rank's sparse
+contribution into dense ``(index, value)`` buffers of STATIC capacity and
+rides XLA collectives:
+
+    all_gather(idx), all_gather(val)      # one ICI collective each
+    sort by idx                           # XLA sort, fused
+    segment-reduce runs of equal idx      # jax.ops.segment_*
+    compact to static out-capacity        # scatter into [capacity]
+
+Everything is static-shaped (XLA requirement): unused slots carry a
+SENTINEL index and the operator's identity value, so padding never
+perturbs results. Host-side key<->code translation (for string keys)
+lives in ``comm.tpu_comm``; this module is pure device code usable inside
+``shard_map`` (e.g. embedding-gradient aggregation inside a jitted train
+step — the FFM workload of BASELINE.json configs[4]).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ytk_mp4j_tpu.operators import Operator, Operators
+
+# Index sentinel for padding slots. int32 max keeps sorts stable (padding
+# sorts to the end) and is never a legal key code.
+SENTINEL = jnp.iinfo(jnp.int32).max
+
+_SEGMENT_REDUCERS = {
+    "SUM": jax.ops.segment_sum,
+    "PROD": jax.ops.segment_prod,
+    "MAX": jax.ops.segment_max,
+    "MIN": jax.ops.segment_min,
+}
+
+
+def pad_to(idx, val, capacity: int, operator: Operator = Operators.SUM):
+    """Pad/truncate ``(idx, val)`` to static ``capacity`` slots, filling
+    with SENTINEL / the operator identity."""
+    L = idx.shape[0]
+    if L > capacity:
+        raise ValueError(f"{L} entries exceed capacity {capacity}")
+    ident = jnp.asarray(operator.identity(val.dtype), dtype=val.dtype)
+    pad_i = jnp.full((capacity - L,), SENTINEL, dtype=jnp.int32)
+    pad_v = jnp.full((capacity - L,) + val.shape[1:], ident, dtype=val.dtype)
+    return (jnp.concatenate([idx.astype(jnp.int32), pad_i]),
+            jnp.concatenate([val, pad_v]))
+
+
+def segment_reduce_sorted(idx, val, capacity: int,
+                          operator: Operator = Operators.SUM):
+    """Reduce runs of equal index in an idx-sorted stream into at most
+    ``capacity`` unique (idx, val) slots. Returns (out_idx, out_val) with
+    SENTINEL/identity padding; unique entries are packed at the front in
+    ascending idx order."""
+    # run starts -> segment ids (cumsum of boundary flags)
+    first = jnp.ones((1,), dtype=jnp.int32)
+    bounds = jnp.concatenate([first, (idx[1:] != idx[:-1]).astype(jnp.int32)])
+    # padding slots (SENTINEL) must not open new live segments; they sort
+    # to the end so they share one trailing segment region
+    seg = jnp.cumsum(bounds) - 1
+    reducer = _SEGMENT_REDUCERS.get(operator.name)
+    if reducer is not None:
+        out_val = reducer(val, seg, num_segments=capacity)
+    else:
+        # generic associative op: log-step doubling combine over the
+        # sorted stream (scan-free, static shapes)
+        out_val = _generic_segment_reduce(val, seg, capacity, operator)
+    # mode="drop": with a full union the sentinel segment id equals
+    # `capacity` and must be discarded, not clipped onto the last slot
+    out_idx = (jnp.full((capacity,), SENTINEL, dtype=jnp.int32)
+               .at[seg].set(idx, mode="drop"))
+    # overwrite segments that only contain sentinel slots; values may be
+    # N-D (map-of-arrays operands) — broadcast the liveness mask
+    ident = jnp.asarray(operator.identity(val.dtype), dtype=val.dtype)
+    live = (out_idx != SENTINEL).reshape(
+        (capacity,) + (1,) * (out_val.ndim - 1))
+    out_val = jnp.where(live, out_val, ident)
+    return out_idx, out_val
+
+
+def _generic_segment_reduce(val, seg, capacity: int, operator: Operator):
+    """Segment reduction for user-defined operators via a segmented
+    suffix scan (Hillis-Steele): after round k, acc[i] covers elements
+    [i, i+2^k) of i's segment; segment contiguity in the sorted stream
+    makes the same-segment test sufficient. O(log L) rounds, static."""
+    L = val.shape[0]
+    acc = val
+    stride = 1
+    idxs = jnp.arange(L)
+    expand = (L,) + (1,) * (val.ndim - 1)
+    while stride < L:
+        partner = idxs + stride
+        partner_ok = partner < L
+        p = jnp.clip(partner, 0, L - 1)
+        same = ((seg[p] == seg) & partner_ok).reshape(expand)
+        merged = operator.jnp_fn(acc, acc[p])
+        acc = jnp.where(same, merged, acc)
+        stride *= 2
+    # heads of segments carry the full reduction
+    head = jnp.concatenate([jnp.ones((1,), bool), seg[1:] != seg[:-1]])
+    out = jnp.full((capacity,) + val.shape[1:],
+                   operator.identity(val.dtype), dtype=val.dtype)
+    out = out.at[jnp.where(head, seg, capacity)].set(acc, mode="drop")
+    return out
+
+
+def sparse_allreduce(idx, val, capacity: int,
+                     operator: Operator = Operators.SUM,
+                     axis_name: str = "mp4j"):
+    """Key-union sparse allreduce inside ``shard_map``.
+
+    Each member contributes up to ``local_capacity`` (= idx.shape[0])
+    entries (SENTINEL-padded). Every member receives the union of keys
+    with values reduced by ``operator``, packed ascending into
+    ``capacity`` static slots (SENTINEL/identity padding).
+    """
+    gi = lax.all_gather(idx, axis_name, axis=0, tiled=True)
+    gv = lax.all_gather(val, axis_name, axis=0, tiled=True)
+    order = jnp.argsort(gi)
+    return segment_reduce_sorted(gi[order], gv[order], capacity, operator)
+
+
+def sparse_to_dense(idx, val, size: int,
+                    operator: Operator = Operators.SUM):
+    """Scatter (idx, val) into a dense [size] vector (identity-filled);
+    SENTINEL slots are dropped."""
+    ident = jnp.asarray(operator.identity(val.dtype), dtype=val.dtype)
+    out = jnp.full((size,) + val.shape[1:], ident, dtype=val.dtype)
+    safe = jnp.where(idx == SENTINEL, size, idx)
+    return out.at[safe].set(val, mode="drop")
