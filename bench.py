@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""North-star benchmark: GBDT histogram allreduce (BASELINE.md).
+
+Measures the flagship workload — per-tree-level (node x feature x bin)
+gradient/hessian histogram build + allreduce (ytk-learn GBDT shape:
+F=28 features, 256 bins, depth-6 trees, Higgs-like synthetic data) — on:
+
+1. the TPU path: one jitted shard_map step per tree over the available
+   chip(s) (histograms built by XLA segment-sum, allreduced by psum);
+2. the CPU socket baseline: the same tree build with numpy histograms
+   and the histogram allreduce over real loopback TCP via
+   ProcessCommSlave ring collectives (the reference's architecture).
+
+Metric (GB/s/chip): bytes of training data scanned per histogram pass
+(depth levels x N x (F bin-bytes + 8 grad/hess bytes)) per second per
+chip — a rate, so the two paths may use different N. vs_baseline is the
+TPU rate over the socket rate (north star: >= 10x, BASELINE.json).
+
+Prints exactly one JSON line.
+"""
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def make_data(n, f, b, seed=0):
+    rng = np.random.default_rng(seed)
+    bins = rng.integers(0, b, (n, f)).astype(np.int32)
+    y = (bins[:, 0] / b + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    return bins, y
+
+
+def scanned_bytes(n, f, depth):
+    # per level the trainer scans every sample's F bin bytes + g/h floats
+    return depth * n * (f + 8)
+
+
+# ----------------------------------------------------------------------
+def bench_tpu(n=2_000_000, f=28, b=256, depth=6, trees=3):
+    import jax
+    from ytk_mp4j_tpu.models.gbdt import GBDTConfig, GBDTTrainer
+
+    cfg = GBDTConfig(n_features=f, n_bins=b, depth=depth,
+                     learning_rate=0.1, n_trees=trees)
+    tr = GBDTTrainer(cfg)  # all available real devices
+    bins, y = make_data(n, f, b)
+    dbins, dy, dpreds, dw = tr.shard_data(bins, y)
+    step = tr._build_step()
+    # warmup + compile
+    dpreds, tree = step(dbins, dy, dpreds, dw)
+    jax.block_until_ready(dpreds)
+    t0 = time.perf_counter()
+    for _ in range(trees):
+        dpreds, tree = step(dbins, dy, dpreds, dw)
+    jax.block_until_ready(dpreds)
+    dt = (time.perf_counter() - t0) / trees
+    n_chips = jax.device_count()
+    gbs_per_chip = scanned_bytes(n, f, depth) / dt / 1e9 / n_chips
+    return gbs_per_chip, 1.0 / dt, n_chips
+
+
+# ----------------------------------------------------------------------
+def _numpy_histograms(bins, g, h, node_ids, n_nodes, f, b):
+    hg = np.zeros((n_nodes, f, b), np.float32)
+    hh = np.zeros((n_nodes, f, b), np.float32)
+    base = node_ids.astype(np.int64) * (f * b)
+    for j in range(f):
+        ids = base + j * b + bins[:, j]
+        hg.reshape(-1)[:] += np.bincount(ids, weights=g,
+                                         minlength=n_nodes * f * b)
+        hh.reshape(-1)[:] += np.bincount(ids, weights=h,
+                                         minlength=n_nodes * f * b)
+    return hg, hh
+
+
+def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4):
+    """The reference-architecture baseline: numpy histogram build + ring
+    allreduce of the histogram buffers over loopback TCP."""
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    bins, y = make_data(n, f, b, seed=1)
+    per = n // procs
+    master = Master(procs, timeout=60.0).serve_in_thread()
+    times = [None] * procs
+    errors = []
+
+    def worker():
+        try:
+            slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0)
+            r = slave.rank
+            lb = bins[r * per:(r + 1) * per]
+            ly = y[r * per:(r + 1) * per]
+            g = ly.copy()          # preds=0 -> g = -y up to sign; fine
+            h = np.ones_like(g)
+            node_ids = np.zeros(per, np.int32)
+            slave.barrier()
+            t0 = time.perf_counter()
+            lam = 1.0
+            for d in range(depth):
+                n_nodes = 2 ** d
+                hg, hh = _numpy_histograms(lb, g, h, node_ids, n_nodes, f, b)
+                flat = np.concatenate([hg.reshape(-1), hh.reshape(-1)])
+                slave.allreduce_array(flat, Operands.FLOAT, Operators.SUM)
+                hg = flat[:hg.size].reshape(n_nodes, f, b)
+                hh = flat[hg.size:].reshape(n_nodes, f, b)
+                # split finding + routing (numpy mirror of the TPU path)
+                cg, ch = np.cumsum(hg, -1), np.cumsum(hh, -1)
+                Gt, Ht = cg[..., -1:], ch[..., -1:]
+                gain = (cg ** 2 / (ch + lam)
+                        + (Gt - cg) ** 2 / (Ht - ch + lam)
+                        - Gt ** 2 / (Ht + lam))
+                gain[..., -1] = -np.inf
+                best = gain.reshape(n_nodes, -1).argmax(-1)
+                feat, bin_ = best // b, best % b
+                v = np.take_along_axis(lb, feat[node_ids][:, None],
+                                       axis=1)[:, 0]
+                node_ids = node_ids * 2 + (v > bin_[node_ids])
+            times[r] = time.perf_counter() - t0
+            slave.close(0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(procs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(300)
+    if errors:
+        raise errors[0]
+    if any(t is None for t in times):
+        raise RuntimeError(
+            "socket baseline worker hung past the join timeout")
+    dt = max(times)
+    # the socket job scanned n samples total across `procs` workers on
+    # one host: rate per "chip" = whole-job rate (one machine)
+    return scanned_bytes(n, f, depth) / dt / 1e9
+
+
+def main():
+    tpu_gbs, trees_per_sec, n_chips = bench_tpu()
+    sock_gbs = bench_socket()
+    print(json.dumps({
+        "metric": "gbdt-histogram-allreduce GB/s/chip",
+        "value": round(tpu_gbs, 3),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(tpu_gbs / sock_gbs, 2),
+        "extra": {
+            "trees_per_sec": round(trees_per_sec, 3),
+            "socket_baseline_gbs": round(sock_gbs, 3),
+            "n_chips": n_chips,
+            "config": "Higgs-like synthetic, F=28, B=256, depth=6, "
+                      "N_tpu=2e6, N_socket=2e5/4 procs",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from ytk_mp4j_tpu.models import gbdt
+
+__all__ = ["gbdt"]

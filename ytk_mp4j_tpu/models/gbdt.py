@@ -1,0 +1,243 @@
+"""TPU-native distributed GBDT — the north-star workload.
+
+ytk-mp4j's flagship consumer is ytk-learn's distributed GBDT, whose inner
+loop is a per-tree-level (node x feature x bin) gradient/hessian
+HISTOGRAM ALLREDUCE across data-parallel workers (BASELINE.json:
+"ytk-learn GBDT histogram allreduce — Higgs 11Mx28, 256 bins"). This
+module is that consumer rebuilt TPU-first so the collectives library can
+be measured end-to-end:
+
+- samples are sharded over the mesh (pure data parallelism, the only
+  parallelism the reference stack has — SURVEY.md section 2);
+- each device builds local histograms with a single XLA segment-sum over
+  ``node*F*B + f*B + bin`` flat ids (static shapes, no Python loops over
+  samples);
+- ``lax.psum`` over the mesh axis IS the histogram allreduce that the
+  reference performs with Kryo-socket recursive halving;
+- split finding (regularized gain over bin-cumulative G/H), node
+  routing, and leaf updates are all jit-compiled; the per-level loop is
+  unrolled (depth is static).
+
+Everything runs inside ONE jitted ``shard_map`` training step per tree —
+the histogram allreduce never leaves the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ytk_mp4j_tpu.parallel.mesh import make_mesh
+
+
+@dataclass(frozen=True)
+class GBDTConfig:
+    n_features: int = 28
+    n_bins: int = 256           # byte-binned, like ytk-learn's 256-bin hists
+    depth: int = 6
+    learning_rate: float = 0.1
+    reg_lambda: float = 1.0
+    n_trees: int = 10
+
+
+# ----------------------------------------------------------------------
+# histogram building (the hot op)
+# ----------------------------------------------------------------------
+def build_histograms(bins, g, h, node_ids, n_nodes: int, cfg: GBDTConfig):
+    """Per-(node, feature, bin) gradient/hessian sums.
+
+    bins: [N, F] int32 (values in [0, B)); g, h: [N] f32;
+    node_ids: [N] int32 in [0, n_nodes).
+    Returns (hist_g, hist_h): [n_nodes, F, B] f32.
+
+    One flat segment-sum of N*F contributions — XLA lowers this to a
+    sorted scatter-add; static output shape n_nodes*F*B.
+    """
+    F, B = cfg.n_features, cfg.n_bins
+    flat_ids = (node_ids[:, None] * (F * B)
+                + jnp.arange(F, dtype=jnp.int32)[None, :] * B
+                + bins)                                   # [N, F]
+    seg = flat_ids.reshape(-1)
+    gs = jnp.broadcast_to(g[:, None], bins.shape).reshape(-1)
+    hs = jnp.broadcast_to(h[:, None], bins.shape).reshape(-1)
+    hist_g = jax.ops.segment_sum(gs, seg, num_segments=n_nodes * F * B)
+    hist_h = jax.ops.segment_sum(hs, seg, num_segments=n_nodes * F * B)
+    return (hist_g.reshape(n_nodes, F, B), hist_h.reshape(n_nodes, F, B))
+
+
+def best_splits(hist_g, hist_h, reg_lambda: float):
+    """Regularized best split per node.
+
+    hist_*: [n_nodes, F, B]. Returns (feat [n_nodes], bin [n_nodes],
+    gain [n_nodes]) — the split "bin <= b goes left".
+    """
+    cg = jnp.cumsum(hist_g, axis=-1)        # G_left for split at bin b
+    ch = jnp.cumsum(hist_h, axis=-1)
+    Gt = cg[..., -1:]
+    Ht = ch[..., -1:]
+    lam = reg_lambda
+
+    def score(G, H):
+        return (G * G) / (H + lam)
+
+    gain = score(cg, ch) + score(Gt - cg, Ht - ch) - score(Gt, Ht)
+    # splitting at the last bin sends everything left — not a split
+    gain = gain.at[..., -1].set(-jnp.inf)
+    flat = gain.reshape(gain.shape[0], -1)
+    best = jnp.argmax(flat, axis=-1)
+    B = hist_g.shape[-1]
+    return ((best // B).astype(jnp.int32), (best % B).astype(jnp.int32),
+            jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0])
+
+
+# ----------------------------------------------------------------------
+# one boosting round (tree build) — per-shard body
+# ----------------------------------------------------------------------
+def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
+                     weights=None):
+    """Build one tree on this shard's samples; histogram-allreduce across
+    ``axis_name`` (None = single device). Returns (new_preds, tree).
+
+    ``weights`` ([N] f32, default all-ones) scales each sample's
+    gradient/hessian contribution — the driver uses weight 0 to neutralize
+    shard-padding rows so padded and unpadded runs are bit-equivalent.
+
+    tree = (feats [nodes], bins [nodes], leaf values [2^depth]) in
+    level-order heap layout (internal nodes 0..2^depth-2).
+    """
+    F, B = cfg.n_features, cfg.n_bins
+    # squared-error loss: g = w * (pred - y), h = w
+    g = preds - y
+    h = jnp.ones_like(g)
+    if weights is not None:
+        g = g * weights
+        h = h * weights
+    N = bins.shape[0]
+    node_ids = jnp.zeros((N,), dtype=jnp.int32)
+
+    n_internal = 2 ** cfg.depth - 1
+    tree_feat = jnp.zeros((n_internal,), dtype=jnp.int32)
+    tree_bin = jnp.zeros((n_internal,), dtype=jnp.int32)
+
+    level_start = 0
+    for d in range(cfg.depth):          # depth static -> unrolled
+        n_nodes = 2 ** d
+        hg, hh = build_histograms(bins, g, h, node_ids, n_nodes, cfg)
+        if axis_name is not None:
+            hg = lax.psum(hg, axis_name)     # THE histogram allreduce
+            hh = lax.psum(hh, axis_name)
+        feat, bin_, _gain = best_splits(hg, hh, cfg.reg_lambda)
+        tree_feat = lax.dynamic_update_slice(tree_feat, feat, (level_start,))
+        tree_bin = lax.dynamic_update_slice(tree_bin, bin_, (level_start,))
+        # route samples: go right if bin value > split bin
+        nf = feat[node_ids]                  # [N]
+        nb = bin_[node_ids]
+        v = jnp.take_along_axis(bins, nf[:, None], axis=1)[:, 0]
+        node_ids = node_ids * 2 + (v > nb).astype(jnp.int32)
+        level_start += n_nodes
+
+    # leaf values from (all-reduced) leaf G/H
+    n_leaves = 2 ** cfg.depth
+    leaf_g = jax.ops.segment_sum(g, node_ids, num_segments=n_leaves)
+    leaf_h = jax.ops.segment_sum(h, node_ids, num_segments=n_leaves)
+    if axis_name is not None:
+        leaf_g = lax.psum(leaf_g, axis_name)
+        leaf_h = lax.psum(leaf_h, axis_name)
+    leaf_val = -leaf_g / (leaf_h + cfg.reg_lambda)
+    preds = preds + cfg.learning_rate * leaf_val[node_ids]
+    return preds, (tree_feat, tree_bin, leaf_val)
+
+
+def predict_tree(bins, tree, cfg: GBDTConfig):
+    """Route samples through one tree (level-order heap layout)."""
+    tree_feat, tree_bin, leaf_val = tree
+    N = bins.shape[0]
+    node = jnp.zeros((N,), dtype=jnp.int32)   # level-local node index
+    level_start = 0
+    for d in range(cfg.depth):
+        nf = tree_feat[level_start + node]
+        nb = tree_bin[level_start + node]
+        v = jnp.take_along_axis(bins, nf[:, None], axis=1)[:, 0]
+        node = node * 2 + (v > nb).astype(jnp.int32)
+        level_start += 2 ** d
+    return leaf_val[node]
+
+
+# ----------------------------------------------------------------------
+# driver: full training under shard_map over a mesh
+# ----------------------------------------------------------------------
+class GBDTTrainer:
+    """Data-parallel GBDT over a mesh (1-D or hierarchical)."""
+
+    def __init__(self, cfg: GBDTConfig, mesh=None, n_devices=None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.axes = (self.mesh.axis_names[0]
+                     if len(self.mesh.axis_names) == 1
+                     else tuple(self.mesh.axis_names))
+        self._step = None
+
+    @property
+    def n_shards(self) -> int:
+        n = 1
+        for a in self.mesh.axis_names:
+            n *= self.mesh.shape[a]
+        return n
+
+    def _build_step(self):
+        cfg = self.cfg
+        axes = self.axes
+        spec = P(axes)
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(spec, spec, spec, spec),
+                 out_specs=(spec, P(None)))
+        def step(bins, y, preds, weights):
+            new_preds, tree = train_tree_shard(
+                bins[0], y[0], preds[0], cfg, axes, weights=weights[0])
+            return new_preds[None], tree
+
+        return jax.jit(step)
+
+    def shard_data(self, bins: np.ndarray, y: np.ndarray):
+        """Pad + reshape host data to [n_shards, N/shard, ...] and place
+        on the mesh. Padding rows get sample weight 0 so they contribute
+        nothing to histograms or leaves (distributed results stay
+        equivalent to single-device for any N)."""
+        n = self.n_shards
+        N = bins.shape[0]
+        per = -(-N // n)
+        pad = per * n - N
+        w = np.ones(N, np.float32)
+        if pad:
+            bins = np.concatenate([bins, np.zeros((pad,) + bins.shape[1:],
+                                                  bins.dtype)])
+            y = np.concatenate([y, np.zeros((pad,), y.dtype)])
+            w = np.concatenate([w, np.zeros(pad, np.float32)])
+        bins3 = bins.reshape(n, per, -1)
+        y2 = y.reshape(n, per)
+        w2 = w.reshape(n, per)
+        sh = NamedSharding(self.mesh, P(self.axes))
+        return (jax.device_put(bins3, sh), jax.device_put(y2, sh),
+                jax.device_put(np.zeros_like(y2), sh),
+                jax.device_put(w2, sh))
+
+    def train(self, bins: np.ndarray, y: np.ndarray,
+              n_trees: int | None = None):
+        """Full boosting run; returns (trees, final preds [padded])."""
+        if self._step is None:
+            self._step = self._build_step()
+        dbins, dy, dpreds, dw = self.shard_data(
+            np.asarray(bins, np.int32), np.asarray(y, np.float32))
+        trees = []
+        for _ in range(n_trees if n_trees is not None else self.cfg.n_trees):
+            dpreds, tree = self._step(dbins, dy, dpreds, dw)
+            trees.append(tree)
+        return trees, np.asarray(dpreds).reshape(-1)
