@@ -1,3 +1,8 @@
-from ytk_mp4j_tpu.models import gbdt
+"""Model families of the reference's flagship consumer (ytk-learn),
+rebuilt TPU-first as end-to-end workloads for the collectives library:
+GBDT (histogram allreduce), linear models (gradient allreduce), FM/FFM
+(sparse embedding-gradient allreduce)."""
 
-__all__ = ["gbdt"]
+from ytk_mp4j_tpu.models import fm, gbdt, linear
+
+__all__ = ["fm", "gbdt", "linear"]

@@ -1,0 +1,57 @@
+"""Shared data-parallel trainer plumbing for the model families.
+
+Every ytk-learn-style consumer here (GBDT, linear, FM/FFM) shards its
+samples over the mesh the same way: flat or hierarchical mesh axes, rows
+padded up to a multiple of the shard count, padding rows neutralized by a
+zero sample weight so distributed results match single-device runs for
+any N (SURVEY.md section 4's differential-testing requirement).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ytk_mp4j_tpu.parallel.mesh import make_mesh
+
+
+class DataParallelTrainer:
+    """Mesh bookkeeping + sample sharding shared by the trainers."""
+
+    def __init__(self, mesh=None, n_devices=None):
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.axes = (self.mesh.axis_names[0]
+                     if len(self.mesh.axis_names) == 1
+                     else tuple(self.mesh.axis_names))
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.size
+
+    def _row_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axes))
+
+    def _pad_rows(self, arrays: list[np.ndarray]):
+        """Pad dim 0 of each array to a multiple of ``n_shards``; returns
+        (padded arrays, per-shard rows, sample-weight vector with zeros on
+        the padding rows)."""
+        N = arrays[0].shape[0]
+        n = self.n_shards
+        per = -(-N // n)
+        pad = per * n - N
+        sw = np.ones(N, np.float32)
+        if pad:
+            arrays = [
+                np.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+                for a in arrays
+            ]
+            sw = np.pad(sw, (0, pad))
+        return arrays, per, sw
+
+    def _put_sharded(self, a: np.ndarray, per: int):
+        """Reshape [n*per, ...] -> [n, per, ...] and place on the mesh."""
+        return jax.device_put(
+            a.reshape((self.n_shards, per) + a.shape[1:]),
+            self._row_sharding())

@@ -1,0 +1,334 @@
+"""TPU-native distributed factorization machines (FM and field-aware FFM).
+
+ytk-mp4j's consumer ytk-learn ships FM and FFM model families whose
+training loop allreduces EMBEDDING GRADIENTS every step — and because a
+mini-batch touches only a sparse subset of the feature vocabulary, the
+reference ships them as a sparse ``Map<String, Float[]>`` over the Kryo
+socket path ("FFM gradient allreduce", BASELINE.json configs[4];
+SURVEY.md section 3c).
+
+TPU-first rebuild. Instances are padded to ``max_nnz`` static slots
+(feature id / field id / value / mask), the whole step is one jitted
+``shard_map`` program, and the gradient allreduce is:
+
+- **dense mode** (default): the full embedding-table gradient rides one
+  ``lax.psum`` — bandwidth ~|V| but maximally MXU/HBM friendly; right
+  whenever the vocabulary fits comfortably on-chip.
+- **sparse mode** (``sparse_grads=True``): per-slot gradient rows are
+  packed as static-shape ``(row_index, grad_row)`` buffers and merged
+  with :func:`ytk_mp4j_tpu.ops.sparse.sparse_allreduce` (all_gather +
+  sort + segment-sum — the device-native analogue of the reference's
+  key-wise map merge), then scattered back into the table. Bandwidth
+  ~nnz instead of ~|V|: the TPU translation of the reference's sparse
+  map path.
+
+Model scores (order-2, sigmoid/logloss for classification):
+
+- FM:  ``w0 + sum_i w_i x_i + sum_{a<b} <v_a, v_b> x_a x_b`` with the
+  O(K k) sum-of-squares identity.
+- FFM: ``v`` is per (feature, field): ``sum_{a<b} <v_{a, field_b},
+  v_{b, field_a}> x_a x_b`` over K^2 slot pairs (K = max_nnz, static).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.models._base import DataParallelTrainer
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.ops import sparse as sparse_ops
+
+MODELS = ("fm", "ffm")
+LOSSES = ("logistic", "squared")
+
+
+@dataclass(frozen=True)
+class FMConfig:
+    n_features: int                 # vocabulary size |V|
+    n_fields: int = 1               # >1 + model="ffm" => field-aware
+    k: int = 8                      # latent dimension
+    max_nnz: int = 16               # static non-zero slots per instance
+    model: str = "fm"
+    loss: str = "logistic"
+    learning_rate: float = 0.1
+    l2: float = 0.0                 # on embeddings + linear weights
+    init_scale: float = 0.01
+
+    def __post_init__(self):
+        if self.model not in MODELS:
+            raise Mp4jError(f"model must be one of {MODELS}")
+        if self.loss not in LOSSES:
+            raise Mp4jError(f"loss must be one of {LOSSES}")
+        if self.model == "ffm" and self.n_fields < 2:
+            raise Mp4jError("ffm needs n_fields >= 2")
+
+
+def _score(params, feats, fields, vals, mask, cfg: FMConfig):
+    """Model score for a batch of padded sparse instances.
+
+    feats/fields: [N, K] int32; vals/mask: [N, K] f32.
+    """
+    w0, w, V = params
+    xv = vals * mask                                   # zero padded slots
+    linear = jnp.sum(w[feats] * xv, axis=1)
+    if cfg.model == "fm":
+        # 0.5 * ((sum_a v_a x_a)^2 - sum_a (v_a x_a)^2), summed over k
+        E = V[feats]                                   # [N, K, k]
+        Ex = E * xv[..., None]
+        s = jnp.sum(Ex, axis=1)                        # [N, k]
+        inter = 0.5 * jnp.sum(s * s - jnp.sum(Ex * Ex, axis=1), axis=1)
+    else:
+        # FFM: E[a, b] = v_{feat_a, field_b}; z += <E[a,b], E[b,a]> x_a x_b
+        Vf = V.reshape(cfg.n_features, cfg.n_fields, cfg.k)
+        E = Vf[feats[:, :, None], fields[:, None, :]]  # [N, K, K, k]
+        pair = jnp.einsum("nabk,nbak->nab", E, E)
+        pair = pair * (xv[:, :, None] * xv[:, None, :])
+        K = feats.shape[1]
+        upper = jnp.triu(jnp.ones((K, K), pair.dtype), 1)
+        inter = jnp.sum(pair * upper, axis=(1, 2))
+    return w0 + linear + inter
+
+
+def _slot_rows(feats, fields, cfg: FMConfig):
+    """Embedding-table row index touched by each gradient slot.
+
+    FM touches row ``feat`` per slot ([N, K]); FFM touches row
+    ``feat * n_fields + field_b`` per slot PAIR ([N, K, K]) — matching
+    the [N, K(, K), k] slot-gradient layout of ``_score``'s gathers.
+    """
+    if cfg.model == "fm":
+        return feats
+    return feats[:, :, None] * cfg.n_fields + fields[:, None, :]
+
+
+def _mean_loss_grad(params, batch, cfg: FMConfig, axis_name):
+    """Global-mean loss + gradients; grads stay per-shard (cast varying
+    via ``lax.pcast``) so the cross-shard reduction is the explicit
+    collective chosen by the caller (dense psum or sparse allreduce) —
+    see models/linear.py."""
+    feats, fields, vals, mask, y, sw = batch
+    if axis_name is not None:
+        params = jax.tree_util.tree_map(
+            lambda p: lax.pcast(p, axis_name, to="varying"), params)
+
+    def shard_sum(p):
+        z = _score(p, feats, fields, vals, mask, cfg)
+        if cfg.loss == "logistic":
+            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        else:
+            per = 0.5 * (z - y) ** 2
+        return jnp.sum(per * sw)
+
+    sum_loss, grads = jax.value_and_grad(shard_sum)(params)
+    cnt = jnp.sum(sw)
+    if axis_name is not None:
+        sum_loss = lax.psum(sum_loss, axis_name)
+        cnt = lax.psum(cnt, axis_name)
+    denom = jnp.maximum(cnt, 1.0)
+    return sum_loss / denom, grads, denom
+
+
+def train_step_dense(params, batch, cfg: FMConfig, axis_name=None):
+    """One step; the embedding-gradient allreduce is a dense psum."""
+    loss, (g0, gw, gV), denom = _mean_loss_grad(params, batch, cfg, axis_name)
+    if axis_name is not None:
+        g0 = lax.psum(g0, axis_name)
+        gw = lax.psum(gw, axis_name)
+        gV = lax.psum(gV, axis_name)       # THE dense gradient allreduce
+    w0, w, V = params
+    lr = cfg.learning_rate
+    w0 = w0 - lr * (g0 / denom)
+    w = w - lr * (gw / denom + cfg.l2 * w)
+    V = V - lr * (gV / denom + cfg.l2 * V)
+    return (w0, w, V), loss
+
+
+def train_step_sparse(params, batch, cfg: FMConfig, capacity: int,
+                      axis_name="mp4j"):
+    """One step; embedding gradients ride the SPARSE path.
+
+    Instead of psum'ing the dense [rows, k] gradient table, each shard
+    packs its touched (row, grad_row) slots and the mesh merges them
+    with ``sparse_allreduce`` (bandwidth ~nnz, not ~|V|). ``capacity``
+    is the static bound on global unique touched rows per step.
+    """
+    feats, fields, vals, mask, y, sw = batch
+    loss, (g0, gw, gV), denom = _mean_loss_grad(params, batch, cfg, axis_name)
+    g0 = lax.psum(g0, axis_name)
+    gw = lax.psum(gw, axis_name)         # linear part stays dense (small)
+    w0, w, V = params
+    # gV is this shard's dense scatter-added table; pack each TOUCHED row
+    # once (dedupe the slot list: duplicate slots would re-contribute the
+    # same already-summed row), then COMPACT the unique rows into
+    # ``capacity`` slots before the collective so the all_gather moves
+    # ~unique-rows, not the raw (much longer, duplicate-heavy) slot list.
+    # Local unique rows never exceed the documented capacity contract
+    # (capacity must bound the GLOBAL unique count), so the slice is safe.
+    rows = _slot_rows(feats, fields, cfg).reshape(-1)           # [S]
+    sorted_rows = jnp.sort(rows)
+    first = jnp.concatenate([
+        jnp.ones((1,), bool), sorted_rows[1:] != sorted_rows[:-1]])
+    idx = jnp.where(first, sorted_rows, sparse_ops.SENTINEL).astype(jnp.int32)
+    compact = jnp.argsort(idx)[:capacity]    # unique rows first, asc
+    idx = idx[compact]
+    val = jnp.where((idx != sparse_ops.SENTINEL)[:, None],
+                    gV[jnp.where(idx == sparse_ops.SENTINEL, 0, idx)], 0.0)
+    oi, ov = sparse_ops.sparse_allreduce(
+        idx, val, capacity, Operators.SUM, axis_name)
+    gV_merged = sparse_ops.sparse_to_dense(oi, ov, gV.shape[0],
+                                           Operators.SUM)
+    lr = cfg.learning_rate
+    w0 = w0 - lr * (g0 / denom)
+    w = w - lr * (gw / denom + cfg.l2 * w)
+    V = V - lr * (gV_merged / denom + cfg.l2 * V)
+    return (w0, w, V), loss
+
+
+def predict(params, feats, fields, vals, mask, cfg: FMConfig):
+    z = _score(params, feats, fields, vals, mask, cfg)
+    if cfg.loss == "logistic":
+        return jax.nn.sigmoid(z)
+    return z
+
+
+class FMTrainer(DataParallelTrainer):
+    """Data-parallel FM/FFM over a mesh.
+
+    ``sparse_grads=True`` routes embedding gradients through the
+    device-native sparse allreduce (the FFM workload of
+    BASELINE.json configs[4]); default is the dense psum.
+    """
+
+    def __init__(self, cfg: FMConfig, mesh=None, n_devices=None,
+                 sparse_grads: bool = False, sparse_capacity: int | None = None):
+        super().__init__(mesh=mesh, n_devices=n_devices)
+        self.cfg = cfg
+        self.sparse_grads = sparse_grads
+        self.sparse_capacity = sparse_capacity
+        self._step = None
+        self._step_key = None
+
+    @property
+    def n_rows(self) -> int:
+        """Embedding-table rows: |V| for FM, |V| * n_fields for FFM."""
+        if self.cfg.model == "fm":
+            return self.cfg.n_features
+        return self.cfg.n_features * self.cfg.n_fields
+
+    def init_params(self, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        V = (self.cfg.init_scale
+             * rng.standard_normal((self.n_rows, self.cfg.k))).astype(
+                 np.float32)
+        return (jnp.zeros((), jnp.float32),
+                jnp.zeros((self.cfg.n_features,), jnp.float32),
+                jnp.asarray(V))
+
+    def _build_step(self, per_shard_slots: int):
+        cfg = self.cfg
+        axes = self.axes
+        dspec = P(axes)
+        if self.sparse_grads:
+            cap = self.sparse_capacity
+            if cap is None:
+                # global unique touched rows can't exceed total slots
+                # this step, nor the table size
+                bound = per_shard_slots * self.n_shards
+                if cfg.model == "ffm":
+                    bound *= cfg.max_nnz
+                cap = min(self.n_rows, bound)
+            step_fn = partial(train_step_sparse, cfg=cfg, capacity=cap,
+                              axis_name=axes)
+            # the sort/segment pipeline after all_gather defeats static
+            # replication inference — same waiver as the sparse path in
+            # comm.tpu_comm (correctness is covered by the dense-vs-
+            # sparse differential test)
+            check_vma = False
+        else:
+            step_fn = partial(train_step_dense, cfg=cfg, axis_name=axes)
+            check_vma = True
+
+        @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
+                 in_specs=(P(),) + (dspec,) * 6, out_specs=(P(), P()))
+        def step(params, feats, fields, vals, mask, y, sw):
+            batch = (feats[0], fields[0], vals[0], mask[0], y[0], sw[0])
+            return step_fn(params, batch)
+
+        return jax.jit(step)
+
+    def shard_data(self, feats, fields, vals, y):
+        """Pad + shard padded-sparse instances.
+
+        feats/fields: [N, K] int (K <= max_nnz; padded slots = any id
+        with value 0); vals: [N, K] float; y: [N].
+        """
+        feats = np.asarray(feats, np.int32)
+        fields = np.asarray(fields, np.int32)
+        vals = np.asarray(vals, np.float32)
+        y = np.asarray(y, np.float32)
+        if feats.ndim != 2 or feats.shape[1] > self.cfg.max_nnz:
+            raise Mp4jError(
+                f"feats must be [N, K<={self.cfg.max_nnz}], got {feats.shape}")
+        if (feats.min(initial=0) < 0
+                or feats.max(initial=0) >= self.cfg.n_features):
+            raise Mp4jError("feature id out of range")
+        if self.cfg.model == "ffm" and (
+                fields.min(initial=0) < 0
+                or fields.max(initial=0) >= self.cfg.n_fields):
+            raise Mp4jError("field id out of range")
+        N, K = feats.shape
+        padK = self.cfg.max_nnz - K
+        if padK:
+            zK = ((0, 0), (0, padK))
+            feats = np.pad(feats, zK)
+            fields = np.pad(fields, zK)
+            vals = np.pad(vals, zK)
+        mask = (vals != 0).astype(np.float32)
+        (feats, fields, vals, mask, y), per, sw = self._pad_rows(
+            [feats, fields, vals, mask, y])
+        put = lambda a: self._put_sharded(a, per)  # noqa: E731
+        return (put(feats), put(fields), put(vals), put(mask), put(y),
+                put(sw))
+
+    def fit(self, feats, fields, vals, y, n_steps: int = 100, params=None,
+            seed: int = 0):
+        """Full-batch training; returns (params, losses)."""
+        sharded = self.shard_data(feats, fields, vals, y)
+        # the jitted step bakes in the sparse capacity, which depends on
+        # the per-shard batch size — rebuild when that changes (a stale
+        # smaller capacity would silently drop gradient rows)
+        per_shard_slots = int(sharded[0].shape[1]) * self.cfg.max_nnz
+        if self._step is None or self._step_key != per_shard_slots:
+            self._step = self._build_step(per_shard_slots)
+            self._step_key = per_shard_slots
+        if params is None:
+            params = self.init_params(seed)
+        losses = []
+        for _ in range(n_steps):
+            params, loss = self._step(params, *sharded)
+            # bound in-flight programs; see models/linear.py fit()
+            losses.append(jax.block_until_ready(loss))
+        return params, np.asarray(jax.device_get(losses))
+
+    def predict(self, params, feats, fields, vals):
+        feats = jnp.asarray(np.asarray(feats, np.int32))
+        fields = jnp.asarray(np.asarray(fields, np.int32))
+        vals = jnp.asarray(np.asarray(vals, np.float32))
+        K = feats.shape[1]
+        if K < self.cfg.max_nnz:
+            padK = ((0, 0), (0, self.cfg.max_nnz - K))
+            feats = jnp.pad(feats, padK)
+            fields = jnp.pad(fields, padK)
+            vals = jnp.pad(vals, padK)
+        mask = (vals != 0).astype(jnp.float32)
+        return np.asarray(predict(params, feats, fields, vals, mask,
+                                  self.cfg))

@@ -32,9 +32,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from ytk_mp4j_tpu.parallel.mesh import make_mesh
+from ytk_mp4j_tpu.models._base import DataParallelTrainer
 
 
 @dataclass(frozen=True)
@@ -173,23 +173,13 @@ def predict_tree(bins, tree, cfg: GBDTConfig):
 # ----------------------------------------------------------------------
 # driver: full training under shard_map over a mesh
 # ----------------------------------------------------------------------
-class GBDTTrainer:
+class GBDTTrainer(DataParallelTrainer):
     """Data-parallel GBDT over a mesh (1-D or hierarchical)."""
 
     def __init__(self, cfg: GBDTConfig, mesh=None, n_devices=None):
+        super().__init__(mesh=mesh, n_devices=n_devices)
         self.cfg = cfg
-        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
-        self.axes = (self.mesh.axis_names[0]
-                     if len(self.mesh.axis_names) == 1
-                     else tuple(self.mesh.axis_names))
         self._step = None
-
-    @property
-    def n_shards(self) -> int:
-        n = 1
-        for a in self.mesh.axis_names:
-            n *= self.mesh.shape[a]
-        return n
 
     def _build_step(self):
         cfg = self.cfg
@@ -211,23 +201,10 @@ class GBDTTrainer:
         on the mesh. Padding rows get sample weight 0 so they contribute
         nothing to histograms or leaves (distributed results stay
         equivalent to single-device for any N)."""
-        n = self.n_shards
-        N = bins.shape[0]
-        per = -(-N // n)
-        pad = per * n - N
-        w = np.ones(N, np.float32)
-        if pad:
-            bins = np.concatenate([bins, np.zeros((pad,) + bins.shape[1:],
-                                                  bins.dtype)])
-            y = np.concatenate([y, np.zeros((pad,), y.dtype)])
-            w = np.concatenate([w, np.zeros(pad, np.float32)])
-        bins3 = bins.reshape(n, per, -1)
-        y2 = y.reshape(n, per)
-        w2 = w.reshape(n, per)
-        sh = NamedSharding(self.mesh, P(self.axes))
-        return (jax.device_put(bins3, sh), jax.device_put(y2, sh),
-                jax.device_put(np.zeros_like(y2), sh),
-                jax.device_put(w2, sh))
+        (bins, y), per, w = self._pad_rows([bins, y])
+        return (self._put_sharded(bins, per), self._put_sharded(y, per),
+                self._put_sharded(np.zeros_like(y), per),
+                self._put_sharded(w, per))
 
     def train(self, bins: np.ndarray, y: np.ndarray,
               n_trees: int | None = None):

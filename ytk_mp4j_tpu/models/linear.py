@@ -1,0 +1,188 @@
+"""TPU-native distributed linear models (linear & logistic regression).
+
+ytk-mp4j's consumer ytk-learn ships a "linear" model family trained by
+data-parallel gradient descent: each worker computes gradients on its
+shard and the gradient vector is ALLREDUCED every step (the same pattern
+as the GBDT histogram allreduce, SURVEY.md section 1 — gradient
+aggregation is the library's reason to exist).
+
+TPU-first rebuild: the whole optimization step — forward, loss, grad,
+``lax.psum`` over the mesh axis, optimizer update — is ONE jitted
+``shard_map`` program. The gradient allreduce that the reference performs
+with Kryo-socket recursive halving (SURVEY.md section 3b) is a single XLA
+ICI collective; parameters stay replicated, data stays sharded.
+
+Losses: ``squared`` (regression) and ``logistic`` (binary classification,
+labels in {0, 1}); L2 as a penalty gradient added before the momentum
+update (coupled, classic SGD-with-weight-penalty; the reported loss is
+the data term only), L1 via a proximal shrink after the step (so
+momentum still sees a smooth objective).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.models._base import DataParallelTrainer
+
+LOSSES = ("squared", "logistic")
+
+
+@dataclass(frozen=True)
+class LinearConfig:
+    n_features: int
+    loss: str = "squared"
+    learning_rate: float = 0.1
+    l1: float = 0.0
+    l2: float = 0.0
+    momentum: float = 0.0
+
+    def __post_init__(self):
+        if self.loss not in LOSSES:
+            raise Mp4jError(f"loss must be one of {LOSSES}, got {self.loss!r}")
+
+
+def _mean_loss_grad(params, x, y, sample_w, cfg: LinearConfig, axis_name):
+    """Global-mean gradient of the (unregularized) loss.
+
+    The psum'd (sum_grad, sum_weight) pair turns per-shard sums into the
+    exact global mean — weighting also neutralizes padding rows (weight
+    0), so sharded and single-device runs match bitwise up to reduction
+    order.
+
+    Params arrive replicated (``P()``); they are cast device-varying
+    with ``lax.pcast`` before differentiation so the gradient stays a
+    PER-SHARD quantity and the cross-shard sum is the EXPLICIT ``psum``
+    below. (Without this, shard_map's varying-axis autodiff inserts the
+    psum itself — the transpose of replication — and an explicit psum on
+    top would multiply gradients by the shard count.)
+    """
+    w, b = params
+    if axis_name is not None:
+        w = lax.pcast(w, axis_name, to="varying")
+        b = lax.pcast(b, axis_name, to="varying")
+
+    def shard_sums(w, b):
+        z = x @ w + b
+        if cfg.loss == "logistic":
+            # mean softplus-style logloss on {0,1} labels
+            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        else:
+            per = 0.5 * (z - y) ** 2
+        return jnp.sum(per * sample_w)
+
+    sum_loss, grads = jax.value_and_grad(
+        lambda p: shard_sums(*p))((w, b))
+    cnt = jnp.sum(sample_w)
+    if axis_name is not None:
+        sum_loss = lax.psum(sum_loss, axis_name)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name), grads)  # THE gradient allreduce
+        cnt = lax.psum(cnt, axis_name)
+    denom = jnp.maximum(cnt, 1.0)
+    mean_grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+    return sum_loss / denom, mean_grads
+
+
+def train_step_shard(params, vel, x, y, sample_w, cfg: LinearConfig,
+                     axis_name=None):
+    """One optimization step on this shard. Returns (params, vel, loss)."""
+    loss, (gw, gb) = _mean_loss_grad(params, x, y, sample_w, cfg, axis_name)
+    w, b = params
+    gw = gw + cfg.l2 * w                      # L2 penalty (not on bias)
+    vw, vb = vel
+    vw = cfg.momentum * vw + gw
+    vb = cfg.momentum * vb + gb
+    w = w - cfg.learning_rate * vw
+    b = b - cfg.learning_rate * vb
+    if cfg.l1 > 0.0:
+        # proximal shrink keeps the objective smooth for momentum
+        shrink = cfg.learning_rate * cfg.l1
+        w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - shrink, 0.0)
+    return (w, b), (vw, vb), loss
+
+
+def predict(params, x, cfg: LinearConfig):
+    w, b = params
+    z = x @ w + b
+    if cfg.loss == "logistic":
+        return jax.nn.sigmoid(z)
+    return z
+
+
+class LinearTrainer(DataParallelTrainer):
+    """Data-parallel linear/logistic regression over a mesh.
+
+    The per-step program is one jitted ``shard_map``: data sharded over
+    the mesh axis (or axes, for a hierarchical inter x intra mesh),
+    parameters and optimizer state replicated, gradients psum'd.
+    """
+
+    def __init__(self, cfg: LinearConfig, mesh=None, n_devices=None):
+        super().__init__(mesh=mesh, n_devices=n_devices)
+        self.cfg = cfg
+        self._step = None
+
+    def init_params(self):
+        return (jnp.zeros((self.cfg.n_features,), jnp.float32),
+                jnp.zeros((), jnp.float32))
+
+    def _build_step(self):
+        cfg = self.cfg
+        axes = self.axes
+        dspec = P(axes)
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(P(), P(), dspec, dspec, dspec),
+                 out_specs=(P(), P(), P()))
+        def step(params, vel, x, y, sw):
+            return train_step_shard(params, vel, x[0], y[0], sw[0], cfg, axes)
+
+        return jax.jit(step)
+
+    def shard_data(self, x: np.ndarray, y: np.ndarray):
+        """Pad + reshape to [n_shards, N/shard, ...]; padding rows carry
+        sample weight 0 so results match unsharded runs for any N."""
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.float32)
+        if x.ndim != 2 or x.shape[1] != self.cfg.n_features:
+            raise Mp4jError(
+                f"x must be [N, {self.cfg.n_features}], got {x.shape}")
+        (x, y), per, sw = self._pad_rows([x, y])
+        return (self._put_sharded(x, per), self._put_sharded(y, per),
+                self._put_sharded(sw, per))
+
+    def fit(self, x: np.ndarray, y: np.ndarray, n_steps: int = 100,
+            params=None):
+        """Run ``n_steps`` full-batch steps; returns (params, losses)."""
+        if self._step is None:
+            self._step = self._build_step()
+        dx, dy, dsw = self.shard_data(x, y)
+        if params is None:
+            params = self.init_params()
+        vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+        losses = []
+        for _ in range(n_steps):
+            params, vel, loss = self._step(params, vel, dx, dy, dsw)
+            # Synchronize each step: on hosts with fewer cores than mesh
+            # devices, letting hundreds of small multi-collective programs
+            # queue up can starve XLA's CPU collective rendezvous (its
+            # device threads block 40s then abort). One program in flight
+            # at a time costs nothing here (steps are data-dependent
+            # anyway) and keeps the thread demand bounded.
+            loss = jax.block_until_ready(loss)
+            losses.append(loss)
+        return params, np.asarray(jax.device_get(losses))
+
+    def predict(self, params, x: np.ndarray) -> np.ndarray:
+        x = jnp.asarray(np.asarray(x, np.float32))
+        return np.asarray(predict(params, x, self.cfg))
