@@ -25,37 +25,61 @@ def make_all(n, length, operand, seed=7):
     return make_inputs(n, length, operand, np.random.default_rng(seed))
 
 
+@pytest.mark.parametrize("algo", ["rhd", "ring"])
 @pytest.mark.parametrize("n", [2, 3, 4, 5])
 @pytest.mark.parametrize("op", ["SUM", "MAX"])
-def test_allreduce_ring(n, op):
+def test_allreduce_algos(n, op, algo):
+    """Both allreduce algorithms (recursive halving/doubling — the
+    reference's path — and ring) against the numpy oracle, including
+    non-power-of-2 rank counts (pre/post fold)."""
     operand = Operands.DOUBLE
     alls = make_all(n, 41, operand)
     want = expected_reduce(alls, op)
 
     def fn(slave, r):
         arr = alls[r].copy()
-        slave.allreduce_array(arr, operand, Operators.by_name(op))
+        slave.allreduce_array(arr, operand, Operators.by_name(op),
+                              algo=algo)
         return arr
 
     for got in run_slaves(n, fn):
         np.testing.assert_allclose(got, want)
 
 
-def test_allreduce_subrange_int():
-    n = 4
+@pytest.mark.parametrize("algo", ["rhd", "ring"])
+@pytest.mark.parametrize("n", [4, 7])
+def test_allreduce_subrange_int(n, algo):
     operand = Operands.INT
     alls = make_all(n, 20, operand)
     want = expected_reduce(alls, "SUM")
 
     def fn(slave, r):
         arr = alls[r].copy()
-        slave.allreduce_array(arr, operand, Operators.SUM, from_=5, to=15)
+        slave.allreduce_array(arr, operand, Operators.SUM, from_=5, to=15,
+                              algo=algo)
         return arr
 
     for r, got in enumerate(run_slaves(n, fn)):
         np.testing.assert_array_equal(got[5:15], want[5:15])
         np.testing.assert_array_equal(got[:5], alls[r][:5])
         np.testing.assert_array_equal(got[15:], alls[r][15:])
+
+
+def test_allreduce_rhd_short_array():
+    """Range shorter than the participant count: empty halving segments
+    must be exchanged without corruption."""
+    n = 5
+    operand = Operands.DOUBLE
+    alls = make_all(n, 3, operand)
+    want = expected_reduce(alls, "SUM")
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.allreduce_array(arr, operand, Operators.SUM, algo="rhd")
+        return arr
+
+    for got in run_slaves(n, fn):
+        np.testing.assert_allclose(got, want)
 
 
 @pytest.mark.parametrize("n", [3, 4])

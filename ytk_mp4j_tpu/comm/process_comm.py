@@ -8,13 +8,14 @@ with in-place buffer semantics. ``info()/error()`` forward to the
 master's console; ``barrier()``/``close(code)`` coordinate through the
 master (SURVEY.md section 3e).
 
-Algorithms: bandwidth-optimal ring reduce-scatter / ring allgather (and
-their composition for allreduce), binomial trees for broadcast/reduce,
-direct sends for rooted gather/scatter. The reference uses MPICH-style
-recursive halving/doubling (BASELINE.json); rings are chosen here because
-they handle any rank count and uneven segments uniformly (no
-power-of-2 fold) at the same asymptotic bandwidth — semantics are
-identical, which is what the differential suite checks.
+Algorithms: allreduce defaults to the reference's MPICH-style
+Rabenseifner path — reduce-scatter by RECURSIVE HALVING + allgather by
+RECURSIVE DOUBLING, with non-power-of-2 rank counts folded in by a
+pre/post step (the "Kryo-socket recursive-halving path" of
+BASELINE.json; SURVEY.md section 3b) — with ring reduce-scatter /
+ring allgather available via ``algo="ring"`` (same asymptotic
+bandwidth, uniform for any rank count). Broadcast/reduce are binomial
+trees; rooted gather/scatter are direct sends.
 
 The per-round element-wise merge (the reference's CPU hot loop, SURVEY.md
 section 3b step 2) runs through the native C++ kernel
@@ -218,15 +219,24 @@ class ProcessCommSlave(CommSlave):
     # ------------------------------------------------------------------
     def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
                         operator: Operator = Operators.SUM,
-                        from_: int = 0, to: int | None = None):
-        """Ring reduce-scatter + ring allgather over ``arr[from_:to]``.
+                        from_: int = 0, to: int | None = None,
+                        algo: str = "rhd"):
+        """Allreduce over ``arr[from_:to]``, in place on every rank.
+
+        ``algo="rhd"`` (default, the reference's path): reduce-scatter by
+        recursive halving + allgather by recursive doubling over the
+        largest power-of-2 rank group, extra ranks folded in by a
+        pre/post exchange. ``algo="ring"``: ring reduce-scatter + ring
+        allgather.
 
         Non-numeric (STRING/OBJECT list) operands take the rank-ordered
-        binomial tree instead: ring merge order is rotated per chunk,
+        binomial tree instead: halving/ring merge order varies per chunk,
         which is only equivalent for commutative operators; list
         reductions (e.g. concatenation) deserve deterministic rank order
         and are latency- not bandwidth-bound anyway.
         """
+        if algo not in ("rhd", "ring"):
+            raise Mp4jError(f"unknown allreduce algo {algo!r}")
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
             return arr
@@ -235,9 +245,83 @@ class ProcessCommSlave(CommSlave):
                               from_=from_, to=to)
             return self.broadcast_array(arr, operand, root=0,
                                         from_=from_, to=to)
+        if algo == "rhd":
+            return self._rhd_allreduce(arr, operand, operator, lo, hi)
         segs = meta.partition_range(lo, hi, self._n)
         self._ring_reduce_scatter(arr, segs, operand, operator)
         self._ring_allgather(arr, segs)
+        return arr
+
+    # -- recursive halving/doubling (Rabenseifner), SURVEY.md 3b --------
+    def _rhd_allreduce(self, arr, operand, operator, lo, hi):
+        """MPICH-style allreduce: fold extra ranks into the largest
+        power-of-2 group, reduce-scatter by recursive halving, allgather
+        by recursive doubling, unfold.
+
+        Round structure (p = 2^floor(log2 n) participants):
+        - fold: ranks >= p ship their whole range to ``rank - p``, which
+          merges it; folded ranks then idle until unfold.
+        - halving: log2(p) exchanges; each round partner = vr ^ dist with
+          dist halving from p/2, exchanging half of the active segment
+          window and merging the received half (native hot loop).
+        - doubling: the mirror image; window doubles until every
+          participant holds the full reduced range.
+        - unfold: participants send the finished range back to their
+          folded partner.
+        """
+        n, r = self._n, self._rank
+        p = 1
+        while p * 2 <= n:
+            p *= 2
+        extra = n - p
+
+        if r >= p:  # folded rank: contribute, then wait for the result
+            self._send(r - p, np.ascontiguousarray(arr[lo:hi]))
+            arr[lo:hi] = self._recv(r - p)
+            return arr
+        if r < extra:  # fold partner: merge the extra rank's data
+            recv = self._recv(r + p)
+            native.reduce_into(operator, arr[lo:hi], np.asarray(recv))
+
+        vr = r
+        segs = meta.partition_range(lo, hi, p)
+
+        def span(a, b):  # byte range of segment window [a, b)
+            return segs[a][0], segs[b - 1][1]
+
+        # reduce-scatter: recursive halving
+        dist = p >> 1
+        while dist >= 1:
+            partner = vr ^ dist
+            block0 = (vr // (2 * dist)) * (2 * dist)
+            if vr & dist:
+                keep = (block0 + dist, block0 + 2 * dist)
+                give = (block0, block0 + dist)
+            else:
+                keep = (block0, block0 + dist)
+                give = (block0 + dist, block0 + 2 * dist)
+            gs, ge = span(*give)
+            ks, ke = span(*keep)
+            recv = self._sendrecv(partner, partner,
+                                  np.ascontiguousarray(arr[gs:ge]))
+            native.reduce_into(operator, arr[ks:ke], np.asarray(recv))
+            dist >>= 1
+
+        # allgather: recursive doubling
+        dist = 1
+        while dist < p:
+            partner = vr ^ dist
+            mb0 = (vr // dist) * dist
+            tb0 = (partner // dist) * dist
+            ms, me = span(mb0, mb0 + dist)
+            ts, te = span(tb0, tb0 + dist)
+            recv = self._sendrecv(partner, partner,
+                                  np.ascontiguousarray(arr[ms:me]))
+            arr[ts:te] = recv
+            dist *= 2
+
+        if r < extra:  # unfold: ship the finished range back
+            self._send(r + p, np.ascontiguousarray(arr[lo:hi]))
         return arr
 
     def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,

@@ -12,9 +12,22 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ytk_mp4j_tpu.parallel.mesh import make_mesh
+
+
+def per_example_loss(z, y, loss: str):
+    """Per-example data loss shared by the linear and FM/FFM families.
+
+    ``logistic``: softplus-form logloss on {0, 1} labels, written as
+    ``max(z, 0) - z y + log1p(exp(-|z|))`` for overflow-free evaluation
+    at large |z|. ``squared``: 0.5 (z - y)^2.
+    """
+    if loss == "logistic":
+        return jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return 0.5 * (z - y) ** 2
 
 
 class DataParallelTrainer:

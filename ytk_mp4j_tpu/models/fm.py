@@ -43,7 +43,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
-from ytk_mp4j_tpu.models._base import DataParallelTrainer
+from ytk_mp4j_tpu.models._base import DataParallelTrainer, per_example_loss
 from ytk_mp4j_tpu.operators import Operators
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 
@@ -122,11 +122,7 @@ def _mean_loss_grad(params, batch, cfg: FMConfig, axis_name):
 
     def shard_sum(p):
         z = _score(p, feats, fields, vals, mask, cfg)
-        if cfg.loss == "logistic":
-            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        else:
-            per = 0.5 * (z - y) ** 2
-        return jnp.sum(per * sw)
+        return jnp.sum(per_example_loss(z, y, cfg.loss) * sw)
 
     sum_loss, grads = jax.value_and_grad(shard_sum)(params)
     cnt = jnp.sum(sw)
@@ -265,16 +261,10 @@ class FMTrainer(DataParallelTrainer):
 
         return jax.jit(step)
 
-    def shard_data(self, feats, fields, vals, y):
-        """Pad + shard padded-sparse instances.
-
-        feats/fields: [N, K] int (K <= max_nnz; padded slots = any id
-        with value 0); vals: [N, K] float; y: [N].
-        """
-        feats = np.asarray(feats, np.int32)
-        fields = np.asarray(fields, np.int32)
-        vals = np.asarray(vals, np.float32)
-        y = np.asarray(y, np.float32)
+    def _check_instances(self, feats: np.ndarray, fields: np.ndarray):
+        """Shared id-range validation for fit and predict inputs (JAX
+        gathers clamp out-of-range indices silently, so bad ids must be
+        rejected on the host)."""
         if feats.ndim != 2 or feats.shape[1] > self.cfg.max_nnz:
             raise Mp4jError(
                 f"feats must be [N, K<={self.cfg.max_nnz}], got {feats.shape}")
@@ -285,6 +275,18 @@ class FMTrainer(DataParallelTrainer):
                 fields.min(initial=0) < 0
                 or fields.max(initial=0) >= self.cfg.n_fields):
             raise Mp4jError("field id out of range")
+
+    def shard_data(self, feats, fields, vals, y):
+        """Pad + shard padded-sparse instances.
+
+        feats/fields: [N, K] int (K <= max_nnz; padded slots = any id
+        with value 0); vals: [N, K] float; y: [N].
+        """
+        feats = np.asarray(feats, np.int32)
+        fields = np.asarray(fields, np.int32)
+        vals = np.asarray(vals, np.float32)
+        y = np.asarray(y, np.float32)
+        self._check_instances(feats, fields)
         N, K = feats.shape
         padK = self.cfg.max_nnz - K
         if padK:
@@ -320,8 +322,11 @@ class FMTrainer(DataParallelTrainer):
         return params, np.asarray(jax.device_get(losses))
 
     def predict(self, params, feats, fields, vals):
-        feats = jnp.asarray(np.asarray(feats, np.int32))
-        fields = jnp.asarray(np.asarray(fields, np.int32))
+        feats = np.asarray(feats, np.int32)
+        fields = np.asarray(fields, np.int32)
+        self._check_instances(feats, fields)
+        feats = jnp.asarray(feats)
+        fields = jnp.asarray(fields)
         vals = jnp.asarray(np.asarray(vals, np.float32))
         K = feats.shape[1]
         if K < self.cfg.max_nnz:

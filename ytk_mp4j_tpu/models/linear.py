@@ -32,7 +32,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
-from ytk_mp4j_tpu.models._base import DataParallelTrainer
+from ytk_mp4j_tpu.models._base import DataParallelTrainer, per_example_loss
 
 LOSSES = ("squared", "logistic")
 
@@ -73,12 +73,7 @@ def _mean_loss_grad(params, x, y, sample_w, cfg: LinearConfig, axis_name):
 
     def shard_sums(w, b):
         z = x @ w + b
-        if cfg.loss == "logistic":
-            # mean softplus-style logloss on {0,1} labels
-            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        else:
-            per = 0.5 * (z - y) ** 2
-        return jnp.sum(per * sample_w)
+        return jnp.sum(per_example_loss(z, y, cfg.loss) * sample_w)
 
     sum_loss, grads = jax.value_and_grad(
         lambda p: shard_sums(*p))((w, b))
