@@ -1,0 +1,39 @@
+"""The checkthread distributed check program (reference check-suite
+shape, thread family): standalone thread group in-process, plus a true
+hybrid run — 1 master + 2 slave processes x 2 threads over loopback."""
+
+import subprocess
+import sys
+
+import pytest
+
+from ytk_mp4j_tpu.check import checkthread
+from ytk_mp4j_tpu.comm.master import Master
+
+
+def test_checkthread_standalone():
+    """Pure-thread job (no master): the whole battery in-process."""
+    assert checkthread.main(["--threads", "3", "--length", "40"]) == 0
+
+
+def test_checkthread_single_thread():
+    assert checkthread.main(["--threads", "1", "--length", "17"]) == 0
+
+
+@pytest.mark.slow
+def test_checkthread_hybrid_subprocess():
+    master = Master(2, timeout=60.0).serve_in_thread()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "ytk_mp4j_tpu.check.checkthread",
+             "--master", f"127.0.0.1:{master.port}", "--threads", "2",
+             "--length", "53"],
+            cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for _ in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"checkthread failed:\n{out}\n{err}"
+    master.join(10)
+    assert master.final_code == 0

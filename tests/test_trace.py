@@ -1,0 +1,90 @@
+"""Per-collective tracing subsystem (SURVEY.md section 5: the tracing
+aux subsystem the reference lacks)."""
+
+import numpy as np
+
+from ytk_mp4j_tpu import trace_collectives
+from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.utils import trace
+
+from helpers import run_slaves
+
+
+def test_disabled_records_nothing():
+    trace.clear()
+    cluster = TpuCommCluster(2)
+    arrs = [np.ones(8, np.float32) for _ in range(2)]
+    cluster.allreduce_array(arrs, Operands.FLOAT, Operators.SUM)
+    assert trace.events() == []
+
+
+def test_device_path_traced():
+    cluster = TpuCommCluster(2)
+    arrs = [np.ones(1024, np.float32) for _ in range(2)]
+    with trace_collectives():
+        cluster.allreduce_array(arrs, Operands.FLOAT, Operators.SUM)
+        cluster.broadcast_array(arrs, Operands.FLOAT, root=0)
+    ev = trace.events()
+    names = [e[0] for e in ev]
+    assert "TpuCommCluster.allreduce_array" in names
+    assert "TpuCommCluster.broadcast_array" in names
+    for name, sec, nb in ev:
+        assert sec > 0
+    # first data arg is the per-rank array list: 2 ranks x 4 KiB
+    ar = dict((e[0], e) for e in ev)["TpuCommCluster.allreduce_array"]
+    assert ar[2] == 2 * 1024 * 4
+    # tracing stops outside the scope
+    cluster.allreduce_array(arrs, Operands.FLOAT, Operators.SUM)
+    assert len(trace.events()) == len(ev)
+
+
+def test_socket_path_traced_and_summary():
+    with trace_collectives():
+        def fn(slave, r):
+            arr = np.full(256, float(r))
+            slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+            slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+            return arr
+
+        run_slaves(2, fn)
+    agg = trace.summary()
+    a = agg["ProcessCommSlave.allreduce_array"]
+    assert a["calls"] == 4  # 2 ranks x 2 calls
+    assert a["bytes"] == 4 * 256 * 8
+    assert a["gb_per_s"] > 0
+    text = trace.format_summary()
+    assert "ProcessCommSlave.allreduce_array" in text
+
+
+def test_thread_path_traced():
+    slaves = ThreadCommSlave.spawn_group(2)
+    import threading
+
+    with trace_collectives():
+        def worker(sl):
+            d = {"a": 1.0}
+            sl.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+        ts = [threading.Thread(target=worker, args=(s,)) for s in slaves]
+        [t.start() for t in ts]
+        [t.join(30) for t in ts]
+    names = [e[0] for e in trace.events()]
+    assert names.count("ThreadCommSlave.allreduce_map") == 2
+
+
+def test_nested_scopes():
+    trace.clear()
+    cluster = TpuCommCluster(2)
+    arrs = [np.ones(8, np.float32) for _ in range(2)]
+    with trace_collectives():
+        with trace_collectives(clear=False):
+            cluster.barrier()
+        cluster.barrier()  # outer scope still active
+    assert len([e for e in trace.events()
+                if e[0] == "TpuCommCluster.barrier"]) == 2
+    cluster.barrier()
+    assert len([e for e in trace.events()
+                if e[0] == "TpuCommCluster.barrier"]) == 2

@@ -27,6 +27,8 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.utils import trace
+from ytk_mp4j_tpu.utils.trace import trace_collectives
 
 __version__ = "0.1.0"
 
@@ -37,4 +39,6 @@ __all__ = [
     "Operand",
     "Operands",
     "meta",
+    "trace",
+    "trace_collectives",
 ]

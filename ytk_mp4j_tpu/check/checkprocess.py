@@ -22,30 +22,16 @@ import traceback
 import numpy as np
 
 from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.check._oracle import expected_reduce, rank_data
 from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
 from ytk_mp4j_tpu.operands import Operands
 from ytk_mp4j_tpu.operators import Operators
 
-NP_REF = {"SUM": np.add, "PROD": np.multiply, "MAX": np.maximum,
-          "MIN": np.minimum}
-
-
-def rank_data(rank: int, n: int, length: int, operand) -> np.ndarray:
-    rng = np.random.default_rng(1000 + rank)
-    if operand.dtype.kind == "f":
-        return rng.standard_normal(length).astype(operand.dtype)
-    return rng.integers(1, 4, length).astype(operand.dtype)
+SEED_BASE = 1000
 
 
 def all_rank_data(n, length, operand):
-    return [rank_data(r, n, length, operand) for r in range(n)]
-
-
-def expected_reduce(arrs, op_name):
-    out = arrs[0].copy()
-    for a in arrs[1:]:
-        out = NP_REF[op_name](out, a)
-    return out
+    return [rank_data(r, length, operand, SEED_BASE) for r in range(n)]
 
 
 def check(slave: ProcessCommSlave, length: int = 257) -> int:

@@ -41,7 +41,7 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.transport.channel import Channel, connect
-from ytk_mp4j_tpu.utils import native
+from ytk_mp4j_tpu.utils import native, trace
 
 
 class ProcessCommSlave(CommSlave):
@@ -639,3 +639,7 @@ class ProcessCommSlave(CommSlave):
     def _check_root(self, root: int):
         if not (0 <= root < self._n):
             raise Mp4jError(f"root {root} out of range [0, {self._n})")
+
+
+# per-collective tracing (utils.trace; zero overhead when disabled)
+trace.instrument(ProcessCommSlave)

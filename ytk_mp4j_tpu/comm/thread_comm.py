@@ -33,7 +33,7 @@ from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
-from ytk_mp4j_tpu.utils import native
+from ytk_mp4j_tpu.utils import native, trace
 
 
 class _ThreadGroup:
@@ -585,3 +585,7 @@ class ThreadCommSlave(CommSlave):
         only receives its threads' share)."""
         self.reduce_map(d, operand, operator, root=0)
         return self.scatter_map(d, operand, root=0)
+
+
+# per-collective tracing (utils.trace; zero overhead when disabled)
+trace.instrument(ThreadCommSlave)

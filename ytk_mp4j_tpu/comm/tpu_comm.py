@@ -42,6 +42,7 @@ from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.ops import collectives as coll
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.parallel.mesh import make_mesh, DEFAULT_AXIS
+from ytk_mp4j_tpu.utils import trace
 
 
 def _x64_enabled() -> bool:
@@ -534,3 +535,7 @@ class TpuCommCluster:
         tok = jax.device_put(np.zeros((self.n, 1), np.int32),
                              self._row_sharding)
         np.asarray(fn(tok))
+
+
+# per-collective tracing (utils.trace; zero overhead when disabled)
+trace.instrument(TpuCommCluster)

@@ -1,0 +1,183 @@
+"""Lightweight per-collective tracing.
+
+The reference has no built-in profiling (SURVEY.md section 5: "at most
+log-line timing in check programs"); this subsystem is the cheap win
+named there. Zero overhead when disabled (one module-global check per
+collective call); when enabled inside :class:`trace_collectives`, every
+backend collective (socket, thread, device) records a
+``(name, seconds, nbytes)`` event, and :func:`summary` aggregates
+count / time / bytes / effective GB/s per collective.
+
+Optionally forwards to the JAX profiler: pass ``profile_dir`` to wrap
+the traced region in ``jax.profiler.start_trace`` so device-path
+collectives appear on the XLA timeline (TensorBoard-loadable).
+
+Usage::
+
+    from ytk_mp4j_tpu.utils import trace
+
+    with trace.trace_collectives():
+        cluster.allreduce_array(arrs, Operands.FLOAT, Operators.SUM)
+    print(trace.summary())
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+_lock = threading.Lock()
+_enabled = False
+_events: list[tuple[str, float, int]] = []
+
+
+def _payload_bytes(x: Any) -> int:
+    """Best-effort payload size of a collective operand."""
+    if isinstance(x, np.ndarray):
+        return x.nbytes
+    if hasattr(x, "nbytes"):  # jax arrays
+        try:
+            return int(x.nbytes)
+        except Exception:
+            return 0
+    if isinstance(x, dict):
+        return sum(_payload_bytes(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return sum(_payload_bytes(v) for v in x)
+    if isinstance(x, (bytes, str)):
+        return len(x)
+    if isinstance(x, (int, float, np.generic)):
+        return 8
+    return 0
+
+
+def record(name: str, seconds: float, nbytes: int) -> None:
+    if _enabled:
+        with _lock:
+            _events.append((name, seconds, nbytes))
+
+
+# Canonical collective-method list shared by every backend; instrument()
+# skips names a backend doesn't define (e.g. the in-jit functional layer
+# has no maps), so one list serves all without drift.
+COLLECTIVE_METHODS = (
+    "allreduce_array", "reduce_array", "broadcast_array",
+    "allgather_array", "gather_array", "scatter_array",
+    "reduce_scatter_array", "allreduce_map", "reduce_map",
+    "broadcast_map", "gather_map", "allgather_map", "scatter_map",
+    "reduce_scatter_map", "barrier", "thread_barrier",
+)
+
+
+def instrument(cls, methods=COLLECTIVE_METHODS):
+    """Wrap each of ``cls``'s collective methods with :func:`traced`
+    (names the class doesn't define are skipped)."""
+    for name in methods:
+        fn = cls.__dict__.get(name)
+        if fn is not None and callable(fn):
+            setattr(cls, name, traced(fn))
+    return cls
+
+
+def traced(fn):
+    """Wrap a collective method: when tracing is enabled, time the call
+    and record the payload size of its first data argument."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if not _enabled:
+            return fn(self, *args, **kwargs)
+        nbytes = _payload_bytes(args[0]) if args else 0
+        t0 = time.perf_counter()
+        out = fn(self, *args, **kwargs)
+        record(f"{type(self).__name__}.{fn.__name__}",
+               time.perf_counter() - t0, nbytes)
+        return out
+
+    return wrapper
+
+
+class trace_collectives:
+    """Context manager enabling collective tracing (optionally plus the
+    JAX profiler when ``profile_dir`` is given). Re-entrant: nested
+    scopes keep tracing enabled until the outermost exits."""
+
+    _depth = 0
+
+    def __init__(self, profile_dir: str | None = None, clear: bool = True):
+        self.profile_dir = profile_dir
+        self.clear = clear
+
+    def __enter__(self):
+        global _enabled
+        # start the profiler BEFORE flipping global state: __exit__ never
+        # runs when __enter__ raises, so state must only change once
+        # nothing else can fail
+        if self.profile_dir is not None:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+        with _lock:
+            if trace_collectives._depth == 0 and self.clear:
+                _events.clear()
+            trace_collectives._depth += 1
+            _enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        global _enabled
+        if self.profile_dir is not None:
+            import jax
+
+            jax.profiler.stop_trace()
+        with _lock:
+            trace_collectives._depth -= 1
+            if trace_collectives._depth == 0:
+                _enabled = False
+        return False
+
+
+def events() -> list[tuple[str, float, int]]:
+    """Raw ``(name, seconds, nbytes)`` events recorded so far."""
+    with _lock:
+        return list(_events)
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def summary() -> dict[str, dict[str, float]]:
+    """Aggregate events: per collective name, ``{calls, seconds, bytes,
+    gb_per_s}`` (payload bytes over wall time — an effective, not wire,
+    rate)."""
+    agg: dict[str, dict[str, float]] = {}
+    for name, sec, nb in events():
+        a = agg.setdefault(name, {"calls": 0, "seconds": 0.0, "bytes": 0})
+        a["calls"] += 1
+        a["seconds"] += sec
+        a["bytes"] += nb
+    for a in agg.values():
+        a["gb_per_s"] = (a["bytes"] / a["seconds"] / 1e9
+                         if a["seconds"] > 0 else 0.0)
+    return agg
+
+
+def format_summary() -> str:
+    """Human-readable table of :func:`summary` (rank-0-style report)."""
+    agg = summary()
+    if not agg:
+        return "(no collective events traced)"
+    w = max(len(k) for k in agg)
+    lines = [f"{'collective':<{w}}  calls  seconds    MB      GB/s"]
+    for name in sorted(agg):
+        a = agg[name]
+        lines.append(
+            f"{name:<{w}}  {a['calls']:>5d}  {a['seconds']:>7.4f}  "
+            f"{a['bytes'] / 1e6:>7.2f}  {a['gb_per_s']:>7.3f}")
+    return "\n".join(lines)
