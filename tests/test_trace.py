@@ -75,6 +75,36 @@ def test_thread_path_traced():
     assert names.count("ThreadCommSlave.allreduce_map") == 2
 
 
+def test_composed_collectives_record_once():
+    """allgather_map composes gather_map + broadcast_map internally; only
+    the outermost call may record (no phantom rows, no double counting)."""
+    cluster = TpuCommCluster(2)
+    maps = [{"a": 1.0}, {"b": 2.0}]
+    with trace_collectives():
+        cluster.allgather_map(maps, Operands.DOUBLE)
+    names = [e[0] for e in trace.events()]
+    assert names == ["TpuCommCluster.allgather_map"]
+
+
+def test_profiler_scope_cannot_nest(tmp_path):
+    with trace_collectives():
+        pass  # plain scopes nest fine (covered below)
+    outer = trace_collectives(profile_dir=str(tmp_path / "p1"))
+    inner = trace_collectives(profile_dir=str(tmp_path / "p2"))
+    with outer:
+        try:
+            inner.__enter__()
+            raised = False
+        except RuntimeError:
+            raised = True
+        assert raised
+    # the failed inner scope must not have corrupted the depth/profiler
+    # bookkeeping: a fresh profiler scope works
+    with trace_collectives(profile_dir=str(tmp_path / "p3")):
+        pass
+    assert trace.events() == []
+
+
 def test_nested_scopes():
     trace.clear()
     cluster = TpuCommCluster(2)

@@ -1,0 +1,184 @@
+"""Distributed correctness check program — multi-host (DCN) level.
+
+Third check family: one ``main()`` per PROCESS joins a
+``jax.distributed`` job (the TPU-native rendezvous replacing the
+reference's master, SURVEY.md section 3a), then checks
+
+1. the host-level :class:`DistributedComm` slave API (dense + map
+   collectives against the numpy oracle), and
+2. the perf path: a jitted ``shard_map`` psum over a GLOBAL mesh built
+   from every process's devices — host-local data placed with
+   ``jax.make_array_from_process_local_data``, the cross-host allreduce
+   staged by XLA over ICI/DCN.
+
+Launch (2 processes x 2 CPU devices each, loopback coordinator):
+
+    for i in 0 1; do
+        python -m ytk_mp4j_tpu.check.checkdist \
+            --coordinator localhost:9876 --num-processes 2 \
+            --process-id $i --local-devices 2 &
+    done
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+
+import numpy as np
+
+
+def check(comm, length: int = 97) -> int:
+    from ytk_mp4j_tpu import meta
+    from ytk_mp4j_tpu.check._oracle import expected_reduce, rank_data
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    n, r = comm.slave_num, comm.rank
+    fails = 0
+
+    def expect(name, ok):
+        nonlocal fails
+        if not ok:
+            fails += 1
+            comm.error(f"{name} MISMATCH")
+
+    for operand in (Operands.DOUBLE, Operands.FLOAT, Operands.INT):
+        exact = operand.dtype.kind != "f"
+        alls = [rank_data(q, length, operand, 3000) for q in range(n)]
+        ranges = meta.partition_range(0, length, n)
+        for op_name in ("SUM", "MAX", "MIN", "PROD"):
+            op = Operators.by_name(op_name)
+            want = expected_reduce(alls, op_name)
+            arr = alls[r].copy()
+            comm.allreduce_array(arr, operand, op)
+            ok = (np.array_equal(arr, want) if exact
+                  else np.allclose(arr, want, rtol=1e-5, atol=1e-6))
+            expect(f"allreduce/{operand.name}/{op_name}", ok)
+        # rooted + segment family
+        want = expected_reduce(alls, "SUM")
+        arr = alls[r].copy()
+        comm.reduce_array(arr, operand, Operators.SUM, root=0)
+        if r == 0:
+            expect(f"reduce/{operand.name}",
+                   np.allclose(arr, want, rtol=1e-5))
+        arr = alls[r].copy()
+        comm.broadcast_array(arr, operand, root=n - 1)
+        expect(f"broadcast/{operand.name}", np.array_equal(arr, alls[n - 1]))
+        arr = alls[r].copy()
+        comm.reduce_scatter_array(arr, operand, Operators.SUM)
+        s, e = ranges[r]
+        expect(f"reduce_scatter/{operand.name}",
+               np.allclose(arr[s:e], want[s:e], rtol=1e-5))
+        arr = alls[r].copy()
+        comm.allgather_array(arr, operand)
+        want_g = np.concatenate(
+            [alls[q][s:e] for q, (s, e) in enumerate(ranges)])
+        expect(f"allgather/{operand.name}", np.array_equal(arr, want_g))
+        arr = alls[r].copy()
+        comm.scatter_array(arr, operand, root=0)
+        s, e = ranges[r]
+        expect(f"scatter/{operand.name}",
+               np.array_equal(arr[s:e], alls[0][s:e]))
+        comm.barrier()
+
+    # map collectives over the pickled-object path
+    maps = [{f"k{(q + j) % (n + 1)}": float(q * 10 + j) for j in range(3)}
+            for q in range(n)]
+    want_merged: dict = {}
+    for m in maps:
+        for k, v in m.items():
+            want_merged[k] = want_merged.get(k, 0.0) + v
+    d = dict(maps[r])
+    comm.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+    expect("allreduce_map", d == want_merged)
+    d = {f"r{r}": float(r)}
+    comm.allgather_map(d, Operands.DOUBLE)
+    expect("allgather_map", d == {f"r{q}": float(q) for q in range(n)})
+    d = dict(maps[r])
+    comm.reduce_scatter_map(d, Operands.DOUBLE, Operators.SUM)
+    expect("reduce_scatter_map",
+           d == {k: v for k, v in want_merged.items()
+                 if meta.key_partition(k, n) == r})
+    return fails
+
+
+def check_global_mesh(comm) -> int:
+    """The perf path: jitted psum over a global (all-process) mesh."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ytk_mp4j_tpu.comm.distributed import global_mesh, hier_global_mesh
+    from ytk_mp4j_tpu.operators import Operators
+    from ytk_mp4j_tpu.ops import collectives as coll
+
+    fails = 0
+    for mesh, axes in ((global_mesh(), "mp4j"),
+                       (hier_global_mesh(), ("inter", "intra"))):
+        D = mesh.size
+        L = jax.local_device_count()
+        spec = P(axes if isinstance(axes, str) else axes)
+        # host-local rows -> one global [D, 8] array sharded over ranks
+        local = np.stack([
+            np.full(8, comm.rank * L + j, np.float32) for j in range(L)])
+        garr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), local, (D, 8))
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+        def f(x):
+            return coll.allreduce(x, Operators.SUM, axes)
+
+        out = jax.jit(f)(garr)
+        # row q is constant q; psum over ranks puts sum(range(D)) in
+        # every slot
+        want = float(sum(range(D)))
+        got = np.asarray(
+            [s.data for s in out.addressable_shards][0]).reshape(-1)[0]
+        if not np.isclose(got, want):
+            comm.error(f"global-mesh psum MISMATCH: {got} != {want}")
+            fails += 1
+    return fails
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True, help="host:port")
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--local-devices", type=int, default=2)
+    ap.add_argument("--length", type=int, default=97)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # CPU multi-process job: each process contributes --local-devices
+    # virtual devices (the "multi-node without a cluster" pattern,
+    # SURVEY.md section 4)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", args.local_devices)
+    # DOUBLE/LONG operands round-trip through the devices; without x64
+    # they would be silently downcast (the backend raises instead)
+    jax.config.update("jax_enable_x64", True)
+
+    from ytk_mp4j_tpu.comm.distributed import init_distributed
+
+    comm = init_distributed(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id)
+    try:
+        fails = check(comm, args.length)
+        fails += check_global_mesh(comm)
+        comm.info(f"checkdist done: {fails} failures")
+        comm.close(0 if fails == 0 else 1)
+        return 0 if fails == 0 else 1
+    except Exception:
+        traceback.print_exc()
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,411 @@
+"""Multi-host backend — the DCN-scale rendezvous and host-level slave.
+
+In the reference, scaling past one machine means pointing every slave
+JVM at the master's host:port (SURVEY.md section 3a). The TPU-native
+analogue of that rendezvous is ``jax.distributed.initialize``: the
+coordinator assigns process indices (ranks) and wires up the PJRT
+distributed runtime, after which XLA collectives ride ICI within a slice
+and DCN across hosts.
+
+Two layers are exposed here:
+
+- :func:`init_distributed` + :class:`DistributedComm` — a host-level
+  slave mirroring the ``ProcessCommSlave`` API (rank / slave_num /
+  barrier / info / close + the 7 collectives x {array, map}) where each
+  RANK IS A PROCESS (host). Array payloads ride device collectives via
+  ``multihost_utils``; map operands are pickled and exchanged as padded
+  byte buffers (the Kryo analogue at DCN scale). This is the
+  control-plane / host-data path — convenient, not the perf path.
+- :func:`global_mesh` / :func:`hier_global_mesh` — mesh builders over
+  ALL processes' devices for the perf path: user jit code with
+  ``shard_map`` + ``ops.collectives`` (and the model families) runs
+  unchanged on a global mesh; XLA stages psum across ICI then DCN
+  exactly like the reference's thread-then-process nesting (SURVEY.md
+  section 3d).
+
+Single-process fallback: constructing :class:`DistributedComm` without
+``jax.distributed`` initialized yields a 1-rank comm (useful for code
+that runs unmodified on one host or many).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+import jax
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operand, Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.parallel.mesh import DEFAULT_AXIS, INTER_AXIS, INTRA_AXIS
+from ytk_mp4j_tpu.utils import trace
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None,
+                     **kwargs) -> "DistributedComm":
+    """Join the distributed job and return the host-level comm.
+
+    Mirrors the reference's slave constructor (master host:port ->
+    coordinator address; expected slave count -> num_processes; SURVEY.md
+    section 3a). With no arguments, JAX auto-detects cluster settings
+    (TPU pod metadata) or falls back to single-process.
+    """
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id, **kwargs)
+    return DistributedComm()
+
+
+def global_mesh(axis_name: str = DEFAULT_AXIS) -> Mesh:
+    """1-D mesh over every device of every process (the flat perf path)."""
+    return Mesh(np.asarray(jax.devices()), (axis_name,))
+
+
+def hier_global_mesh(axis_names: tuple[str, str] = (INTER_AXIS, INTRA_AXIS),
+                     ) -> Mesh:
+    """2-D (process x local-device) mesh: ``inter`` crosses hosts (DCN),
+    ``intra`` stays on-host/slice (ICI) — the device-side analogue of the
+    reference's process x thread nesting (SURVEY.md section 3d)."""
+    P = jax.process_count()
+    L = jax.local_device_count()
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return Mesh(np.asarray(devs).reshape(P, L), axis_names)
+
+
+class DistributedComm(CommSlave):
+    """Host-level slave over the JAX distributed runtime.
+
+    One rank per PROCESS. Collectives move host numpy data through the
+    devices (``multihost_utils``), with in-place buffer semantics
+    matching the other backends. Use the mesh builders above + the
+    functional layer for device-resident perf-path work.
+    """
+
+    def __init__(self):
+        self._rank = jax.process_index()
+        self._n = jax.process_count()
+        self._closed = False
+        self.final_code: int | None = None  # set by close()
+
+    # -- identity / control plane --------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def slave_num(self) -> int:
+        return self._n
+
+    def barrier(self, name: str | None = None) -> None:
+        self._assert_open()
+        tag = name if name is not None else "mp4j_barrier"
+        multihost_utils.sync_global_devices(tag)
+
+    def close(self, code: int = 0) -> None:
+        """Exchange exit codes, synchronize, then leave the job.
+
+        Matches the reference's close(code) aggregation: every process
+        learns the job-wide worst code before teardown —
+        :attr:`final_code` is ``max`` over all ranks' codes (the
+        coordinator-side ``Master.final_code`` equivalent), and a
+        nonzero aggregate is logged on every rank."""
+        if self._closed:
+            return
+        if self._n > 1:
+            codes = self._exchange_obj(int(code))
+            self.final_code = max(codes)
+            if self.final_code != 0:
+                self.error(f"job closing with aggregate exit code "
+                           f"{self.final_code} (per-rank: {codes})")
+            multihost_utils.sync_global_devices("mp4j_close")
+            jax.distributed.shutdown()
+        else:
+            self.final_code = int(code)
+        self._closed = True
+
+    def _assert_open(self):
+        if self._closed:
+            raise Mp4jError("comm is closed")
+
+    # -- internals ------------------------------------------------------
+    def _check_numeric(self, operand: Operand):
+        if not operand.is_numeric:
+            raise Mp4jError(
+                f"{operand.name} operands travel the map/object path on "
+                "the distributed backend")
+        if operand.dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+            raise Mp4jError(
+                f"{operand.name} needs jax_enable_x64: the payload "
+                "round-trips through the devices and would be silently "
+                "downcast")
+
+    def _norm_range(self, arr, operand: Operand, lo: int, hi: int | None):
+        self._check_numeric(operand)
+        arr = operand.check_array(arr)
+        if arr.ndim != 1:
+            raise Mp4jError("distributed path supports 1-D arrays")
+        if hi is None:
+            hi = len(arr)
+        if not (0 <= lo <= hi <= len(arr)):
+            raise Mp4jError(f"range [{lo}, {hi}) out of bounds")
+        return arr, lo, hi
+
+    def _allgather_rows(self, row: np.ndarray) -> np.ndarray:
+        """[L] per process -> [P, L] on every process (device allgather)."""
+        return np.asarray(multihost_utils.process_allgather(row))
+
+    def _exchange_obj(self, obj) -> list:
+        """Every process contributes one picklable object; returns the
+        list of all processes' objects (rank-ordered). Pickled bytes ride
+        a padded uint8 device allgather — the DCN Kryo analogue."""
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        n = np.asarray([payload.size], np.int64)
+        sizes = self._allgather_rows(n)[:, 0]
+        cap = int(sizes.max())
+        buf = np.zeros(cap, np.uint8)
+        buf[: payload.size] = payload
+        rows = self._allgather_rows(buf)
+        return [pickle.loads(rows[p, : sizes[p]].tobytes())
+                for p in range(self._n)]
+
+    def _bcast(self, arr: np.ndarray, root: int) -> np.ndarray:
+        return np.asarray(multihost_utils.broadcast_one_to_all(
+            arr, is_source=self._rank == root))
+
+    def _check_root(self, root: int):
+        if not (0 <= root < self._n):
+            raise Mp4jError(f"root {root} out of range [0, {self._n})")
+
+    @staticmethod
+    def _reduce_rows(rows: np.ndarray, operator: Operator) -> np.ndarray:
+        acc = rows[0].copy()
+        for p in range(1, rows.shape[0]):
+            acc = operator.np_fn(acc, rows[p])
+        return acc
+
+    # -- dense-array collectives ---------------------------------------
+    def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        from_: int = 0, to: int | None = None):
+        self._assert_open()
+        arr, lo, hi = self._norm_range(arr, operand, from_, to)
+        if self._n == 1 or hi == lo:
+            return arr
+        rows = self._allgather_rows(np.ascontiguousarray(arr[lo:hi]))
+        arr[lo:hi] = self._reduce_rows(rows, operator)
+        return arr
+
+    def reduce_array(self, arr, operand: Operand = Operands.FLOAT,
+                     operator: Operator = Operators.SUM, root: int = 0,
+                     from_: int = 0, to: int | None = None):
+        self._assert_open()
+        self._check_root(root)
+        arr, lo, hi = self._norm_range(arr, operand, from_, to)
+        if self._n == 1 or hi == lo:
+            return arr
+        rows = self._allgather_rows(np.ascontiguousarray(arr[lo:hi]))
+        if self._rank == root:
+            arr[lo:hi] = self._reduce_rows(rows, operator)
+        return arr
+
+    def broadcast_array(self, arr, operand: Operand = Operands.FLOAT,
+                        root: int = 0, from_: int = 0,
+                        to: int | None = None):
+        self._assert_open()
+        self._check_root(root)
+        arr, lo, hi = self._norm_range(arr, operand, from_, to)
+        if self._n == 1 or hi == lo:
+            return arr
+        arr[lo:hi] = self._bcast(np.ascontiguousarray(arr[lo:hi]), root)
+        return arr
+
+    def _norm_ranges(self, arr, ranges):
+        if ranges is None:
+            ranges = meta.partition_range(0, len(arr), self._n)
+        if len(ranges) != self._n:
+            raise Mp4jError(f"need {self._n} ranges, got {len(ranges)}")
+        return ranges
+
+    def allgather_array(self, arr, operand: Operand = Operands.FLOAT,
+                        ranges=None):
+        self._assert_open()
+        arr, _, _ = self._norm_range(arr, operand, 0, None)
+        ranges = self._norm_ranges(arr, ranges)
+        if self._n == 1:
+            return arr
+        B = max(1, max(e - s for s, e in ranges))
+        block = np.zeros(B, dtype=operand.dtype)
+        s, e = ranges[self._rank]
+        block[: e - s] = arr[s:e]
+        rows = self._allgather_rows(block)
+        for p, (ps, pe) in enumerate(ranges):
+            arr[ps:pe] = rows[p, : pe - ps]
+        return arr
+
+    def gather_array(self, arr, operand: Operand = Operands.FLOAT,
+                     root: int = 0, ranges=None):
+        self._assert_open()
+        self._check_root(root)
+        arr, _, _ = self._norm_range(arr, operand, 0, None)
+        ranges = self._norm_ranges(arr, ranges)
+        if self._n == 1:
+            return arr
+        B = max(1, max(e - s for s, e in ranges))
+        block = np.zeros(B, dtype=operand.dtype)
+        s, e = ranges[self._rank]
+        block[: e - s] = arr[s:e]
+        rows = self._allgather_rows(block)
+        if self._rank == root:
+            for p, (ps, pe) in enumerate(ranges):
+                arr[ps:pe] = rows[p, : pe - ps]
+        return arr
+
+    def scatter_array(self, arr, operand: Operand = Operands.FLOAT,
+                      root: int = 0, ranges=None):
+        self._assert_open()
+        self._check_root(root)
+        arr, _, _ = self._norm_range(arr, operand, 0, None)
+        ranges = self._norm_ranges(arr, ranges)
+        if self._n == 1:
+            return arr
+        lo, hi = ranges[0][0], ranges[-1][1]
+        full = self._bcast(np.ascontiguousarray(arr[lo:hi]), root)
+        s, e = ranges[self._rank]
+        arr[s:e] = full[s - lo: e - lo]
+        return arr
+
+    def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,
+                             operator: Operator = Operators.SUM,
+                             ranges=None):
+        self._assert_open()
+        arr, _, _ = self._norm_range(arr, operand, 0, None)
+        ranges = self._norm_ranges(arr, ranges)
+        if self._n == 1:
+            return arr
+        lo, hi = ranges[0][0], ranges[-1][1]
+        rows = self._allgather_rows(np.ascontiguousarray(arr[lo:hi]))
+        merged = self._reduce_rows(rows, operator)
+        s, e = ranges[self._rank]
+        arr[s:e] = merged[s - lo: e - lo]
+        return arr
+
+    # -- map collectives (pickled-object path) -------------------------
+    @staticmethod
+    def _merge_maps(operator: Operator, acc: dict, src: dict) -> dict:
+        for k, v in src.items():
+            acc[k] = operator.np_fn(acc[k], v) if k in acc else v
+        return acc
+
+    def allreduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      operator: Operator = Operators.SUM) -> dict:
+        self._assert_open()
+        if self._n == 1:
+            return d
+        acc: dict = {}
+        for m in self._exchange_obj(d):
+            self._merge_maps(operator, acc, m)
+        d.clear()
+        d.update(acc)
+        return d
+
+    def reduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   operator: Operator = Operators.SUM, root: int = 0) -> dict:
+        self._assert_open()
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        acc: dict = {}
+        for m in self._exchange_obj(d):
+            self._merge_maps(operator, acc, m)
+        if self._rank == root:
+            d.clear()
+            d.update(acc)
+        return d
+
+    def broadcast_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      root: int = 0) -> dict:
+        self._assert_open()
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        src = self._exchange_obj(d)[root]
+        d.clear()
+        d.update(src)
+        return d
+
+    def gather_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   root: int = 0) -> dict:
+        self._assert_open()
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        maps = self._exchange_obj(d)
+        total = sum(len(m) for m in maps)
+        union: dict = {}
+        for m in maps:
+            union.update(m)
+        if len(union) != total:
+            raise Mp4jError("gather_map requires disjoint keys across "
+                            "ranks; use reduce_map to combine")
+        if self._rank == root:
+            d.clear()
+            d.update(union)
+        return d
+
+    def allgather_map(self, d: dict,
+                      operand: Operand = Operands.DOUBLE) -> dict:
+        self._assert_open()
+        if self._n == 1:
+            return d
+        maps = self._exchange_obj(d)
+        total = sum(len(m) for m in maps)
+        union: dict = {}
+        for m in maps:
+            union.update(m)
+        if len(union) != total:
+            raise Mp4jError("allgather_map requires disjoint keys")
+        d.clear()
+        d.update(union)
+        return d
+
+    def scatter_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                    root: int = 0) -> dict:
+        self._assert_open()
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        src = self._exchange_obj(d)[root]
+        mine = {k: v for k, v in src.items()
+                if meta.key_partition(k, self._n) == self._rank}
+        d.clear()
+        d.update(mine)
+        return d
+
+    def reduce_scatter_map(self, d: dict,
+                           operand: Operand = Operands.DOUBLE,
+                           operator: Operator = Operators.SUM) -> dict:
+        self._assert_open()
+        if self._n == 1:
+            return d
+        acc: dict = {}
+        for m in self._exchange_obj(d):
+            self._merge_maps(operator, acc, m)
+        mine = {k: v for k, v in acc.items()
+                if meta.key_partition(k, self._n) == self._rank}
+        d.clear()
+        d.update(mine)
+        return d
+
+
+# per-collective tracing (utils.trace; zero overhead when disabled)
+trace.instrument(DistributedComm)

@@ -83,17 +83,27 @@ def instrument(cls, methods=COLLECTIVE_METHODS):
     return cls
 
 
+_in_collective = threading.local()
+
+
 def traced(fn):
     """Wrap a collective method: when tracing is enabled, time the call
-    and record the payload size of its first data argument."""
+    and record the payload size of its first data argument. Only the
+    OUTERMOST traced call on a thread records — collectives implemented
+    by composing other collectives (e.g. allreduce_map = reduce_map +
+    broadcast_map) must not double-count or emit phantom rows."""
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
-        if not _enabled:
+        if not _enabled or getattr(_in_collective, "depth", 0) > 0:
             return fn(self, *args, **kwargs)
         nbytes = _payload_bytes(args[0]) if args else 0
+        _in_collective.depth = 1
         t0 = time.perf_counter()
-        out = fn(self, *args, **kwargs)
+        try:
+            out = fn(self, *args, **kwargs)
+        finally:
+            _in_collective.depth = 0
         record(f"{type(self).__name__}.{fn.__name__}",
                time.perf_counter() - t0, nbytes)
         return out
@@ -104,9 +114,12 @@ def traced(fn):
 class trace_collectives:
     """Context manager enabling collective tracing (optionally plus the
     JAX profiler when ``profile_dir`` is given). Re-entrant: nested
-    scopes keep tracing enabled until the outermost exits."""
+    scopes keep tracing enabled until the outermost exits. At most ONE
+    scope in the stack may pass ``profile_dir`` (the JAX profiler cannot
+    nest); a second raises before any state changes."""
 
     _depth = 0
+    _profiler_owner: "trace_collectives | None" = None
 
     def __init__(self, profile_dir: str | None = None, clear: bool = True):
         self.profile_dir = profile_dir
@@ -118,9 +131,20 @@ class trace_collectives:
         # runs when __enter__ raises, so state must only change once
         # nothing else can fail
         if self.profile_dir is not None:
-            import jax
+            with _lock:
+                if trace_collectives._profiler_owner is not None:
+                    raise RuntimeError(
+                        "a trace_collectives scope with profile_dir is "
+                        "already active; the JAX profiler cannot nest")
+                trace_collectives._profiler_owner = self
+            try:
+                import jax
 
-            jax.profiler.start_trace(self.profile_dir)
+                jax.profiler.start_trace(self.profile_dir)
+            except BaseException:
+                with _lock:
+                    trace_collectives._profiler_owner = None
+                raise
         with _lock:
             if trace_collectives._depth == 0 and self.clear:
                 _events.clear()
@@ -130,10 +154,12 @@ class trace_collectives:
 
     def __exit__(self, *exc):
         global _enabled
-        if self.profile_dir is not None:
+        if trace_collectives._profiler_owner is self:
             import jax
 
             jax.profiler.stop_trace()
+            with _lock:
+                trace_collectives._profiler_owner = None
         with _lock:
             trace_collectives._depth -= 1
             if trace_collectives._depth == 0:
