@@ -2,8 +2,12 @@
 generation, and the master+slave-threads socket harness."""
 
 import threading
+from pathlib import Path
 
 import numpy as np
+
+# repo root for subprocess-based tests (cwd-independent)
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
 
 # single source of truth for the numpy oracle: the check programs' module
 from ytk_mp4j_tpu.check._oracle import NP_REF, expected_reduce  # noqa: F401

@@ -10,6 +10,8 @@ import pytest
 from ytk_mp4j_tpu.check import checkthread
 from ytk_mp4j_tpu.comm.master import Master
 
+from helpers import REPO_ROOT
+
 
 def test_checkthread_standalone():
     """Pure-thread job (no master): the whole battery in-process."""
@@ -28,7 +30,7 @@ def test_checkthread_hybrid_subprocess():
             [sys.executable, "-m", "ytk_mp4j_tpu.check.checkthread",
              "--master", f"127.0.0.1:{master.port}", "--threads", "2",
              "--length", "53"],
-            cwd="/root/repo",
+            cwd=REPO_ROOT,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for _ in range(2)
     ]

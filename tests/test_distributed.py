@@ -13,6 +13,8 @@ import pytest
 
 from ytk_mp4j_tpu.comm.distributed import DistributedComm
 
+from helpers import REPO_ROOT
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -49,7 +51,7 @@ def test_checkdist_multiprocess(procs):
              "--coordinator", f"127.0.0.1:{port}",
              "--num-processes", str(procs), "--process-id", str(i),
              "--local-devices", "2", "--length", "53"],
-            cwd="/root/repo",
+            cwd=REPO_ROOT,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for i in range(procs)
     ]

@@ -18,7 +18,7 @@ from ytk_mp4j_tpu.comm.master import Master
 from ytk_mp4j_tpu.operands import Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 
-from helpers import expected_reduce, make_inputs, run_slaves
+from helpers import REPO_ROOT, expected_reduce, make_inputs, run_slaves
 
 
 def make_all(n, length, operand, seed=7):
@@ -199,7 +199,7 @@ def test_checkprocess_subprocess():
         subprocess.Popen(
             [sys.executable, "-m", "ytk_mp4j_tpu.check.checkprocess",
              "--master", f"127.0.0.1:{master.port}", "--length", "65"],
-            cwd="/root/repo",
+            cwd=REPO_ROOT,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for _ in range(3)
     ]

@@ -174,7 +174,9 @@ def main(argv=None) -> int:
         fails += check_global_mesh(comm)
         comm.info(f"checkdist done: {fails} failures")
         comm.close(0 if fails == 0 else 1)
-        return 0 if fails == 0 else 1
+        # job-wide verdict: root-only checks fail on rank 0 alone, so
+        # every process must report the aggregate, not its local count
+        return comm.final_code
     except Exception:
         traceback.print_exc()
         return 2

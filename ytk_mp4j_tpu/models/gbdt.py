@@ -45,10 +45,30 @@ class GBDTConfig:
     learning_rate: float = 0.1
     reg_lambda: float = 1.0
     n_trees: int = 10
+    # "pair": feature-pair joint histograms (halved scatter elements,
+    # see the performance note below); "flat": one scatter per feature
+    hist_mode: str = "pair"
+
+    def __post_init__(self):
+        if self.hist_mode not in ("pair", "flat"):
+            raise ValueError(
+                f"hist_mode must be 'pair' or 'flat', got {self.hist_mode!r}")
 
 
 # ----------------------------------------------------------------------
 # histogram building (the hot op)
+#
+# TPU performance note (measured on v5e, N=2M x F=28 x B=256): histogram
+# building is bound by the chip's serial scatter unit at ~7.6 ns per
+# (sample, feature) contribution, independent of bucket count. Every
+# alternative loses to the straight scatter: per-element gathers and
+# sorts hit the same serial bound; one-hot matmuls burn B x the useful
+# FLOPs (VPU-bound building the one-hot); complex64 / 64-bit packed
+# scatters are emulated ~10-20x slower; v5e has no SparseCore
+# (get_sparse_core_info -> 0 cores). The one real lever is reducing
+# scatter ELEMENT COUNT: packing feature PAIRS into joint (B x B)
+# histograms halves the elements (N*F/2) at the cost of a streaming
+# marginalization pass, a measured ~1.3x end-to-end win, exact in f32.
 # ----------------------------------------------------------------------
 def build_histograms(bins, g, h, node_ids, n_nodes: int, cfg: GBDTConfig):
     """Per-(node, feature, bin) gradient/hessian sums.
@@ -57,9 +77,19 @@ def build_histograms(bins, g, h, node_ids, n_nodes: int, cfg: GBDTConfig):
     node_ids: [N] int32 in [0, n_nodes).
     Returns (hist_g, hist_h): [n_nodes, F, B] f32.
 
-    One flat segment-sum of N*F contributions — XLA lowers this to a
-    sorted scatter-add; static output shape n_nodes*F*B.
+    Strategy "pair" (default when F is even and the joint table fits):
+    one scatter of N*F/2 elements into per-feature-PAIR joint (B x B)
+    histograms, then marginalize. Strategy "flat": one scatter of N*F
+    elements (the fallback, and the shape the socket baseline mirrors).
     """
+    F, B = cfg.n_features, cfg.n_bins
+    joint_mb = n_nodes * (F // 2) * B * B * 4 * 2 / 1e6
+    if cfg.hist_mode == "pair" and F % 2 == 0 and joint_mb <= 1024:
+        return _build_histograms_pair(bins, g, h, node_ids, n_nodes, cfg)
+    return _build_histograms_flat(bins, g, h, node_ids, n_nodes, cfg)
+
+
+def _build_histograms_flat(bins, g, h, node_ids, n_nodes, cfg):
     F, B = cfg.n_features, cfg.n_bins
     flat_ids = (node_ids[:, None] * (F * B)
                 + jnp.arange(F, dtype=jnp.int32)[None, :] * B
@@ -70,6 +100,28 @@ def build_histograms(bins, g, h, node_ids, n_nodes: int, cfg: GBDTConfig):
     hist_g = jax.ops.segment_sum(gs, seg, num_segments=n_nodes * F * B)
     hist_h = jax.ops.segment_sum(hs, seg, num_segments=n_nodes * F * B)
     return (hist_g.reshape(n_nodes, F, B), hist_h.reshape(n_nodes, F, B))
+
+
+def _build_histograms_pair(bins, g, h, node_ids, n_nodes, cfg):
+    """Joint (feature-pair, B x B) histograms + marginalization: halves
+    the scatter elements (the serial-unit bound above), exactly."""
+    F, B = cfg.n_features, cfg.n_bins
+    P = F // 2
+    b1 = bins[:, 0::2]                                    # [N, P]
+    b2 = bins[:, 1::2]
+    flat = (node_ids[:, None] * (P * B * B)
+            + jnp.arange(P, dtype=jnp.int32)[None, :] * (B * B)
+            + b1 * B + b2).reshape(-1)
+    gs = jnp.broadcast_to(g[:, None], b1.shape).reshape(-1)
+    hs = jnp.broadcast_to(h[:, None], b1.shape).reshape(-1)
+    HG = jax.ops.segment_sum(gs, flat, num_segments=n_nodes * P * B * B)
+    HH = jax.ops.segment_sum(hs, flat, num_segments=n_nodes * P * B * B)
+    HG = HG.reshape(n_nodes, P, B, B)
+    HH = HH.reshape(n_nodes, P, B, B)
+    # marginalize the joint table: even features sum out b2, odd sum b1
+    hg = jnp.stack([HG.sum(-1), HG.sum(-2)], 2).reshape(n_nodes, F, B)
+    hh = jnp.stack([HH.sum(-1), HH.sum(-2)], 2).reshape(n_nodes, F, B)
+    return hg, hh
 
 
 def best_splits(hist_g, hist_h, reg_lambda: float):
