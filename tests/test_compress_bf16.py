@@ -1,0 +1,139 @@
+"""Compressed operands (socket wire) and the BFLOAT16 operand."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.transport.channel import Channel
+
+from helpers import expected_reduce, run_slaves
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return Channel(a), Channel(b)
+
+
+def test_channel_compressed_roundtrip():
+    tx, rx = _pair()
+    arr = np.zeros(10_000, np.float64)  # highly compressible
+    out = {}
+
+    def reader():
+        out["arr"] = rx.recv()
+        out["obj"] = rx.recv()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    tx.send_array(arr, compress=True)
+    tx.send_obj({"k": [1, 2, 3], "s": "x" * 5000}, compress=True)
+    t.join(10)
+    np.testing.assert_array_equal(out["arr"], arr)
+    assert out["obj"]["s"] == "x" * 5000
+    tx.close()
+    rx.close()
+
+
+def test_compressed_wire_is_smaller():
+    """Compressible payloads must actually shrink on the wire."""
+    sent = []
+
+    class Spy:
+        def setsockopt(self, *a):
+            pass
+
+        def sendall(self, b):
+            sent.append(len(b))
+
+    ch = Channel.__new__(Channel)
+    ch.sock = Spy()
+    arr = np.zeros(100_000, np.float64)
+    ch.send_array(arr)
+    plain = sum(sent)
+    sent.clear()
+    ch.send_array(arr, compress=True)
+    packed = sum(sent)
+    assert packed < plain / 20
+
+
+@pytest.mark.parametrize("algo", ["rhd", "ring"])
+def test_socket_allreduce_compressed_operand(algo):
+    n = 3
+    operand = Operands.compressed(Operands.DOUBLE)
+    assert operand.compress and operand.dtype == np.float64
+    rng = np.random.default_rng(3)
+    alls = [rng.standard_normal(57) for _ in range(n)]
+    want = expected_reduce(alls, "SUM")
+
+    def fn(slave, r):
+        arr = alls[r].copy()
+        slave.allreduce_array(arr, operand, Operators.SUM, algo=algo)
+        return arr
+
+    for got in run_slaves(n, fn):
+        np.testing.assert_allclose(got, want)
+
+
+def test_socket_map_compressed():
+    n = 3
+    operand = Operands.compressed(Operands.DOUBLE)
+
+    def fn(slave, r):
+        d = {f"k{r % 2}": float(r)}
+        slave.allreduce_map(d, operand, Operators.SUM)
+        return d
+
+    for d in run_slaves(n, fn):
+        assert d == {"k0": 2.0, "k1": 1.0}
+
+
+# ----------------------------------------------------------------------
+def test_bfloat16_operand_device_path():
+    cluster = TpuCommCluster(4)
+    dt = Operands.BFLOAT16.dtype
+    arrs = [np.full(64, float(r + 1), dt) for r in range(4)]
+    cluster.allreduce_array(arrs, Operands.BFLOAT16, Operators.SUM)
+    for a in arrs:
+        assert a.dtype == dt
+        np.testing.assert_array_equal(a.astype(np.float32), 10.0)
+
+
+def test_bfloat16_operand_socket_path():
+    n = 3
+    dt = Operands.BFLOAT16.dtype
+
+    def fn(slave, r):
+        arr = np.full(33, float(2 ** r), dt)
+        slave.allreduce_array(arr, Operands.BFLOAT16, Operators.MAX)
+        return arr
+
+    for got in run_slaves(n, fn):
+        np.testing.assert_array_equal(got.astype(np.float32), 4.0)
+
+
+def test_bfloat16_identities_and_lookup():
+    import ml_dtypes
+
+    dt = Operands.BFLOAT16.dtype
+    assert Operands.by_dtype(dt) is Operands.BFLOAT16
+    # representable extrema (not +-inf): fp8 ml_dtypes have no inf, so
+    # identities use finfo bounds — and they must never be NaN
+    lo = Operators.MAX.identity(dt)
+    hi = Operators.MIN.identity(dt)
+    assert float(lo) == float(ml_dtypes.finfo(dt).min)
+    assert float(hi) == float(ml_dtypes.finfo(dt).max)
+    assert float(Operators.SUM.identity(dt)) == 0.0
+    # the fp8 case the guard exists for: identity stays finite, and a
+    # MAX against it returns the data unchanged
+    f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    ident8 = Operators.MAX.identity(f8)
+    assert np.isfinite(float(ident8))
+    x = np.array([1.0, -2.0], f8)
+    np.testing.assert_array_equal(
+        np.maximum(np.full_like(x, ident8), x).astype(np.float32),
+        x.astype(np.float32))

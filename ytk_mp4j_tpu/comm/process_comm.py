@@ -174,19 +174,20 @@ class ProcessCommSlave(CommSlave):
                 raise Mp4jError(f"timeout waiting for peer {peer} to connect")
             return self._peers[peer]
 
-    def _send(self, peer: int, data) -> None:
+    def _send(self, peer: int, data, compress: bool = False) -> None:
         ch = self._channel(peer)
         if isinstance(data, np.ndarray):
-            ch.send_array(data)
+            ch.send_array(data, compress=compress)
         else:
-            ch.send_obj(data)
+            ch.send_obj(data, compress=compress)
 
     def _recv(self, peer: int):
         return self._channel(peer).recv()
 
-    def _sendrecv(self, send_peer: int, recv_peer: int, data):
+    def _sendrecv(self, send_peer: int, recv_peer: int, data,
+                  compress: bool = False):
         """Send and receive concurrently (paired exchange, ring step)."""
-        fut = self._pool.submit(self._send, send_peer, data)
+        fut = self._pool.submit(self._send, send_peer, data, compress)
         out = self._recv(recv_peer)
         fut.result()
         return out
@@ -249,7 +250,7 @@ class ProcessCommSlave(CommSlave):
             return self._rhd_allreduce(arr, operand, operator, lo, hi)
         segs = meta.partition_range(lo, hi, self._n)
         self._ring_reduce_scatter(arr, segs, operand, operator)
-        self._ring_allgather(arr, segs)
+        self._ring_allgather(arr, segs, compress=operand.compress)
         return arr
 
     # -- recursive halving/doubling (Rabenseifner), SURVEY.md 3b --------
@@ -276,7 +277,8 @@ class ProcessCommSlave(CommSlave):
         extra = n - p
 
         if r >= p:  # folded rank: contribute, then wait for the result
-            self._send(r - p, np.ascontiguousarray(arr[lo:hi]))
+            self._send(r - p, np.ascontiguousarray(arr[lo:hi]),
+                       compress=operand.compress)
             arr[lo:hi] = self._recv(r - p)
             return arr
         if r < extra:  # fold partner: merge the extra rank's data
@@ -303,7 +305,8 @@ class ProcessCommSlave(CommSlave):
             gs, ge = span(*give)
             ks, ke = span(*keep)
             recv = self._sendrecv(partner, partner,
-                                  np.ascontiguousarray(arr[gs:ge]))
+                                  np.ascontiguousarray(arr[gs:ge]),
+                                  compress=operand.compress)
             native.reduce_into(operator, arr[ks:ke], np.asarray(recv))
             dist >>= 1
 
@@ -316,12 +319,14 @@ class ProcessCommSlave(CommSlave):
             ms, me = span(mb0, mb0 + dist)
             ts, te = span(tb0, tb0 + dist)
             recv = self._sendrecv(partner, partner,
-                                  np.ascontiguousarray(arr[ms:me]))
+                                  np.ascontiguousarray(arr[ms:me]),
+                                  compress=operand.compress)
             arr[ts:te] = recv
             dist *= 2
 
         if r < extra:  # unfold: ship the finished range back
-            self._send(r + p, np.ascontiguousarray(arr[lo:hi]))
+            self._send(r + p, np.ascontiguousarray(arr[lo:hi]),
+                       compress=operand.compress)
         return arr
 
     def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,
@@ -356,7 +361,7 @@ class ProcessCommSlave(CommSlave):
             ranges = meta.partition_range(0, len(arr), self._n)
         if self._n == 1:
             return arr
-        self._ring_allgather(arr, ranges)
+        self._ring_allgather(arr, ranges, compress=operand.compress)
         return arr
 
     def _ring_reduce_scatter(self, arr, segs, operand, operator):
@@ -374,7 +379,8 @@ class ProcessCommSlave(CommSlave):
             ss, se = segs[send_idx]
             out = carry if carry is not None else arr[ss:se]
             recv = self._sendrecv(right, left, np.ascontiguousarray(out)
-                                  if isinstance(out, np.ndarray) else out)
+                                  if isinstance(out, np.ndarray) else out,
+                                  compress=operand.compress)
             ri_s, ri_e = segs[(r - 2 - s) % n]
             local = arr[ri_s:ri_e]
             if isinstance(local, np.ndarray):
@@ -388,7 +394,7 @@ class ProcessCommSlave(CommSlave):
         arr[ms:me] = carry
         return arr
 
-    def _ring_allgather(self, arr, segs):
+    def _ring_allgather(self, arr, segs, compress: bool = False):
         """After n-1 ring steps every rank holds all segments."""
         n, r = self._n, self._rank
         right, left = (r + 1) % n, (r - 1) % n
@@ -398,7 +404,8 @@ class ProcessCommSlave(CommSlave):
             recv = self._sendrecv(
                 right, left,
                 np.ascontiguousarray(chunk)
-                if isinstance(chunk, np.ndarray) else chunk)
+                if isinstance(chunk, np.ndarray) else chunk,
+                compress=compress)
             rs, re = segs[(r - 1 - s) % n]
             arr[rs:re] = recv
         return arr
@@ -422,7 +429,8 @@ class ProcessCommSlave(CommSlave):
             if vr & mask:
                 peer = ((vr - mask) + root) % self._n
                 self._send(peer, acc if not isinstance(acc, np.ndarray)
-                           else np.ascontiguousarray(acc))
+                           else np.ascontiguousarray(acc),
+                           compress=operand.compress)
                 break
             else:
                 src_vr = vr + mask
@@ -452,7 +460,8 @@ class ProcessCommSlave(CommSlave):
                     chunk = arr[lo:hi]
                     self._send((dst_vr + root) % self._n,
                                np.ascontiguousarray(chunk)
-                               if isinstance(chunk, np.ndarray) else chunk)
+                               if isinstance(chunk, np.ndarray) else chunk,
+                               compress=operand.compress)
             elif mask <= vr < 2 * mask:
                 recv = self._recv(((vr - mask) + root) % self._n)
                 arr[lo:hi] = recv
@@ -480,7 +489,8 @@ class ProcessCommSlave(CommSlave):
             s, e = ranges[self._rank]
             chunk = arr[s:e]
             self._send(root, np.ascontiguousarray(chunk)
-                       if isinstance(chunk, np.ndarray) else chunk)
+                       if isinstance(chunk, np.ndarray) else chunk,
+                       compress=operand.compress)
         return arr
 
     def scatter_array(self, arr, operand: Operand = Operands.FLOAT,
@@ -499,7 +509,8 @@ class ProcessCommSlave(CommSlave):
                 s, e = ranges[peer]
                 chunk = arr[s:e]
                 self._send(peer, np.ascontiguousarray(chunk)
-                           if isinstance(chunk, np.ndarray) else chunk)
+                           if isinstance(chunk, np.ndarray) else chunk,
+                           compress=operand.compress)
         else:
             s, e = ranges[self._rank]
             arr[s:e] = self._recv(root)
@@ -540,7 +551,8 @@ class ProcessCommSlave(CommSlave):
         mask = 1
         while mask < self._n:
             if vr & mask:
-                self._send(((vr - mask) + root) % self._n, acc)
+                self._send(((vr - mask) + root) % self._n, acc,
+                           compress=operand.compress)
                 break
             else:
                 src_vr = vr + mask
@@ -566,7 +578,8 @@ class ProcessCommSlave(CommSlave):
             if have:
                 dst_vr = vr + mask
                 if dst_vr < self._n:
-                    self._send((dst_vr + root) % self._n, d)
+                    self._send((dst_vr + root) % self._n, d,
+                               compress=operand.compress)
             elif mask <= vr < 2 * mask:
                 recv = self._recv(((vr - mask) + root) % self._n)
                 d.clear()
@@ -593,7 +606,7 @@ class ProcessCommSlave(CommSlave):
                             f"{peer}; use reduce_map to combine")
                     d[k] = v
         else:
-            self._send(root, d)
+            self._send(root, d, compress=operand.compress)
         return d
 
     def allgather_map(self, d: dict, operand: Operand = Operands.DOUBLE) -> dict:
@@ -620,7 +633,8 @@ class ProcessCommSlave(CommSlave):
                 shares[partitioner(k)][k] = v
             for peer in range(self._n):
                 if peer != root:
-                    self._send(peer, shares[peer])
+                    self._send(peer, shares[peer],
+                               compress=operand.compress)
             d.clear()
             d.update(shares[root])
         else:

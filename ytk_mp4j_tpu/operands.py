@@ -31,6 +31,10 @@ class Operand:
     # serializer): (dumps, loads) over bytes.
     dumps: Callable[[Any], bytes] | None = None
     loads: Callable[[bytes], Any] | None = None
+    # zlib-compress this operand's payloads on socket transports (a
+    # bandwidth/CPU trade for compressible data; no effect on the device
+    # path, where payloads never leave HBM). See Operands.compressed().
+    compress: bool = False
 
     @property
     def is_numeric(self) -> bool:
@@ -62,6 +66,25 @@ class Operands:
     SHORT = Operand("SHORT", np.dtype(np.int16))
     BYTE = Operand("BYTE", np.dtype(np.int8))
     STRING = Operand("STRING", None)
+
+    # TPU-native extension (no Java analogue): the chip's preferred
+    # 16-bit float. Device-eligible; on socket transports numpy computes
+    # through ml_dtypes.
+    try:
+        import ml_dtypes as _mld
+
+        BFLOAT16 = Operand("BFLOAT16", np.dtype(_mld.bfloat16))
+    except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+        BFLOAT16 = None
+
+    @staticmethod
+    def compressed(operand: Operand) -> Operand:
+        """A copy of ``operand`` whose payloads are zlib-compressed on
+        socket transports (the reference-era Kryo-with-compression
+        trade; the device path is unaffected)."""
+        from dataclasses import replace
+
+        return replace(operand, compress=True)
 
     # Factory-method spellings for parity with the reference API shape.
     @staticmethod
@@ -98,7 +121,9 @@ class Operands:
         analogue). Defaults to pickle."""
         return Operand("OBJECT", None, dumps=dumps, loads=loads)
 
-    NUMERIC = (DOUBLE, FLOAT, INT, LONG, SHORT, BYTE)
+    NUMERIC = tuple(
+        op for op in (DOUBLE, FLOAT, INT, LONG, SHORT, BYTE, BFLOAT16)
+        if op is not None)
 
     @classmethod
     def by_dtype(cls, dtype) -> Operand:

@@ -92,12 +92,23 @@ def _prod_identity(dt: np.dtype):
 def _max_identity(dt: np.dtype):
     if dt.kind == "f":
         return -np.inf
+    if dt.kind == "V":
+        # ml_dtypes low-precision floats. Use the dtype's representable
+        # minimum, not -inf: fp8 variants (e4m3fn) have no inf, where
+        # casting -inf would poison the identity with NaN
+        import ml_dtypes
+
+        return ml_dtypes.finfo(dt).min
     return np.iinfo(dt).min
 
 
 def _min_identity(dt: np.dtype):
     if dt.kind == "f":
         return np.inf
+    if dt.kind == "V":
+        import ml_dtypes
+
+        return ml_dtypes.finfo(dt).max
     return np.iinfo(dt).max
 
 

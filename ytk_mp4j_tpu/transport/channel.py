@@ -8,7 +8,11 @@ Kryo for objects and raw ``DataOutputStream`` writes for primitive arrays
   then the raw buffer (no pickling; zero-copy on receive into a
   preallocated array),
 - everything else (maps, strings, objects, control tuples) is pickled —
-  pickle stands in for Kryo.
+  pickle stands in for Kryo,
+- either kind may be zlib-compressed on the wire (``compress=True`` on
+  send; the receiver auto-detects by frame tag). Compression is
+  per-operand (``Operands.compressed(...)``): a bandwidth/CPU trade the
+  caller makes for highly-compressible payloads.
 
 Frame layout: ``u8 tag | u64 payload_len | payload``.
 """
@@ -18,6 +22,7 @@ from __future__ import annotations
 import pickle
 import socket
 import struct
+import zlib
 
 import numpy as np
 
@@ -25,8 +30,28 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 
 TAG_OBJ = 0
 TAG_ARRAY = 1
+TAG_OBJ_Z = 2      # zlib-compressed pickle
+TAG_ARRAY_Z = 3    # header pickle | zlib-compressed raw buffer
+
+_ZLEVEL = 1  # fast; the trade is wire bytes vs CPU, not ratio records
 
 _HDR = struct.Struct("<BQ")
+
+
+def _dtype_token(dt: np.dtype) -> str:
+    """Wire name for a dtype. ``dt.str`` for standard numpy dtypes;
+    extension float dtypes (ml_dtypes, kind 'V') go by NAME because
+    their ``str`` ('<V2') decodes as raw void."""
+    return dt.name if dt.kind == "V" else dt.str
+
+
+def _raw_view(arr: np.ndarray):
+    """The array's bytes as a buffer; extension dtypes lack buffer
+    support, so reinterpret as uint8."""
+    try:
+        return memoryview(arr).cast("B")
+    except (TypeError, ValueError):
+        return arr.view(np.uint8)
 
 
 class Channel:
@@ -34,7 +59,10 @@ class Channel:
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # non-TCP transport (e.g. a UNIX socketpair)
 
     # -- low level ------------------------------------------------------
     def _send_all(self, *bufs: bytes | memoryview):
@@ -53,34 +81,57 @@ class Channel:
         return out
 
     # -- objects --------------------------------------------------------
-    def send_obj(self, obj) -> None:
+    def send_obj(self, obj, compress: bool = False) -> None:
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        self._send_all(_HDR.pack(TAG_OBJ, len(payload)), payload)
+        tag = TAG_OBJ
+        if compress:
+            payload = zlib.compress(payload, _ZLEVEL)
+            tag = TAG_OBJ_Z
+        self._send_all(_HDR.pack(tag, len(payload)), payload)
 
     # -- arrays (fast path) --------------------------------------------
-    def send_array(self, arr: np.ndarray) -> None:
+    def send_array(self, arr: np.ndarray, compress: bool = False) -> None:
         arr = np.ascontiguousarray(arr)
-        header = pickle.dumps((arr.dtype.str, arr.shape))
-        payload_len = len(header) + 4 + arr.nbytes
+        header = pickle.dumps((_dtype_token(arr.dtype), arr.shape))
+        if compress:
+            body: bytes | memoryview = zlib.compress(_raw_view(arr), _ZLEVEL)
+            tag = TAG_ARRAY_Z
+            nbody = len(body)
+        else:
+            body = _raw_view(arr)
+            tag = TAG_ARRAY
+            nbody = arr.nbytes
         self._send_all(
-            _HDR.pack(TAG_ARRAY, payload_len),
+            _HDR.pack(tag, len(header) + 4 + nbody),
             struct.pack("<I", len(header)),
             header,
-            memoryview(arr).cast("B"),
+            body,
         )
 
     # -- unified receive ------------------------------------------------
     def recv(self):
         hdr = self._recv_exact(_HDR.size)
         tag, ln = _HDR.unpack(bytes(hdr))
-        if tag == TAG_OBJ:
-            return pickle.loads(self._recv_exact(ln))
-        if tag == TAG_ARRAY:
+        if tag in (TAG_OBJ, TAG_OBJ_Z):
+            payload = self._recv_exact(ln)
+            if tag == TAG_OBJ_Z:
+                payload = zlib.decompress(payload)
+            return pickle.loads(payload)
+        if tag in (TAG_ARRAY, TAG_ARRAY_Z):
             (hlen,) = struct.unpack("<I", bytes(self._recv_exact(4)))
             dtype_str, shape = pickle.loads(self._recv_exact(hlen))
-            nbytes = ln - 4 - hlen
-            buf = self._recv_exact(nbytes)
-            return np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
+            buf = self._recv_exact(ln - 4 - hlen)
+            if tag == TAG_ARRAY_Z:
+                # bytearray keeps the received array writable, like the
+                # uncompressed path's recv_into buffer
+                buf = bytearray(zlib.decompress(buf))
+            try:
+                dt = np.dtype(dtype_str)
+            except TypeError:
+                import ml_dtypes  # noqa: F401 - registers extension names
+
+                dt = np.dtype(dtype_str)
+            return np.frombuffer(buf, dtype=dt).reshape(shape)
         raise Mp4jError(f"unknown frame tag {tag}")
 
     def recv_array(self) -> np.ndarray:
