@@ -1,0 +1,46 @@
+"""The CPU socket reference path: a self-contained master + 4 slaves job
+on loopback TCP (in threads here; in production each slave is its own
+process pointed at the master's host:port, see README)."""
+import threading
+
+import numpy as np
+
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+N = 4
+master = Master(N, timeout=30.0).serve_in_thread()
+
+
+def slave_main():
+    s = ProcessCommSlave("127.0.0.1", master.port, timeout=30.0)
+    s.info(f"slave {s.rank}/{s.slave_num} up")
+
+    # the reference's recursive-halving allreduce (default algo="rhd")
+    arr = np.full(1000, float(s.rank + 1))
+    s.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+    assert arr[0] == sum(range(1, N + 1))
+
+    # compressed operand: zlib on the wire for compressible payloads
+    zeros = np.zeros(100_000)
+    s.allreduce_array(zeros, Operands.compressed(Operands.DOUBLE),
+                      Operators.SUM)
+
+    # sparse map allreduce (pickle standing in for Kryo)
+    d = {f"grad:{s.rank % 2}": float(s.rank)}
+    s.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+    s.barrier()
+    s.info(f"done: {sorted(d.items())}")
+    s.close(0)
+
+
+threads = [threading.Thread(target=slave_main) for _ in range(N)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+master.join()
+print("job exit code:", master.final_code)

@@ -1,0 +1,38 @@
+"""Hybrid process x thread nesting (the reference's ThreadCommSlave):
+threads reduce through shared memory, thread 0 runs the process-level
+collective, results fan back out. Here: one process, 4 threads (pass
+master args to spawn_group to join a multi-process job)."""
+import threading
+
+import numpy as np
+
+from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+T = 4
+slaves = ThreadCommSlave.spawn_group(T)  # standalone thread group
+
+
+def thread_main(slave):
+    r = slave.rank
+    arr = np.full(100, float(r), np.float32)
+    slave.allreduce_array(arr, Operands.FLOAT, Operators.SUM)
+    assert arr[0] == sum(range(T))
+
+    slave.thread_barrier()               # the reference's threadBarrier()
+
+    d = {f"k{r}": float(r)}
+    slave.allgather_map(d, Operands.DOUBLE)
+    assert len(d) == T
+
+    slave.close(0)
+    return arr
+
+
+threads = [threading.Thread(target=thread_main, args=(s,)) for s in slaves]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+print("hybrid group done")

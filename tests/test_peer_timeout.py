@@ -1,0 +1,56 @@
+"""Dead-peer diagnosability: peer_timeout turns the reference's
+fail-stop hang into a clean Mp4jError (SURVEY.md section 5)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+
+def test_dead_peer_raises_instead_of_hanging():
+    master = Master(2, timeout=30.0).serve_in_thread()
+    outcome = {}
+
+    def worker():
+        # timeout bounds peer-connect waits too; keep both short so the
+        # dead peer surfaces quickly whichever phase it dies in
+        slave = ProcessCommSlave("127.0.0.1", master.port, timeout=4.0,
+                                 peer_timeout=1.5)
+        if slave.rank == 1:
+            # defect without participating in the collective
+            slave.close(1)
+            return
+        arr = np.ones(64, np.float32)
+        try:
+            slave.allreduce_array(arr, Operands.FLOAT, Operators.SUM)
+            outcome["err"] = None
+        except Mp4jError as e:
+            outcome["err"] = str(e)
+        slave.close(0)
+
+    ts = [threading.Thread(target=worker, daemon=True) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20)
+        assert not t.is_alive(), "collective hung despite peer_timeout"
+    assert outcome["err"] is not None, "dead peer must surface as Mp4jError"
+    master.join(10)
+    assert master.final_code == 1  # rank 1's defect code aggregates
+
+
+def test_default_is_reference_failstop():
+    """Without peer_timeout the channel has no receive deadline (the
+    reference's fail-stop semantics)."""
+    s = ProcessCommSlave.__new__(ProcessCommSlave)
+    assert "peer_timeout" in ProcessCommSlave.__init__.__doc__
+    import inspect
+
+    sig = inspect.signature(ProcessCommSlave.__init__)
+    assert sig.parameters["peer_timeout"].default is None

@@ -53,8 +53,13 @@ class ProcessCommSlave(CommSlave):
 
     def __init__(self, master_host: str, master_port: int,
                  listen_host: str = "127.0.0.1",
-                 timeout: float | None = 120.0):
+                 timeout: float | None = 120.0,
+                 peer_timeout: float | None = None):
+        """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
+        the reference's fail-stop hang) bounds each peer receive during
+        collectives, turning a dead peer into an Mp4jError."""
         self._timeout = timeout
+        self._peer_timeout = peer_timeout
         # own listen socket on an ephemeral port
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -144,6 +149,7 @@ class ProcessCommSlave(CommSlave):
                 # accept loop must survive to serve the healthy peers
                 sock.close()
                 continue
+            ch.set_timeout(self._peer_timeout)
             with self._peer_cv:
                 self._peers[peer_rank] = ch
                 self._peer_cv.notify_all()
@@ -164,6 +170,7 @@ class ProcessCommSlave(CommSlave):
                 host, port = self._roster[peer]
                 ch = connect(host, port, timeout=self._timeout)
                 ch.send_obj(self._rank)
+                ch.set_timeout(self._peer_timeout)
                 self._peers[peer] = ch
                 self._peer_cv.notify_all()
                 return ch

@@ -201,8 +201,12 @@ class ThreadCommSlave(CommSlave):
     # ------------------------------------------------------------------
     def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
                         operator: Operator = Operators.SUM,
-                        from_: int = 0, to: int | None = None):
-        """Intra-process tree into thread 0, process allreduce, fan out."""
+                        from_: int = 0, to: int | None = None,
+                        algo: str = "rhd"):
+        """Intra-process tree into thread 0, process allreduce, fan out.
+
+        ``algo`` selects the process-level algorithm (recursive
+        halving/doubling or ring), as on ProcessCommSlave."""
         hi = to if to is not None else len(arr)
         lo = from_
 
@@ -217,7 +221,8 @@ class ThreadCommSlave(CommSlave):
             for s in slots[1:]:
                 self._merge_into(operator, acc, s)
             if self._g.proc is not None:
-                self._g.proc.allreduce_array(acc, operand, operator)
+                self._g.proc.allreduce_array(acc, operand, operator,
+                                             algo=algo)
             return acc
 
         def collect(result):

@@ -69,12 +69,23 @@ class Channel:
         for b in bufs:
             self.sock.sendall(b)
 
+    def set_timeout(self, timeout: float | None) -> None:
+        """Receive timeout. ``None`` (default) is the reference's
+        fail-stop behavior — a dead peer blocks forever; a finite value
+        turns that hang into a diagnosable Mp4jError."""
+        self.sock.settimeout(timeout)
+
     def _recv_exact(self, n: int) -> bytearray:
         out = bytearray(n)
         view = memoryview(out)
         got = 0
         while got < n:
-            r = self.sock.recv_into(view[got:], n - got)
+            try:
+                r = self.sock.recv_into(view[got:], n - got)
+            except socket.timeout:
+                raise Mp4jError(
+                    f"receive timed out with {n - got} bytes pending "
+                    "(peer dead or stalled?)") from None
             if r == 0:
                 raise Mp4jError("peer closed connection mid-message")
             got += r
