@@ -6,7 +6,7 @@ gradient/hessian histogram build + allreduce (ytk-learn GBDT shape:
 F=28 features, 256 bins, depth-6 trees, Higgs-like synthetic data) — on:
 
 1. the TPU path: one jitted shard_map step per tree over the available
-   chip(s) (feature-pair-packed scatter histograms + psum allreduce);
+   chip(s) (one-hot MXU matmul histograms + psum allreduce);
 2. the CPU socket baseline: the same tree build with numpy histograms
    and the histogram allreduce over real loopback TCP via
    ProcessCommSlave ring collectives (the reference's architecture).
@@ -20,12 +20,13 @@ Metric (GB/s/chip): bytes of training data scanned per histogram pass
 chip — a rate, so the two paths may use different N. vs_baseline is the
 TPU rate over the socket rate.
 
-TPU context (measured, see models/gbdt.py): histogram building is bound
-by the chip's serial scatter unit at ~7.6 ns/element, so the single-chip
-end-to-end edge over a CPU core is modest; the library's >=10x north
-star lives in the COLLECTIVE (psum over ICI vs Kryo-socket rounds),
-which this harness also reports (socket allreduce GB/s in extras) and
-which scales with chips while the socket ring does not.
+TPU context (measured, see models/gbdt.py): scatter histograms are
+bound by the chip's serial scatter unit (~13 ns/element); the default
+"matmul" strategy routes the build onto the MXU instead (tiled one-hot
+matmul, hi/lo bf16 split), a measured ~6x end-to-end — single-chip
+end-to-end clears 10x over the socket baseline. The collective itself
+(psum over ICI vs Kryo-socket rounds, socket allreduce GB/s in extras)
+additionally scales with chips while the socket ring does not.
 
 Prints exactly one JSON line.
 """

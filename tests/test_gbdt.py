@@ -37,22 +37,24 @@ def test_histograms_match_numpy(rng):
     np.testing.assert_allclose(np.asarray(hh), want_h, rtol=1e-4, atol=1e-4)
 
 
-def test_pair_and_flat_histograms_agree(rng):
-    """The pair-packed scatter strategy (halved elements, see the TPU
-    performance note in models/gbdt.py) must match flat and numpy."""
-    N, F, B = 300, 6, 8
+def test_hist_strategies_agree(rng):
+    """The pair-packed scatter and one-hot matmul strategies (see the
+    TPU performance note in models/gbdt.py) must match flat and numpy —
+    N=1500 > _MATMUL_TILE also exercises the matmul path's
+    non-tile-multiple padding (T=2 tiles, 548 pad rows)."""
+    N, F, B = 1500, 6, 8
     bins = rng.integers(0, B, (N, F)).astype(np.int32)
     g = rng.standard_normal(N).astype(np.float32)
     h = np.ones(N, np.float32)
     node_ids = rng.integers(0, 4, N).astype(np.int32)
     outs = {}
-    for mode in ("pair", "flat"):
+    for mode in ("matmul", "pair", "flat"):
         cfg = GBDTConfig(n_features=F, n_bins=B, hist_mode=mode)
         outs[mode] = build_histograms(
             jnp.array(bins), jnp.array(g), jnp.array(h),
             jnp.array(node_ids), 4, cfg)
     want_g, want_h = np_histograms(bins, g, h, node_ids, 4, F, B)
-    for mode in ("pair", "flat"):
+    for mode in ("matmul", "pair", "flat"):
         np.testing.assert_allclose(np.asarray(outs[mode][0]), want_g,
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(np.asarray(outs[mode][1]), want_h,
