@@ -20,13 +20,18 @@ Metric (GB/s/chip): bytes of training data scanned per histogram pass
 chip — a rate, so the two paths may use different N. vs_baseline is the
 TPU rate over the socket rate.
 
-TPU context (measured, see models/gbdt.py): scatter histograms are
-bound by the chip's serial scatter unit (~13 ns/element); the default
-"matmul" strategy routes the build onto the MXU instead (tiled one-hot
-matmul, hi/lo bf16 split), a measured ~6x end-to-end — single-chip
-end-to-end clears 10x over the socket baseline. The collective itself
-(psum over ICI vs Kryo-socket rounds, socket allreduce GB/s in extras)
+TPU context (measured, see models/gbdt.py + ops/hist_kernel.py):
+scatter histograms are bound by the chip's serial scatter unit
+(~13 ns/element); the "matmul" strategy routes the build onto the MXU
+instead (tiled one-hot matmul, hi/lo bf16 split), ~6x end-to-end; the
+default "pallas" strategy fuses the one-hot generation and the matmul
+in VMEM, a further ~26% (measured 170 vs 230 ms/tree on v5e) — near
+the VPU floor of the one-hot generation itself. The collective (psum
+over ICI vs Kryo-socket rounds, socket allreduce GB/s in extras)
 additionally scales with chips while the socket ring does not.
+The timed loop chains ``trees`` steps per host sync because the axon
+tunnel costs ~100 ms per round-trip + ~2 ms per dispatch (measured);
+small-rep timings are dominated by that, not device work.
 
 Prints exactly one JSON line.
 """
@@ -52,7 +57,7 @@ def scanned_bytes(n, f, depth):
 
 
 # ----------------------------------------------------------------------
-def bench_tpu(n=1_000_000, f=28, b=256, depth=6, trees=2):
+def bench_tpu(n=1_000_000, f=28, b=256, depth=6, trees=10):
     import jax
     from ytk_mp4j_tpu.models.gbdt import GBDTConfig, GBDTTrainer
 
@@ -179,9 +184,11 @@ def main():
             "socket_collective_gbs": round(sock_coll_gbs, 4),
             "n_chips": n_chips,
             "config": "Higgs-like synthetic, F=28, B=256, depth=6, "
-                      "N_tpu=1e6, N_socket=2e5/4 procs; timing closed "
-                      "by host round-trip (honest under axon's "
-                      "non-blocking block_until_ready)",
+                      "N_tpu=1e6, N_socket=2e5/4 procs; 10 chained "
+                      "trees per host sync (amortizes the ~100ms axon "
+                      "tunnel round-trip); timing closed by host "
+                      "round-trip (honest under axon's non-blocking "
+                      "block_until_ready)",
         },
     }))
 

@@ -48,13 +48,13 @@ def test_hist_strategies_agree(rng):
     h = np.ones(N, np.float32)
     node_ids = rng.integers(0, 4, N).astype(np.int32)
     outs = {}
-    for mode in ("matmul", "pair", "flat"):
+    for mode in ("pallas", "matmul", "pair", "flat"):
         cfg = GBDTConfig(n_features=F, n_bins=B, hist_mode=mode)
         outs[mode] = build_histograms(
             jnp.array(bins), jnp.array(g), jnp.array(h),
             jnp.array(node_ids), 4, cfg)
     want_g, want_h = np_histograms(bins, g, h, node_ids, 4, F, B)
-    for mode in ("matmul", "pair", "flat"):
+    for mode in ("pallas", "matmul", "pair", "flat"):
         np.testing.assert_allclose(np.asarray(outs[mode][0]), want_g,
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(np.asarray(outs[mode][1]), want_h,

@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.models._base import DataParallelTrainer
+from ytk_mp4j_tpu.ops.hist_kernel import split_bf16
 
 
 @dataclass(frozen=True)
@@ -45,17 +46,19 @@ class GBDTConfig:
     learning_rate: float = 0.1
     reg_lambda: float = 1.0
     n_trees: int = 10
-    # "matmul": one-hot MXU matmul histograms (default, ~5x the scatter
-    # strategies on v5e — see the performance note below); "pair":
-    # feature-pair joint scatter histograms (exact in f32, the
-    # differential oracle); "flat": one scatter per feature
-    hist_mode: str = "matmul"
+    # "pallas": fused one-hot MXU matmul in VMEM (default; ~25% over
+    # "matmul", see ops/hist_kernel.py); "matmul": XLA one-hot MXU
+    # matmul (~5x the scatter strategies on v5e — see the performance
+    # note below; also the fallback when the pallas constraints don't
+    # hold); "pair": feature-pair joint scatter histograms (exact in
+    # f32, the differential oracle); "flat": one scatter per feature
+    hist_mode: str = "pallas"
 
     def __post_init__(self):
-        if self.hist_mode not in ("matmul", "pair", "flat"):
+        if self.hist_mode not in ("pallas", "matmul", "pair", "flat"):
             raise ValueError(
-                f"hist_mode must be 'matmul', 'pair' or 'flat', "
-                f"got {self.hist_mode!r}")
+                f"hist_mode must be 'pallas', 'matmul', 'pair' or "
+                f"'flat', got {self.hist_mode!r}")
 
 
 # ----------------------------------------------------------------------
@@ -85,39 +88,48 @@ class GBDTConfig:
 _MATMUL_TILE = 1024  # contraction tile; OH tile = tile*F*B*2 bytes in VMEM
 
 
-def build_histograms(bins, g, h, node_ids, n_nodes: int, cfg: GBDTConfig):
+def build_histograms(bins, g, h, node_ids, n_nodes: int, cfg: GBDTConfig,
+                     interpret: bool | None = None):
     """Per-(node, feature, bin) gradient/hessian sums.
 
     bins: [N, F] int32 (values in [0, B)); g, h: [N] f32;
     node_ids: [N] int32 in [0, n_nodes).
     Returns (hist_g, hist_h): [n_nodes, F, B] f32.
 
-    Strategy "matmul" (default): one-hot MXU matmul per tile (see the
-    performance note). Strategy "pair" (when F is even and the joint
-    table fits): one scatter of N*F/2 elements into per-feature-PAIR
-    joint (B x B) histograms, then marginalize. Strategy "flat": one
-    scatter of N*F elements (the fallback, and the shape the socket
-    baseline mirrors).
+    Strategy "pallas" (default): the fused VMEM one-hot MXU kernel
+    (ops/hist_kernel.py); falls back to "matmul" when the kernel's
+    lane-alignment constraints don't hold on a compiled backend.
+    ``interpret`` selects the kernel's interpret mode (None: interpret
+    unless running on TPU — the CPU test suite and the driver's virtual
+    CPU meshes take the interpreted path). Strategy "matmul": XLA
+    one-hot MXU matmul per tile (see the performance note). Strategy
+    "pair" (when F is even and the joint table fits): one scatter of
+    N*F/2 elements into per-feature-PAIR joint (B x B) histograms, then
+    marginalize. Strategy "flat": one scatter of N*F elements (the
+    fallback, and the shape the socket baseline mirrors).
     """
     F, B = cfg.n_features, cfg.n_bins
+    if cfg.hist_mode == "pallas":
+        from ytk_mp4j_tpu.ops.hist_kernel import (pallas_hist_supported,
+                                                  pallas_histograms)
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        # the pallas HLO interpreter is not vma-aware, so interpreting
+        # inside shard_map trips check_vma; the matmul strategy is the
+        # semantically identical stand-in there (CPU test meshes)
+        under_shard_map = bool(getattr(jax.typeof(g), "vma", None))
+        if interpret and not under_shard_map:
+            return pallas_histograms(bins, g, h, node_ids, n_nodes, F, B,
+                                     interpret=True)
+        if not interpret and pallas_hist_supported(B, F, n_nodes):
+            return pallas_histograms(bins, g, h, node_ids, n_nodes, F, B)
+        return _build_histograms_matmul(bins, g, h, node_ids, n_nodes, cfg)
     if cfg.hist_mode == "matmul":
         return _build_histograms_matmul(bins, g, h, node_ids, n_nodes, cfg)
     joint_mb = n_nodes * (F // 2) * B * B * 4 * 2 / 1e6
     if cfg.hist_mode == "pair" and F % 2 == 0 and joint_mb <= 1024:
         return _build_histograms_pair(bins, g, h, node_ids, n_nodes, cfg)
     return _build_histograms_flat(bins, g, h, node_ids, n_nodes, cfg)
-
-
-def _split_bf16(a):
-    """Split f32 ``a`` into bf16 (hi, lo) with ``hi + lo ~= a`` to ~24
-    bits. ``hi`` zeroes the low 16 mantissa bits via bit-masking — NOT
-    ``a - f32(bf16(a))``, which XLA's algebraic simplifier folds to
-    zero — so ``lo = a - hi`` is exact in f32 and only rounds at the
-    final bf16 cast (<= 2^-17 relative)."""
-    hi = lax.bitcast_convert_type(
-        lax.bitcast_convert_type(a, jnp.uint32) & jnp.uint32(0xFFFF0000),
-        jnp.float32)
-    return hi.astype(jnp.bfloat16), (a - hi).astype(jnp.bfloat16)
 
 
 def _build_histograms_matmul(bins, g, h, node_ids, n_nodes, cfg):
@@ -141,7 +153,7 @@ def _build_histograms_matmul(bins, g, h, node_ids, n_nodes, cfg):
         noh = nt[:, None] == iota_n
 
         def amat(v):
-            hi, lo = _split_bf16(jnp.where(noh, v[:, None], 0.0))
+            hi, lo = split_bf16(jnp.where(noh, v[:, None], 0.0))
             return jnp.concatenate([hi, lo], 1)       # [tile, 2*n_nodes]
 
         A = jnp.concatenate([amat(gt), amat(ht)], 1)  # [tile, 4*n_nodes]
@@ -226,7 +238,7 @@ def best_splits(hist_g, hist_h, reg_lambda: float):
 # one boosting round (tree build) — per-shard body
 # ----------------------------------------------------------------------
 def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
-                     weights=None):
+                     weights=None, interpret=None):
     """Build one tree on this shard's samples; histogram-allreduce across
     ``axis_name`` (None = single device). Returns (new_preds, tree).
 
@@ -254,7 +266,8 @@ def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
     level_start = 0
     for d in range(cfg.depth):          # depth static -> unrolled
         n_nodes = 2 ** d
-        hg, hh = build_histograms(bins, g, h, node_ids, n_nodes, cfg)
+        hg, hh = build_histograms(bins, g, h, node_ids, n_nodes, cfg,
+                                  interpret=interpret)
         if axis_name is not None:
             hg = lax.psum(hg, axis_name)     # THE histogram allreduce
             hh = lax.psum(hh, axis_name)
@@ -310,13 +323,17 @@ class GBDTTrainer(DataParallelTrainer):
         cfg = self.cfg
         axes = self.axes
         spec = P(axes)
+        # the pallas kernel compiles only on TPU meshes; interpret it on
+        # the virtual CPU meshes the tests and the driver dry-run use
+        interpret = self.mesh.devices.flat[0].platform != "tpu"
 
         @partial(jax.shard_map, mesh=self.mesh,
                  in_specs=(spec, spec, spec, spec),
                  out_specs=(spec, P(None)))
         def step(bins, y, preds, weights):
             new_preds, tree = train_tree_shard(
-                bins[0], y[0], preds[0], cfg, axes, weights=weights[0])
+                bins[0], y[0], preds[0], cfg, axes, weights=weights[0],
+                interpret=interpret)
             return new_preds[None], tree
 
         return jax.jit(step)
