@@ -94,10 +94,16 @@ def _numpy_histograms(bins, g, h, node_ids, n_nodes, f, b):
     return hg, hh
 
 
-def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4):
+def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4,
+                 native_transport=False):
     """The reference-architecture baseline: numpy histogram build + ring
     allreduce of the histogram buffers over loopback TCP. Also returns
-    the pure collective rate (allreduce GB/s of the histogram buffers)."""
+    the pure collective rate (allreduce GB/s of the histogram buffers).
+
+    ``native_transport=False`` is the FROZEN baseline: the fully framed
+    per-message path mirroring the reference's Kryo-framed JVM sockets.
+    True measures our native C++ raw data plane (reported in extras,
+    not used as the comparison baseline)."""
     from ytk_mp4j_tpu.comm.master import Master
     from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
     from ytk_mp4j_tpu.operands import Operands
@@ -112,7 +118,8 @@ def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4):
 
     def worker():
         try:
-            slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0)
+            slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
+                                     native_transport=native_transport)
             r = slave.rank
             lb = bins[r * per:(r + 1) * per]
             ly = y[r * per:(r + 1) * per]
@@ -170,9 +177,54 @@ def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4):
     return scanned_bytes(n, f, depth) / dt / 1e9, cbytes / csecs / 1e9
 
 
+def bench_socket_collective(f=28, b=256, depth=6, procs=4, reps=3,
+                            native_transport=True):
+    """Allreduce rate alone over the tree-level histogram buffer shapes
+    (no numpy histogram/split work — used for the native-transport
+    extras figure without re-running the whole socket workload)."""
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    sizes = [2 * (2 ** d) * f * b for d in range(depth)]
+    master = Master(procs, timeout=60.0).serve_in_thread()
+    rates = [None] * procs
+    errors = []
+
+    def worker():
+        try:
+            slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
+                                     native_transport=native_transport)
+            bufs = [np.ones(s, np.float32) for s in sizes]
+            slave.barrier()
+            t0 = time.perf_counter()
+            nbytes = 0
+            for _ in range(reps):
+                for buf in bufs:
+                    slave.allreduce_array(buf, Operands.FLOAT,
+                                          Operators.SUM)
+                    nbytes += buf.nbytes
+            rates[slave.rank] = nbytes / (time.perf_counter() - t0)
+            slave.close(0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(procs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    if errors:
+        raise errors[0]
+    return min(rates) / 1e9
+
+
 def main():
     tpu_gbs, trees_per_sec, n_chips = bench_tpu()
     sock_gbs, sock_coll_gbs = bench_socket()
+    sock_native_coll_gbs = bench_socket_collective(native_transport=True)
     print(json.dumps({
         "metric": "gbdt-histogram-allreduce GB/s/chip",
         "value": round(tpu_gbs, 4),
@@ -182,6 +234,7 @@ def main():
             "trees_per_sec": round(trees_per_sec, 4),
             "socket_baseline_gbs": round(sock_gbs, 4),
             "socket_collective_gbs": round(sock_coll_gbs, 4),
+            "socket_native_collective_gbs": round(sock_native_coll_gbs, 4),
             "n_chips": n_chips,
             "config": "Higgs-like synthetic, F=28, B=256, depth=6, "
                       "N_tpu=1e6, N_socket=2e5/4 procs; 10 chained "

@@ -23,10 +23,11 @@ def make_inputs(n, length, operand, rng):
             for _ in range(n)]
 
 
-def run_slaves(n, fn, timeout=60.0):
+def run_slaves(n, fn, timeout=60.0, **slave_kwargs):
     """Start a master + n slave threads; fn(slave, rank) runs per rank.
     Returns per-rank results; raises the first slave error; asserts the
-    master's aggregate exit code is 0."""
+    master's aggregate exit code is 0. ``slave_kwargs`` are forwarded to
+    every ProcessCommSlave (e.g. native_transport=False)."""
     master = Master(n, timeout=timeout).serve_in_thread()
     results = [None] * n
     errors = []
@@ -35,7 +36,7 @@ def run_slaves(n, fn, timeout=60.0):
         slave = None
         try:
             slave = ProcessCommSlave("127.0.0.1", master.port,
-                                     timeout=timeout)
+                                     timeout=timeout, **slave_kwargs)
             results[slave.rank] = fn(slave, slave.rank)
             slave.close(0)
         except Exception as e:  # pragma: no cover - surfaced via errors

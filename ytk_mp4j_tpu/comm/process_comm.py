@@ -54,12 +54,23 @@ class ProcessCommSlave(CommSlave):
     def __init__(self, master_host: str, master_port: int,
                  listen_host: str = "127.0.0.1",
                  timeout: float | None = 120.0,
-                 peer_timeout: float | None = None):
+                 peer_timeout: float | None = None,
+                 native_transport: bool = True):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
-        collectives, turning a dead peer into an Mp4jError."""
+        collectives, turning a dead peer into an Mp4jError.
+
+        ``native_transport`` enables the raw (unframed) data plane for
+        numeric uncompressed operands — the C++ poll loop when the
+        native library builds, a wire-identical pure-Python raw path
+        otherwise. It is a JOB-wide wire-protocol choice: every slave in
+        a job must pass the same value (the raw/framed decision must
+        match on both ends of every exchange). False keeps the fully
+        framed Python path — the frozen reference baseline bench.py
+        measures against."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
+        self._native_transport = native_transport
         # own listen socket on an ephemeral port
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -200,6 +211,66 @@ class ProcessCommSlave(CommSlave):
         return out
 
     # ------------------------------------------------------------------
+    # raw (unframed) data plane
+    #
+    # The numeric fast path: segment sizes are derived from collective
+    # metadata on both ends, so no framing travels on the wire (the
+    # reference's primitive DataOutputStream path, SURVEY.md section 2).
+    # Whether an exchange is raw must be a pure function of job-wide
+    # call parameters — operand properties and the job's
+    # native_transport flag — NEVER of local library availability, or
+    # ranks would disagree about the wire format. The C++ poll loop
+    # (csrc/mp4j_transport.cpp) moves the bytes when available; the
+    # Python fallback produces identical wire bytes.
+    # ------------------------------------------------------------------
+    def _raw_ok(self, operand: Operand) -> bool:
+        return (self._native_transport and operand.is_numeric
+                and not operand.compress)
+
+    def _exchange_raw(self, send_peer: int, recv_peer: int,
+                      sarr: np.ndarray | None, rarr: np.ndarray | None):
+        """Full-duplex raw exchange; either side may be absent (None)."""
+        send_ch = self._channel(send_peer) if sarr is not None else None
+        recv_ch = self._channel(recv_peer) if rarr is not None else None
+        if sarr is not None:
+            sarr = np.ascontiguousarray(sarr)
+        sides = " ".join(
+            ([f"send->{send_peer}"] if sarr is not None else [])
+            + ([f"recv<-{recv_peer}"] if rarr is not None else []))
+        try:
+            done = native.sendrecv_raw(
+                (send_ch or recv_ch).sock.fileno(),
+                (recv_ch or send_ch).sock.fileno(),
+                sarr, rarr, self._peer_timeout)
+            if done:
+                return
+            # pure-Python fallback: helper thread sends while we receive
+            fut = (self._pool.submit(send_ch.send_raw, sarr)
+                   if sarr is not None else None)
+            if rarr is not None:
+                recv_ch.recv_raw_into(rarr)
+            if fut is not None:
+                fut.result()
+        except Mp4jError as e:
+            raise Mp4jError(f"raw exchange ({sides}) failed: {e}") from None
+
+    def _recv_buf(self, operand: Operand, n: int) -> np.ndarray:
+        return np.empty(n, dtype=operand.dtype)
+
+    def _exchange_raw_into(self, send_peer: int, recv_peer: int,
+                           sarr: np.ndarray | None, rview: np.ndarray,
+                           operand: Operand) -> np.ndarray:
+        """Raw exchange receiving into ``rview`` (via a temp when the
+        view is not directly receivable — contiguity is a LOCAL detail
+        and must not influence the shared raw/framed decision)."""
+        direct = rview.flags.c_contiguous and rview.flags.writeable
+        rbuf = rview if direct else self._recv_buf(operand, rview.size)
+        self._exchange_raw(send_peer, recv_peer, sarr, rbuf)
+        if not direct:
+            rview[:] = rbuf
+        return rbuf
+
+    # ------------------------------------------------------------------
     # dense-array helpers
     # ------------------------------------------------------------------
     @staticmethod
@@ -257,7 +328,7 @@ class ProcessCommSlave(CommSlave):
             return self._rhd_allreduce(arr, operand, operator, lo, hi)
         segs = meta.partition_range(lo, hi, self._n)
         self._ring_reduce_scatter(arr, segs, operand, operator)
-        self._ring_allgather(arr, segs, compress=operand.compress)
+        self._ring_allgather(arr, segs, operand)
         return arr
 
     # -- recursive halving/doubling (Rabenseifner), SURVEY.md 3b --------
@@ -278,18 +349,28 @@ class ProcessCommSlave(CommSlave):
           folded partner.
         """
         n, r = self._n, self._rank
+        raw = self._raw_ok(operand)
         p = 1
         while p * 2 <= n:
             p *= 2
         extra = n - p
 
         if r >= p:  # folded rank: contribute, then wait for the result
-            self._send(r - p, np.ascontiguousarray(arr[lo:hi]),
-                       compress=operand.compress)
-            arr[lo:hi] = self._recv(r - p)
+            if raw:
+                self._exchange_raw(r - p, r - p, arr[lo:hi], None)
+                self._exchange_raw_into(r - p, r - p, None, arr[lo:hi],
+                                        operand)
+            else:
+                self._send(r - p, np.ascontiguousarray(arr[lo:hi]),
+                           compress=operand.compress)
+                arr[lo:hi] = self._recv(r - p)
             return arr
         if r < extra:  # fold partner: merge the extra rank's data
-            recv = self._recv(r + p)
+            if raw:
+                recv = self._recv_buf(operand, hi - lo)
+                self._exchange_raw(r + p, r + p, None, recv)
+            else:
+                recv = self._recv(r + p)
             native.reduce_into(operator, arr[lo:hi], np.asarray(recv))
 
         vr = r
@@ -311,9 +392,13 @@ class ProcessCommSlave(CommSlave):
                 give = (block0 + dist, block0 + 2 * dist)
             gs, ge = span(*give)
             ks, ke = span(*keep)
-            recv = self._sendrecv(partner, partner,
-                                  np.ascontiguousarray(arr[gs:ge]),
-                                  compress=operand.compress)
+            if raw:
+                recv = self._recv_buf(operand, ke - ks)
+                self._exchange_raw(partner, partner, arr[gs:ge], recv)
+            else:
+                recv = self._sendrecv(partner, partner,
+                                      np.ascontiguousarray(arr[gs:ge]),
+                                      compress=operand.compress)
             native.reduce_into(operator, arr[ks:ke], np.asarray(recv))
             dist >>= 1
 
@@ -325,15 +410,22 @@ class ProcessCommSlave(CommSlave):
             tb0 = (partner // dist) * dist
             ms, me = span(mb0, mb0 + dist)
             ts, te = span(tb0, tb0 + dist)
-            recv = self._sendrecv(partner, partner,
-                                  np.ascontiguousarray(arr[ms:me]),
-                                  compress=operand.compress)
-            arr[ts:te] = recv
+            if raw:
+                self._exchange_raw_into(partner, partner, arr[ms:me],
+                                        arr[ts:te], operand)
+            else:
+                recv = self._sendrecv(partner, partner,
+                                      np.ascontiguousarray(arr[ms:me]),
+                                      compress=operand.compress)
+                arr[ts:te] = recv
             dist *= 2
 
         if r < extra:  # unfold: ship the finished range back
-            self._send(r + p, np.ascontiguousarray(arr[lo:hi]),
-                       compress=operand.compress)
+            if raw:
+                self._exchange_raw(r + p, r + p, arr[lo:hi], None)
+            else:
+                self._send(r + p, np.ascontiguousarray(arr[lo:hi]),
+                           compress=operand.compress)
         return arr
 
     def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,
@@ -368,7 +460,7 @@ class ProcessCommSlave(CommSlave):
             ranges = meta.partition_range(0, len(arr), self._n)
         if self._n == 1:
             return arr
-        self._ring_allgather(arr, ranges, compress=operand.compress)
+        self._ring_allgather(arr, ranges, operand)
         return arr
 
     def _ring_reduce_scatter(self, arr, segs, operand, operator):
@@ -379,19 +471,25 @@ class ProcessCommSlave(CommSlave):
         contribution (native hot loop).
         """
         n, r = self._n, self._rank
+        raw = self._raw_ok(operand) and isinstance(arr, np.ndarray)
         right, left = (r + 1) % n, (r - 1) % n
         carry = None  # accumulated chunk in flight
         for s in range(n - 1):
             send_idx = (r - 1 - s) % n
             ss, se = segs[send_idx]
             out = carry if carry is not None else arr[ss:se]
-            recv = self._sendrecv(right, left, np.ascontiguousarray(out)
-                                  if isinstance(out, np.ndarray) else out,
-                                  compress=operand.compress)
             ri_s, ri_e = segs[(r - 2 - s) % n]
+            if raw:
+                recv = self._recv_buf(operand, ri_e - ri_s)
+                self._exchange_raw(right, left, out, recv)
+            else:
+                recv = self._sendrecv(right, left, np.ascontiguousarray(out)
+                                      if isinstance(out, np.ndarray) else out,
+                                      compress=operand.compress)
             local = arr[ri_s:ri_e]
             if isinstance(local, np.ndarray):
-                recv = np.asarray(recv).copy()
+                if not raw:
+                    recv = np.asarray(recv).copy()
                 native.reduce_into(operator, recv, local)
                 carry = recv
             else:
@@ -401,20 +499,25 @@ class ProcessCommSlave(CommSlave):
         arr[ms:me] = carry
         return arr
 
-    def _ring_allgather(self, arr, segs, compress: bool = False):
+    def _ring_allgather(self, arr, segs, operand: Operand):
         """After n-1 ring steps every rank holds all segments."""
         n, r = self._n, self._rank
+        raw = self._raw_ok(operand) and isinstance(arr, np.ndarray)
         right, left = (r + 1) % n, (r - 1) % n
         for s in range(n - 1):
             ss, se = segs[(r - s) % n]
             chunk = arr[ss:se]
-            recv = self._sendrecv(
-                right, left,
-                np.ascontiguousarray(chunk)
-                if isinstance(chunk, np.ndarray) else chunk,
-                compress=compress)
             rs, re = segs[(r - 1 - s) % n]
-            arr[rs:re] = recv
+            if raw:
+                self._exchange_raw_into(right, left, chunk, arr[rs:re],
+                                        operand)
+            else:
+                recv = self._sendrecv(
+                    right, left,
+                    np.ascontiguousarray(chunk)
+                    if isinstance(chunk, np.ndarray) else chunk,
+                    compress=operand.compress)
+                arr[rs:re] = recv
         return arr
 
     def reduce_array(self, arr, operand: Operand = Operands.FLOAT,

@@ -1,0 +1,170 @@
+// Native socket data plane for the CPU reference path.
+//
+// The reference's slaves move primitive-array segments over raw JVM
+// socket streams (SURVEY.md section 2 "Serialization": raw
+// DataOutputStream writes, no Kryo, for the primitive fast path). The
+// Python framed path (transport/channel.py) pays per-frame pickle +
+// per-call interpreter overhead and needs a helper thread to overlap
+// the send and receive sides of a ring/halving exchange. This file is
+// the native equivalent: a poll()-driven full-duplex raw exchange --
+// both directions progress in one thread, no framing, no copies.
+//
+// ABI: plain C. Sizes are NOT sent on the wire -- both peers derive
+// them from the collective's metadata (segment math), exactly like the
+// reference's primitive fast path. Callers must keep the raw/framed
+// decision a pure function of job-wide parameters so ranks never
+// disagree about the wire format.
+//
+// Return codes: 0 ok, -1 syscall error, -2 peer closed early,
+// -3 timeout. timeout_ms is an IDLE timeout, matching the framed
+// path's per-recv socket timeout: the deadline resets whenever bytes
+// move in either direction, so a slow-but-progressing transfer never
+// times out — only a stalled peer does.
+
+#include <cerrno>
+#include <cstdint>
+#include <ctime>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int64_t kChunk = 1 << 20;  // per-syscall cap, keeps poll honest
+
+int64_t now_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+class NonblockGuard {
+ public:
+  explicit NonblockGuard(int fd) : fd_(fd), flags_(fcntl(fd, F_GETFL, 0)) {
+    if (flags_ >= 0) fcntl(fd_, F_SETFL, flags_ | O_NONBLOCK);
+  }
+  ~NonblockGuard() {
+    if (flags_ >= 0) fcntl(fd_, F_SETFL, flags_);
+  }
+  bool ok() const { return flags_ >= 0; }
+
+ private:
+  int fd_;
+  int flags_;
+};
+
+// One progress attempt on a ready direction; updates *done.
+// Returns 0 on progress/EAGAIN, else a negative error code.
+int try_send(int fd, const char* buf, int64_t nbytes, int64_t* done) {
+  int64_t want = nbytes - *done;
+  if (want > kChunk) want = kChunk;
+  ssize_t w = write(fd, buf + *done, static_cast<size_t>(want));
+  if (w >= 0) {
+    *done += w;
+    return 0;
+  }
+  return (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) ? 0 : -1;
+}
+
+int try_recv(int fd, char* buf, int64_t nbytes, int64_t* done) {
+  int64_t want = nbytes - *done;
+  if (want > kChunk) want = kChunk;
+  ssize_t r = read(fd, buf + *done, static_cast<size_t>(want));
+  if (r > 0) {
+    *done += r;
+    return 0;
+  }
+  if (r == 0) return -2;  // orderly shutdown with bytes still pending
+  return (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) ? 0 : -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Full-duplex raw exchange: send sbytes from sbuf on send_fd while
+// receiving rbytes into rbuf from recv_fd. send_fd may equal recv_fd
+// (partner exchange on one socket) or differ (ring step).
+// timeout_ms < 0 means block forever (the reference's fail-stop mode).
+int mp4j_sendrecv_raw(int send_fd, int recv_fd, const void* sbuf,
+                      int64_t sbytes, void* rbuf, int64_t rbytes,
+                      int64_t timeout_ms) {
+  const char* sp = static_cast<const char*>(sbuf);
+  char* rp = static_cast<char*>(rbuf);
+  int64_t sdone = 0, rdone = 0;
+  int64_t deadline = timeout_ms >= 0 ? now_ms() + timeout_ms : -1;
+
+  NonblockGuard sg(send_fd);
+  if (!sg.ok()) return -1;
+  const bool same = send_fd == recv_fd;
+  NonblockGuard rg(same ? -1 : recv_fd);  // fcntl(-1) fails harmlessly
+  if (!same && !rg.ok()) return -1;
+
+  while (sdone < sbytes || rdone < rbytes) {
+    pollfd fds[2];
+    int nfds = 0;
+    if (same) {
+      fds[0].fd = send_fd;
+      fds[0].events = static_cast<short>(
+          (sdone < sbytes ? POLLOUT : 0) | (rdone < rbytes ? POLLIN : 0));
+      nfds = 1;
+    } else {
+      if (sdone < sbytes) {
+        fds[nfds].fd = send_fd;
+        fds[nfds].events = POLLOUT;
+        ++nfds;
+      }
+      if (rdone < rbytes) {
+        fds[nfds].fd = recv_fd;
+        fds[nfds].events = POLLIN;
+        ++nfds;
+      }
+    }
+    int wait = -1;
+    if (deadline >= 0) {
+      int64_t left = deadline - now_ms();
+      if (left <= 0) return -3;
+      wait = left > 1000000000 ? 1000000000 : static_cast<int>(left);
+    }
+    int pr = poll(fds, static_cast<nfds_t>(nfds), wait);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pr == 0) return -3;
+    const int64_t before = sdone + rdone;
+    for (int i = 0; i < nfds; ++i) {
+      short rev = fds[i].revents;
+      if (rev == 0) continue;
+      const bool is_send =
+          fds[i].fd == send_fd && (fds[i].events & POLLOUT) != 0;
+      const bool is_recv =
+          fds[i].fd == recv_fd && (fds[i].events & POLLIN) != 0;
+      if (is_recv && (rev & (POLLIN | POLLHUP | POLLERR)) &&
+          rdone < rbytes) {
+        int rc = try_recv(recv_fd, rp, rbytes, &rdone);
+        if (rc < 0) return rc;
+      }
+      if (is_send && (rev & POLLOUT) && sdone < sbytes) {
+        int rc = try_send(send_fd, sp, sbytes, &sdone);
+        if (rc < 0) return rc;
+      }
+      // POLLERR/POLLHUP with nothing readable: surface as closed/error
+      if ((rev & (POLLERR | POLLNVAL)) && !(rev & POLLIN)) return -1;
+      if ((rev & POLLHUP) && !(rev & POLLIN) && is_recv &&
+          rdone < rbytes) {
+        return -2;
+      }
+    }
+    if (deadline >= 0 && sdone + rdone > before) {
+      deadline = now_ms() + timeout_ms;  // progress resets idle timer
+    }
+  }
+  return 0;
+}
+
+// One-directional steps (fold/unfold) call mp4j_sendrecv_raw with a
+// null buffer on the inactive side; no separate entry points needed.
+
+}  // extern "C"

@@ -119,6 +119,29 @@ class Channel:
             body,
         )
 
+    # -- raw (unframed) fast path ----------------------------------------
+    # Sizes never travel on the wire: both peers derive them from the
+    # collective's segment metadata, like the reference's primitive
+    # DataOutputStream fast path. Used by ProcessCommSlave's numeric
+    # collectives (native poll loop when available, these when not).
+    def send_raw(self, arr: np.ndarray) -> None:
+        self.sock.sendall(_raw_view(arr))
+
+    def recv_raw_into(self, arr: np.ndarray) -> None:
+        view = memoryview(_raw_view(arr))
+        n = len(view)
+        got = 0
+        while got < n:
+            try:
+                r = self.sock.recv_into(view[got:], n - got)
+            except socket.timeout:
+                raise Mp4jError(
+                    f"receive timed out with {n - got} raw bytes pending "
+                    "(peer dead or stalled?)") from None
+            if r == 0:
+                raise Mp4jError("peer closed connection mid-message")
+            got += r
+
     # -- unified receive ------------------------------------------------
     def recv(self):
         hdr = self._recv_exact(_HDR.size)

@@ -5,10 +5,14 @@ mtime) and exposes
 
 - :func:`reduce_into` — ``acc = op(acc, src)`` element-wise, the socket
   path's merge hot loop,
-- :func:`merge_unique_u64` — sorted-u64 key union for the sparse map path.
+- :func:`merge_unique_u64` — sorted-u64 key union for the sparse map path,
+- :func:`sendrecv_raw` — the poll()-driven full-duplex raw socket
+  exchange (csrc/mp4j_transport.cpp), the native data plane under
+  ProcessCommSlave's numeric collectives (one-directional steps pass
+  None for the inactive side).
 
-Falls back to numpy transparently if the toolchain is unavailable; the
-active backend is reported by :data:`HAVE_NATIVE`.
+Falls back to numpy/pure-Python transparently if the toolchain is
+unavailable; the active backend is reported by :data:`HAVE_NATIVE`.
 """
 
 from __future__ import annotations
@@ -22,8 +26,10 @@ import numpy as np
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc", "mp4j_native.cpp")
-_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+_SRC = os.path.join(_CSRC, "mp4j_native.cpp")
+_SRCS = [_SRC, os.path.join(_CSRC, "mp4j_transport.cpp")]
+_BUILD_DIR = os.path.join(_CSRC, "build")
 _SO = os.path.join(_BUILD_DIR, "libmp4j_native.so")
 
 # Must match csrc/mp4j_native.cpp DType.
@@ -45,11 +51,12 @@ HAVE_NATIVE: bool | None = None
 
 def _build() -> str:
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    newest_src = max(os.path.getmtime(s) for s in _SRCS)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= newest_src:
         return _SO
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
-        _SRC, "-o", _SO + ".tmp",
+        *_SRCS, "-o", _SO + ".tmp",
     ]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(_SO + ".tmp", _SO)
@@ -78,6 +85,13 @@ def _load():
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p,
+        ]
+        lib.mp4j_sendrecv_raw.restype = ctypes.c_int
+        lib.mp4j_sendrecv_raw.argtypes = [
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64,
         ]
         _lib = lib
         HAVE_NATIVE = True
@@ -113,6 +127,45 @@ def reduce_into(operator, acc: np.ndarray, src: np.ndarray) -> None:
         if rc == 0:
             return
     np.copyto(acc, operator.np_fn(acc, src))
+
+
+_RAW_ERRORS = {
+    -1: "socket error during raw exchange",
+    -2: "peer closed connection mid-message",
+    -3: "raw exchange timed out (peer dead or stalled?)",
+}
+
+
+def _data_ptr(arr: np.ndarray | None):
+    if arr is None or arr.size == 0:
+        return None
+    return ctypes.c_void_p(arr.ctypes.data)
+
+
+def _nbytes(arr: np.ndarray | None) -> int:
+    return 0 if arr is None else arr.nbytes
+
+
+def sendrecv_raw(send_fd: int, recv_fd: int, sarr: np.ndarray | None,
+                 rarr: np.ndarray | None, timeout: float | None) -> bool:
+    """Full-duplex raw exchange via the native poll loop.
+
+    ``sarr`` must be C-contiguous (or None); ``rarr`` must be a writable
+    C-contiguous buffer (or None). Returns False when the native library
+    is unavailable (caller falls back to the Python raw path); raises
+    Mp4jError on wire failure. ``timeout=None`` blocks forever — the
+    reference's fail-stop behavior.
+    """
+    lib = _load()
+    if lib is None:
+        return False
+    timeout_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+    rc = lib.mp4j_sendrecv_raw(send_fd, recv_fd, _data_ptr(sarr),
+                               _nbytes(sarr), _data_ptr(rarr),
+                               _nbytes(rarr), timeout_ms)
+    if rc != 0:
+        raise Mp4jError(_RAW_ERRORS.get(rc, f"raw exchange failed ({rc})"))
+    return True
 
 
 def merge_unique_u64(a: np.ndarray, b: np.ndarray) -> np.ndarray:
