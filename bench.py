@@ -94,6 +94,43 @@ def _numpy_histograms(bins, g, h, node_ids, n_nodes, f, b):
     return hg, hh
 
 
+def _run_socket_job(procs, body, native_transport, join_timeout=300.0):
+    """Master + ``procs`` slave worker threads; ``body(slave, rank)``
+    returns a per-rank result. Raises the first worker error, or a
+    RuntimeError naming the hung ranks if any worker missed the join
+    deadline without raising."""
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+
+    master = Master(procs, timeout=60.0).serve_in_thread()
+    results = [None] * procs
+    errors = []
+
+    def worker():
+        try:
+            slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
+                                     native_transport=native_transport)
+            results[slave.rank] = body(slave, slave.rank)
+            slave.close(0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(procs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_timeout)
+    if errors:
+        raise errors[0]
+    if any(r is None for r in results):
+        hung = [i for i, r in enumerate(results) if r is None]
+        raise RuntimeError(
+            f"socket benchmark workers hung past the join timeout: "
+            f"ranks {hung}")
+    return results
+
+
 def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4,
                  native_transport=False):
     """The reference-architecture baseline: numpy histogram build + ring
@@ -104,74 +141,50 @@ def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4,
     per-message path mirroring the reference's Kryo-framed JVM sockets.
     True measures our native C++ raw data plane (reported in extras,
     not used as the comparison baseline)."""
-    from ytk_mp4j_tpu.comm.master import Master
-    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
     from ytk_mp4j_tpu.operands import Operands
     from ytk_mp4j_tpu.operators import Operators
 
     bins, y = make_data(n, f, b, seed=1)
     per = n // procs
-    master = Master(procs, timeout=60.0).serve_in_thread()
-    times = [None] * procs
-    coll = [None] * procs  # (bytes, seconds) of the allreduces alone
-    errors = []
 
-    def worker():
-        try:
-            slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
-                                     native_transport=native_transport)
-            r = slave.rank
-            lb = bins[r * per:(r + 1) * per]
-            ly = y[r * per:(r + 1) * per]
-            g = ly.copy()          # preds=0 -> g = -y up to sign; fine
-            h = np.ones_like(g)
-            node_ids = np.zeros(per, np.int32)
-            slave.barrier()
-            t0 = time.perf_counter()
-            lam = 1.0
-            cbytes = 0
-            csecs = 0.0
-            for d in range(depth):
-                n_nodes = 2 ** d
-                hg, hh = _numpy_histograms(lb, g, h, node_ids, n_nodes, f, b)
-                flat = np.concatenate([hg.reshape(-1), hh.reshape(-1)])
-                c0 = time.perf_counter()
-                slave.allreduce_array(flat, Operands.FLOAT, Operators.SUM)
-                csecs += time.perf_counter() - c0
-                cbytes += flat.nbytes
-                hg = flat[:hg.size].reshape(n_nodes, f, b)
-                hh = flat[hg.size:].reshape(n_nodes, f, b)
-                # split finding + routing (numpy mirror of the TPU path)
-                cg, ch = np.cumsum(hg, -1), np.cumsum(hh, -1)
-                Gt, Ht = cg[..., -1:], ch[..., -1:]
-                gain = (cg ** 2 / (ch + lam)
-                        + (Gt - cg) ** 2 / (Ht - ch + lam)
-                        - Gt ** 2 / (Ht + lam))
-                gain[..., -1] = -np.inf
-                best = gain.reshape(n_nodes, -1).argmax(-1)
-                feat, bin_ = best // b, best % b
-                v = np.take_along_axis(lb, feat[node_ids][:, None],
-                                       axis=1)[:, 0]
-                node_ids = node_ids * 2 + (v > bin_[node_ids])
-            times[slave.rank] = time.perf_counter() - t0
-            coll[slave.rank] = (cbytes, csecs)
-            slave.close(0)
-        except Exception as e:  # pragma: no cover
-            errors.append(e)
+    def body(slave, r):
+        lb = bins[r * per:(r + 1) * per]
+        ly = y[r * per:(r + 1) * per]
+        g = ly.copy()          # preds=0 -> g = -y up to sign; fine
+        h = np.ones_like(g)
+        node_ids = np.zeros(per, np.int32)
+        slave.barrier()
+        t0 = time.perf_counter()
+        lam = 1.0
+        cbytes = 0
+        csecs = 0.0
+        for d in range(depth):
+            n_nodes = 2 ** d
+            hg, hh = _numpy_histograms(lb, g, h, node_ids, n_nodes, f, b)
+            flat = np.concatenate([hg.reshape(-1), hh.reshape(-1)])
+            c0 = time.perf_counter()
+            slave.allreduce_array(flat, Operands.FLOAT, Operators.SUM)
+            csecs += time.perf_counter() - c0
+            cbytes += flat.nbytes
+            hg = flat[:hg.size].reshape(n_nodes, f, b)
+            hh = flat[hg.size:].reshape(n_nodes, f, b)
+            # split finding + routing (numpy mirror of the TPU path)
+            cg, ch = np.cumsum(hg, -1), np.cumsum(hh, -1)
+            Gt, Ht = cg[..., -1:], ch[..., -1:]
+            gain = (cg ** 2 / (ch + lam)
+                    + (Gt - cg) ** 2 / (Ht - ch + lam)
+                    - Gt ** 2 / (Ht + lam))
+            gain[..., -1] = -np.inf
+            best = gain.reshape(n_nodes, -1).argmax(-1)
+            feat, bin_ = best // b, best % b
+            v = np.take_along_axis(lb, feat[node_ids][:, None],
+                                   axis=1)[:, 0]
+            node_ids = node_ids * 2 + (v > bin_[node_ids])
+        return time.perf_counter() - t0, cbytes, csecs
 
-    ts = [threading.Thread(target=worker, daemon=True)
-          for _ in range(procs)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(300)
-    if errors:
-        raise errors[0]
-    if any(t is None for t in times):
-        raise RuntimeError(
-            "socket baseline worker hung past the join timeout")
-    dt = max(times)
-    cbytes, csecs = coll[0]
+    results = _run_socket_job(procs, body, native_transport)
+    dt = max(res[0] for res in results)
+    _, cbytes, csecs = results[0]
     # the socket job scanned n samples total across `procs` workers on
     # one host: rate per "chip" = whole-job rate (one machine)
     return scanned_bytes(n, f, depth) / dt / 1e9, cbytes / csecs / 1e9
@@ -182,42 +195,24 @@ def bench_socket_collective(f=28, b=256, depth=6, procs=4, reps=3,
     """Allreduce rate alone over the tree-level histogram buffer shapes
     (no numpy histogram/split work — used for the native-transport
     extras figure without re-running the whole socket workload)."""
-    from ytk_mp4j_tpu.comm.master import Master
-    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
     from ytk_mp4j_tpu.operands import Operands
     from ytk_mp4j_tpu.operators import Operators
 
     sizes = [2 * (2 ** d) * f * b for d in range(depth)]
-    master = Master(procs, timeout=60.0).serve_in_thread()
-    rates = [None] * procs
-    errors = []
 
-    def worker():
-        try:
-            slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
-                                     native_transport=native_transport)
-            bufs = [np.ones(s, np.float32) for s in sizes]
-            slave.barrier()
-            t0 = time.perf_counter()
-            nbytes = 0
-            for _ in range(reps):
-                for buf in bufs:
-                    slave.allreduce_array(buf, Operands.FLOAT,
-                                          Operators.SUM)
-                    nbytes += buf.nbytes
-            rates[slave.rank] = nbytes / (time.perf_counter() - t0)
-            slave.close(0)
-        except Exception as e:  # pragma: no cover
-            errors.append(e)
+    def body(slave, r):
+        bufs = [np.ones(s, np.float32) for s in sizes]
+        slave.barrier()
+        t0 = time.perf_counter()
+        nbytes = 0
+        for _ in range(reps):
+            for buf in bufs:
+                slave.allreduce_array(buf, Operands.FLOAT, Operators.SUM)
+                nbytes += buf.nbytes
+        return nbytes / (time.perf_counter() - t0)
 
-    ts = [threading.Thread(target=worker, daemon=True)
-          for _ in range(procs)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(120)
-    if errors:
-        raise errors[0]
+    rates = _run_socket_job(procs, body, native_transport,
+                            join_timeout=120.0)
     return min(rates) / 1e9
 
 

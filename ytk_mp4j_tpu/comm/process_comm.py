@@ -251,7 +251,10 @@ class ProcessCommSlave(CommSlave):
                 recv_ch.recv_raw_into(rarr)
             if fut is not None:
                 fut.result()
-        except Mp4jError as e:
+        except Exception as e:
+            # also catches the fallback's raw socket errors (BrokenPipe,
+            # socket.timeout from the helper-thread send) so the "dead
+            # peer becomes Mp4jError" contract holds on every path
             raise Mp4jError(f"raw exchange ({sides}) failed: {e}") from None
 
     def _recv_buf(self, operand: Operand, n: int) -> np.ndarray:
