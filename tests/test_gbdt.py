@@ -61,6 +61,26 @@ def test_hist_strategies_agree(rng):
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_empty_leaf_nan_stays_isolated(rng):
+    """reg_lambda=0 + an empty leaf gives that leaf value -0/0 = NaN;
+    the one-hot selects must confine it to rows that route there (none),
+    exactly like the gathers they replaced — one poisoned table entry
+    must not contaminate every sample's prediction."""
+    N, F, B = 256, 3, 4
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=4, reg_lambda=0.0,
+                     learning_rate=0.5)
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    y = rng.standard_normal(N).astype(np.float32)
+    preds = np.zeros(N, np.float32)
+    new_preds, tree = train_tree_shard(
+        jnp.array(bins), jnp.array(y), jnp.array(preds), cfg)
+    # depth-4 over 256 samples: empty leaves are essentially guaranteed
+    assert np.isnan(np.asarray(tree[2])).any(), "test needs an empty leaf"
+    assert np.isfinite(np.asarray(new_preds)).all()
+    applied = np.asarray(predict_tree(jnp.array(bins), tree, cfg))
+    assert np.isfinite(applied).all()
+
+
 def test_best_splits_prefers_separating_feature():
     # two nodes; feature 1 cleanly separates grads in node 0
     F, B = 3, 4

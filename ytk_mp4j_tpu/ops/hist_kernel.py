@@ -118,8 +118,10 @@ def pallas_histograms(bins, g, h, node_ids, n_nodes: int, F: int, B: int,
         node_ids = jnp.pad(node_ids, (0, pad))
     C = 4 * n_nodes
     # under shard_map with check_vma, the out_shape must carry the
-    # varying-across-mesh-axes set; inherit it from the inputs
-    vma = getattr(jax.typeof(g), "vma", None)
+    # union of the inputs' varying-across-mesh-axes sets
+    vma = frozenset().union(*(
+        getattr(jax.typeof(x), "vma", None) or frozenset()
+        for x in (bins, g, h, node_ids)))
     if vma:
         out_shape = jax.ShapeDtypeStruct((C, F * B), jnp.float32, vma=vma)
     else:
