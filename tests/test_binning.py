@@ -1,0 +1,64 @@
+"""Quantile binning front end (models/binning.py) + GBDTTrainer.predict:
+the continuous-features -> bins -> train -> predict consumer flow."""
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.models.binning import QuantileBinner
+from ytk_mp4j_tpu.models.gbdt import GBDTConfig, GBDTTrainer
+from ytk_mp4j_tpu.parallel import make_mesh
+
+
+def test_bins_match_searchsorted(rng):
+    N, F, B = 5000, 4, 16
+    X = rng.standard_normal((N, F)).astype(np.float32) * [1, 10, 0.1, 3]
+    bins = QuantileBinner(B).fit_transform(X, sample=None)
+    assert bins.dtype == np.int32
+    assert bins.min() >= 0 and bins.max() < B
+    binner = QuantileBinner(B).fit(X, sample=None)
+    for f in range(F):
+        want = np.searchsorted(binner.edges[f], X[:, f], side="right")
+        np.testing.assert_array_equal(bins[:, f], want)
+
+
+def test_bins_are_balanced(rng):
+    N, B = 20_000, 8
+    X = rng.standard_normal((N, 1)).astype(np.float32)
+    bins = QuantileBinner(B).fit_transform(X, sample=None)
+    counts = np.bincount(bins[:, 0], minlength=B)
+    # quantile edges -> each bucket holds ~N/B
+    assert counts.min() > 0.8 * N / B
+    assert counts.max() < 1.2 * N / B
+
+
+def test_errors():
+    with pytest.raises(Mp4jError):
+        QuantileBinner(1)
+    b = QuantileBinner(4)
+    with pytest.raises(Mp4jError):
+        b.transform(np.zeros((3, 2)))          # not fitted
+    b.fit(np.random.default_rng(0).random((100, 2)), sample=None)
+    with pytest.raises(Mp4jError):
+        b.transform(np.zeros((3, 5)))          # wrong F
+
+
+def test_continuous_end_to_end(rng):
+    """The full ytk-learn-style consumer flow: continuous X -> quantile
+    bins -> distributed GBDT -> ensemble predict reproduces the
+    training-time predictions."""
+    N, F, B = 2000, 5, 32
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    y = (np.sin(3 * X[:, 0]) + 0.1 * rng.standard_normal(N)).astype(
+        np.float32)
+    bins = QuantileBinner(B).fit_transform(X, sample=None)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=4, learning_rate=0.3,
+                     n_trees=5)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(4))
+    trees, train_preds = tr.train(bins, y)
+    mse = float(np.mean((train_preds[:N] - y) ** 2))
+    assert mse < float(np.var(y)) * 0.5
+
+    preds = tr.predict(bins, trees)
+    np.testing.assert_allclose(preds, train_preds[:N], rtol=1e-4,
+                               atol=1e-5)

@@ -380,6 +380,7 @@ class GBDTTrainer(DataParallelTrainer):
         super().__init__(mesh=mesh, n_devices=n_devices)
         self.cfg = cfg
         self._step = None
+        self._predict = None
 
     def _build_step(self):
         cfg = self.cfg
@@ -422,3 +423,23 @@ class GBDTTrainer(DataParallelTrainer):
             dpreds, tree = self._step(dbins, dy, dpreds, dw)
             trees.append(tree)
         return trees, np.asarray(dpreds).reshape(-1)
+
+    def predict(self, bins: np.ndarray, trees) -> np.ndarray:
+        """Ensemble prediction: sum of learning-rate-scaled tree outputs
+        over any binned matrix (one jit; the per-tree loop is unrolled).
+        The jitted runner is cached on the trainer — repeated predict()
+        calls retrace only when (bins shape, tree count) changes."""
+        if self._predict is None:
+            cfg = self.cfg
+
+            @jax.jit
+            def run(bins, trees):
+                out = jnp.zeros((bins.shape[0],), jnp.float32)
+                for tree in trees:
+                    out = out + cfg.learning_rate * predict_tree(
+                        bins, tree, cfg)
+                return out
+
+            self._predict = run
+        bins = np.asarray(bins, np.int32)
+        return np.asarray(self._predict(jnp.asarray(bins), list(trees)))
