@@ -43,6 +43,81 @@ def test_errors():
         b.transform(np.zeros((3, 5)))          # wrong F
 
 
+def test_nan_handling(rng):
+    """NaN rows land in bin 0 (missing bucket), edges fit from finite
+    values only, and an all-NaN feature raises."""
+    N, B = 4000, 8
+    X = rng.standard_normal((N, 2)).astype(np.float32)
+    X[::7, 0] = np.nan
+    b = QuantileBinner(B).fit(X, sample=None)
+    clean = QuantileBinner(B).fit(X[np.isfinite(X[:, 0])], sample=None)
+    np.testing.assert_allclose(b.edges[0], clean.edges[0], rtol=1e-6)
+    bins = b.transform(X)
+    assert (bins[::7, 0] == 0).all()
+    assert bins.min() >= 0 and bins.max() < B
+    X_bad = X.copy()
+    X_bad[:, 1] = np.nan
+    with pytest.raises(Mp4jError):
+        QuantileBinner(B).fit(X_bad, sample=None)
+
+
+def test_save_load_exact_path(rng, tmp_path):
+    """save_model must honor the exact path (np.savez normally appends
+    .npz) and load_model must rebuild the binner's true granularity."""
+    N, F = 200, 3
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    binner = QuantileBinner(8).fit(X, sample=None)   # coarser than n_bins
+    cfg = GBDTConfig(n_features=F, n_bins=32, depth=2, n_trees=2)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    trees, _ = tr.train(binner.transform(X), y)
+    path = str(tmp_path / "model.bin")               # no .npz suffix
+    tr.save_model(path, trees, binner=binner)
+    cfg2, trees2, binner2 = GBDTTrainer.load_model(path)
+    assert binner2.n_bins == 8
+    np.testing.assert_allclose(binner2.edges, binner.edges)
+
+
+def test_predict_proba_extreme_margins_no_overflow(rng):
+    """Confidently-signed margins must not overflow the sigmoid."""
+    F, B = 2, 4
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=1, n_trees=1,
+                     learning_rate=1000.0, loss="logistic")
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    # a tree whose leaves are huge margins
+    trees = [(np.zeros(1, np.int32), np.zeros(1, np.int32),
+              np.array([-500.0, 500.0], np.float32))]
+    bins = rng.integers(0, B, (64, F)).astype(np.int32)
+    with np.errstate(over="raise"):
+        p = tr.predict(bins, trees, proba=True)
+    assert np.isfinite(p).all()
+    assert ((p >= 0) & (p <= 1)).all()
+
+
+def test_save_load_roundtrip(rng, tmp_path):
+    """train -> save -> load in a fresh trainer -> identical preds on
+    new continuous data (the train-then-serve flow)."""
+    N, F, B = 1500, 4, 16
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    y = (X[:, 2] + 0.1 * rng.standard_normal(N)).astype(np.float32)
+    binner = QuantileBinner(B).fit(X, sample=None)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, n_trees=4,
+                     learning_rate=0.3)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(2))
+    trees, _ = tr.train(binner.transform(X), y)
+    path = str(tmp_path / "model.npz")
+    tr.save_model(path, trees, binner=binner)
+
+    cfg2, trees2, binner2 = GBDTTrainer.load_model(path)
+    assert cfg2 == cfg
+    X_new = rng.standard_normal((200, F)).astype(np.float32)
+    serve = GBDTTrainer(cfg2, mesh=make_mesh(1))
+    np.testing.assert_allclose(
+        serve.predict(binner2.transform(X_new), trees2),
+        tr.predict(binner.transform(X_new), trees),
+        rtol=1e-6)
+
+
 def test_continuous_end_to_end(rng):
     """The full ytk-learn-style consumer flow: continuous X -> quantile
     bins -> distributed GBDT -> ensemble predict reproduces the

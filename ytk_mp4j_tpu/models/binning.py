@@ -17,6 +17,8 @@ ytk-learn-scale datasets (one numpy quantile pass).
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 import jax
@@ -39,7 +41,13 @@ class QuantileBinner:
         self.edges: np.ndarray | None = None    # [F, B-1] f32
 
     def fit(self, X, sample: int | None = 1_000_000, seed: int = 0):
-        """Fit per-feature quantile edges from (a row sample of) X."""
+        """Fit per-feature quantile edges from (a row sample of) X.
+
+        Missing values (NaN) are ignored when computing quantiles; at
+        transform time they land in bin 0 (the missing bucket — every
+        ``x >= edge`` comparison is False). A feature with no finite
+        values at all cannot be binned and raises.
+        """
         X = np.asarray(X, np.float32)
         if X.ndim != 2:
             raise Mp4jError(f"X must be [N, F], got {X.shape}")
@@ -48,11 +56,25 @@ class QuantileBinner:
                 X.shape[0], sample, replace=False)
             X = X[idx]
         qs = np.arange(1, self.n_bins) / self.n_bins
-        self.edges = np.quantile(X, qs, axis=0).T.astype(np.float32)
+        with warnings.catch_warnings():
+            # an all-NaN feature is reported as an Mp4jError below, not
+            # as numpy's "All-NaN slice encountered" warning
+            warnings.simplefilter("ignore", RuntimeWarning)
+            edges = np.nanquantile(X, qs, axis=0).T.astype(np.float32)
+        bad = ~np.isfinite(edges).all(axis=1)
+        if bad.any():
+            raise Mp4jError(
+                f"features {np.flatnonzero(bad).tolist()} have no "
+                "finite values to fit quantile edges from")
+        self.edges = edges
         return self
 
     def transform(self, X) -> np.ndarray:
-        """Continuous [N, F] -> int32 bin ids in [0, n_bins)."""
+        """Continuous [N, F] -> int32 bin ids in [0, n_bins).
+
+        NaN inputs land in bin 0 (the missing bucket; see fit) — this
+        deliberately diverges from ``np.searchsorted``, which sorts NaN
+        after every edge."""
         if self.edges is None:
             raise Mp4jError("binner is not fitted")
         X = np.asarray(X, np.float32)

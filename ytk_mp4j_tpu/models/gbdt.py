@@ -43,6 +43,10 @@ class GBDTConfig:
     n_features: int = 28
     n_bins: int = 256           # byte-binned, like ytk-learn's 256-bin hists
     depth: int = 6
+    # "squared": regression (g = pred - y, h = 1); "logistic": binary
+    # classification on {0,1} labels with second-order (Newton) leaf
+    # values, the reference consumer's Higgs objective
+    loss: str = "squared"
     learning_rate: float = 0.1
     reg_lambda: float = 1.0
     n_trees: int = 10
@@ -59,6 +63,9 @@ class GBDTConfig:
             raise ValueError(
                 f"hist_mode must be 'pallas', 'matmul', 'pair' or "
                 f"'flat', got {self.hist_mode!r}")
+        if self.loss not in ("squared", "logistic"):
+            raise ValueError(
+                f"loss must be 'squared' or 'logistic', got {self.loss!r}")
 
 
 # ----------------------------------------------------------------------
@@ -310,9 +317,14 @@ def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
     level-order heap layout (internal nodes 0..2^depth-2).
     """
     F, B = cfg.n_features, cfg.n_bins
-    # squared-error loss: g = w * (pred - y), h = w
-    g = preds - y
-    h = jnp.ones_like(g)
+    # gradient/hessian of the objective at the current margin
+    if cfg.loss == "logistic":
+        p = jax.nn.sigmoid(preds)
+        g = p - y
+        h = p * (1.0 - p)
+    else:  # squared error: g = pred - y, h = 1
+        g = preds - y
+        h = jnp.ones_like(preds)
     if weights is not None:
         g = g * weights
         h = h * weights
@@ -424,11 +436,14 @@ class GBDTTrainer(DataParallelTrainer):
             trees.append(tree)
         return trees, np.asarray(dpreds).reshape(-1)
 
-    def predict(self, bins: np.ndarray, trees) -> np.ndarray:
+    def predict(self, bins: np.ndarray, trees,
+                proba: bool = False) -> np.ndarray:
         """Ensemble prediction: sum of learning-rate-scaled tree outputs
         over any binned matrix (one jit; the per-tree loop is unrolled).
-        The jitted runner is cached on the trainer — repeated predict()
-        calls retrace only when (bins shape, tree count) changes."""
+        Returns raw margins; ``proba=True`` applies the sigmoid (only
+        meaningful with the logistic objective). The jitted runner is
+        cached on the trainer — repeated predict() calls retrace only
+        when (bins shape, tree count) changes."""
         if self._predict is None:
             cfg = self.cfg
 
@@ -442,4 +457,56 @@ class GBDTTrainer(DataParallelTrainer):
 
             self._predict = run
         bins = np.asarray(bins, np.int32)
-        return np.asarray(self._predict(jnp.asarray(bins), list(trees)))
+        out = np.asarray(self._predict(jnp.asarray(bins), list(trees)))
+        if proba:
+            # two-branch sigmoid: exp only ever sees non-positive
+            # arguments, so large |margin| cannot overflow
+            p = np.empty_like(out)
+            pos = out >= 0
+            p[pos] = 1.0 / (1.0 + np.exp(-out[pos]))
+            e = np.exp(out[~pos])
+            p[~pos] = e / (1.0 + e)
+            return p
+        return out
+
+    def save_model(self, path: str, trees, binner=None) -> None:
+        """Persist the ensemble (and optionally the fitted binner's
+        edges) as a portable .npz — the reference consumer's
+        train-then-serve flow."""
+        from dataclasses import asdict
+
+        arrays = {}
+        for i, (tf, tb, lv) in enumerate(trees):
+            arrays[f"feat_{i}"] = np.asarray(tf)
+            arrays[f"bin_{i}"] = np.asarray(tb)
+            arrays[f"leaf_{i}"] = np.asarray(lv)
+        if binner is not None and binner.edges is not None:
+            arrays["bin_edges"] = binner.edges
+        # write through a file object so the exact user-supplied path is
+        # honored (np.savez(path) silently appends ".npz")
+        with open(path, "wb") as f:
+            np.savez(f, n_trees=len(trees),
+                     config=np.array(repr(asdict(self.cfg))), **arrays)
+
+    @staticmethod
+    def load_model(path: str):
+        """Load a saved ensemble; returns (cfg, trees, binner|None)."""
+        import ast
+
+        from ytk_mp4j_tpu.models.binning import QuantileBinner
+
+        with np.load(path, allow_pickle=False) as z:
+            cfg = GBDTConfig(**ast.literal_eval(str(z["config"])))
+            trees = [
+                (z[f"feat_{i}"], z[f"bin_{i}"], z[f"leaf_{i}"])
+                for i in range(int(z["n_trees"]))
+            ]
+            binner = None
+            if "bin_edges" in z:
+                # binning granularity may differ from cfg.n_bins (a
+                # coarser binner feeding a finer histogram is legal);
+                # derive it from the saved edges
+                edges = z["bin_edges"]
+                binner = QuantileBinner(edges.shape[1] + 1)
+                binner.edges = edges
+        return cfg, trees, binner
