@@ -59,6 +59,12 @@ def test_nan_handling(rng):
     X_bad[:, 1] = np.nan
     with pytest.raises(Mp4jError):
         QuantileBinner(B).fit(X_bad, sample=None)
+    # inf sentinels are legal: they fit fine and bin to the top bucket
+    X_inf = rng.standard_normal((N, 1)).astype(np.float32)
+    X_inf[::3, 0] = np.inf
+    bi = QuantileBinner(B).fit(X_inf, sample=None)
+    out = bi.transform(X_inf)
+    assert (out[::3, 0] == B - 1).all()
 
 
 def test_save_load_exact_path(rng, tmp_path):

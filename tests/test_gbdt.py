@@ -90,6 +90,52 @@ def test_logistic_objective_fits_and_matches_distributed(rng):
 def test_bad_loss_rejected():
     with pytest.raises(ValueError):
         GBDTConfig(loss="hinge")
+    with pytest.raises(ValueError):
+        GBDTConfig(loss="softmax", n_classes=1)
+
+
+def test_softmax_out_of_range_labels_rejected(rng):
+    cfg = GBDTConfig(n_features=2, n_bins=4, depth=2, n_trees=1,
+                     loss="softmax", n_classes=3)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    bins = rng.integers(0, 4, (32, 2)).astype(np.int32)
+    with pytest.raises(ValueError):
+        tr.train(bins, np.full(32, 3, np.int32))     # == n_classes
+    with pytest.raises(ValueError):
+        tr.train(bins, np.full(32, -1, np.int32))
+
+
+def test_softmax_multiclass_fits_and_roundtrips(rng, tmp_path):
+    """Multiclass GBDT: one tree per class per round; accuracy beats
+    the base rate; distributed matches single-device; save/load/predict
+    round-trips."""
+    N, F, B, C = 1500, 4, 16, 3
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    y = np.clip(bins[:, 2] * C // B, 0, C - 1).astype(np.int32)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, learning_rate=0.4,
+                     n_trees=4, loss="softmax", n_classes=C)
+
+    dist = GBDTTrainer(cfg, mesh=make_mesh(4))
+    trees, margins = dist.train(bins, y)
+    assert margins.shape == (dist.n_shards * ((N + 3) // 4), C)
+    proba = dist.predict(bins, trees, proba=True)
+    assert proba.shape == (N, C)
+    np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+    acc = float((proba.argmax(1) == y).mean())
+    assert acc > 0.9
+
+    single = GBDTTrainer(cfg, mesh=make_mesh(1))
+    trees_s, margins_s = single.train(bins, y)
+    np.testing.assert_allclose(margins[:N], margins_s[:N], rtol=1e-4,
+                               atol=1e-5)
+
+    path = str(tmp_path / "mc.npz")
+    dist.save_model(path, trees)
+    cfg2, trees2, _ = GBDTTrainer.load_model(path)
+    assert cfg2 == cfg
+    serve = GBDTTrainer(cfg2, mesh=make_mesh(1))
+    np.testing.assert_allclose(serve.predict(bins, trees2),
+                               dist.predict(bins, trees), rtol=1e-6)
 
 
 def test_empty_leaf_nan_stays_isolated(rng):

@@ -55,18 +55,23 @@ class QuantileBinner:
             idx = np.random.default_rng(seed).choice(
                 X.shape[0], sample, replace=False)
             X = X[idx]
-        qs = np.arange(1, self.n_bins) / self.n_bins
-        with warnings.catch_warnings():
-            # an all-NaN feature is reported as an Mp4jError below, not
-            # as numpy's "All-NaN slice encountered" warning
-            warnings.simplefilter("ignore", RuntimeWarning)
-            edges = np.nanquantile(X, qs, axis=0).T.astype(np.float32)
-        bad = ~np.isfinite(edges).all(axis=1)
+        # a feature must have at least one finite value; inf sentinels
+        # are fine (they produce inf edges, which compare like any other
+        # value at transform time and land inf samples in the top bins)
+        bad = ~np.isfinite(X).any(axis=0)
         if bad.any():
             raise Mp4jError(
                 f"features {np.flatnonzero(bad).tolist()} have no "
                 "finite values to fit quantile edges from")
-        self.edges = edges
+        qs = np.arange(1, self.n_bins) / self.n_bins
+        with warnings.catch_warnings():
+            # inf sentinels make nanquantile warn on inf-inf interpolation
+            warnings.simplefilter("ignore", RuntimeWarning)
+            edges = np.nanquantile(X, qs, axis=0).T.astype(np.float32)
+        # quantiles straddling inf sentinels interpolate to NaN; an
+        # edge of +inf keeps the edge vector ordered and is matched
+        # only by x = +inf (x >= inf), which belongs in the top bins
+        self.edges = np.where(np.isnan(edges), np.float32(np.inf), edges)
         return self
 
     def transform(self, X) -> np.ndarray:

@@ -45,8 +45,11 @@ class GBDTConfig:
     depth: int = 6
     # "squared": regression (g = pred - y, h = 1); "logistic": binary
     # classification on {0,1} labels with second-order (Newton) leaf
-    # values, the reference consumer's Higgs objective
+    # values, the reference consumer's Higgs objective; "softmax":
+    # multiclass on integer labels — one tree per class per round
+    # against the diagonal softmax gradient/hessian
     loss: str = "squared"
+    n_classes: int = 2          # used by loss="softmax" only
     learning_rate: float = 0.1
     reg_lambda: float = 1.0
     n_trees: int = 10
@@ -63,9 +66,13 @@ class GBDTConfig:
             raise ValueError(
                 f"hist_mode must be 'pallas', 'matmul', 'pair' or "
                 f"'flat', got {self.hist_mode!r}")
-        if self.loss not in ("squared", "logistic"):
+        if self.loss not in ("squared", "logistic", "softmax"):
             raise ValueError(
-                f"loss must be 'squared' or 'logistic', got {self.loss!r}")
+                f"loss must be 'squared', 'logistic' or 'softmax', "
+                f"got {self.loss!r}")
+        if self.loss == "softmax" and self.n_classes < 2:
+            raise ValueError(
+                f"softmax needs n_classes >= 2, got {self.n_classes}")
 
 
 # ----------------------------------------------------------------------
@@ -304,33 +311,13 @@ def best_splits(hist_g, hist_h, reg_lambda: float):
 # ----------------------------------------------------------------------
 # one boosting round (tree build) — per-shard body
 # ----------------------------------------------------------------------
-def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
-                     weights=None, interpret=None):
-    """Build one tree on this shard's samples; histogram-allreduce across
-    ``axis_name`` (None = single device). Returns (new_preds, tree).
-
-    ``weights`` ([N] f32, default all-ones) scales each sample's
-    gradient/hessian contribution — the driver uses weight 0 to neutralize
-    shard-padding rows so padded and unpadded runs are bit-equivalent.
-
-    tree = (feats [nodes], bins [nodes], leaf values [2^depth]) in
-    level-order heap layout (internal nodes 0..2^depth-2).
-    """
-    F, B = cfg.n_features, cfg.n_bins
-    # gradient/hessian of the objective at the current margin
-    if cfg.loss == "logistic":
-        p = jax.nn.sigmoid(preds)
-        g = p - y
-        h = p * (1.0 - p)
-    else:  # squared error: g = pred - y, h = 1
-        g = preds - y
-        h = jnp.ones_like(preds)
-    if weights is not None:
-        g = g * weights
-        h = h * weights
+def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret):
+    """Grow one tree from per-sample gradients/hessians; the per-level
+    histogram psum over ``axis_name`` is THE distributed allreduce.
+    Returns (delta [N] — the learning-rate-scaled leaf value each sample
+    receives — and the tree)."""
     N = bins.shape[0]
     node_ids = jnp.zeros((N,), dtype=jnp.int32)
-
     n_internal = 2 ** cfg.depth - 1
     tree_feat = jnp.zeros((n_internal,), dtype=jnp.int32)
     tree_bin = jnp.zeros((n_internal,), dtype=jnp.int32)
@@ -358,9 +345,59 @@ def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
         leaf_g = lax.psum(leaf_g, axis_name)
         leaf_h = lax.psum(leaf_h, axis_name)
     leaf_val = -leaf_g / (leaf_h + cfg.reg_lambda)
-    preds = preds + cfg.learning_rate * _onehot_select(
-        leaf_val, node_ids, n_leaves)
-    return preds, (tree_feat, tree_bin, leaf_val)
+    delta = cfg.learning_rate * _onehot_select(leaf_val, node_ids,
+                                               n_leaves)
+    return delta, (tree_feat, tree_bin, leaf_val)
+
+
+def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
+                     weights=None, interpret=None):
+    """One boosting round on this shard's samples. Returns
+    (new_preds, tree).
+
+    ``weights`` ([N] f32, default all-ones) scales each sample's
+    gradient/hessian contribution — the driver uses weight 0 to neutralize
+    shard-padding rows so padded and unpadded runs are bit-equivalent.
+
+    Scalar objectives ("squared", "logistic"): preds/y are [N]; one tree
+    is grown; tree = (feats [nodes], bins [nodes], leaf values
+    [2^depth]) in level-order heap layout. "softmax" (multiclass, the
+    ytk-learn classification objective): preds are margins [N, C], y is
+    integer class labels [N]; one tree is grown PER CLASS against the
+    diagonal softmax g/h (g_c = p_c - 1[y=c], h_c = p_c (1 - p_c));
+    tree = a C-tuple of per-class trees.
+    """
+    if cfg.loss == "softmax":
+        C = cfg.n_classes
+        p = jax.nn.softmax(preds, axis=1)          # [N, C]
+        trees = []
+        deltas = []
+        for c in range(C):                         # C static -> unrolled
+            onehot_y = (y.astype(jnp.int32) == c).astype(jnp.float32)
+            g = p[:, c] - onehot_y
+            h = p[:, c] * (1.0 - p[:, c])
+            if weights is not None:
+                g = g * weights
+                h = h * weights
+            delta, tree = _build_tree(bins, g, h, cfg, axis_name,
+                                      interpret)
+            deltas.append(delta)
+            trees.append(tree)
+        return preds + jnp.stack(deltas, axis=1), tuple(trees)
+
+    # gradient/hessian of the scalar objective at the current margin
+    if cfg.loss == "logistic":
+        p = jax.nn.sigmoid(preds)
+        g = p - y
+        h = p * (1.0 - p)
+    else:  # squared error: g = pred - y, h = 1
+        g = preds - y
+        h = jnp.ones_like(preds)
+    if weights is not None:
+        g = g * weights
+        h = h * weights
+    delta, tree = _build_tree(bins, g, h, cfg, axis_name, interpret)
+    return preds + delta, tree
 
 
 def predict_tree(bins, tree, cfg: GBDTConfig):
@@ -419,36 +456,62 @@ class GBDTTrainer(DataParallelTrainer):
         nothing to histograms or leaves (distributed results stay
         equivalent to single-device for any N)."""
         (bins, y), per, w = self._pad_rows([bins, y])
+        if self.cfg.loss == "softmax":
+            preds = np.zeros((y.shape[0], self.cfg.n_classes), np.float32)
+        else:
+            preds = np.zeros_like(y, np.float32)
         return (self._put_sharded(bins, per), self._put_sharded(y, per),
-                self._put_sharded(np.zeros_like(y), per),
+                self._put_sharded(preds, per),
                 self._put_sharded(w, per))
 
     def train(self, bins: np.ndarray, y: np.ndarray,
               n_trees: int | None = None):
-        """Full boosting run; returns (trees, final preds [padded])."""
+        """Full boosting run; returns (trees, final margins [padded] —
+        [N] for scalar objectives, [N, n_classes] for softmax)."""
         if self._step is None:
             self._step = self._build_step()
+        if self.cfg.loss == "softmax":
+            y = np.asarray(y, np.int32)
+            if y.size and (y.min() < 0 or y.max() >= self.cfg.n_classes):
+                raise ValueError(
+                    f"softmax labels must lie in [0, "
+                    f"{self.cfg.n_classes}), got range "
+                    f"[{y.min()}, {y.max()}]")
+        else:
+            y = np.asarray(y, np.float32)
         dbins, dy, dpreds, dw = self.shard_data(
-            np.asarray(bins, np.int32), np.asarray(y, np.float32))
+            np.asarray(bins, np.int32), y)
         trees = []
         for _ in range(n_trees if n_trees is not None else self.cfg.n_trees):
             dpreds, tree = self._step(dbins, dy, dpreds, dw)
             trees.append(tree)
-        return trees, np.asarray(dpreds).reshape(-1)
+        preds = np.asarray(dpreds)
+        if self.cfg.loss == "softmax":
+            return trees, preds.reshape(-1, self.cfg.n_classes)
+        return trees, preds.reshape(-1)
 
     def predict(self, bins: np.ndarray, trees,
                 proba: bool = False) -> np.ndarray:
         """Ensemble prediction: sum of learning-rate-scaled tree outputs
         over any binned matrix (one jit; the per-tree loop is unrolled).
-        Returns raw margins; ``proba=True`` applies the sigmoid (only
-        meaningful with the logistic objective). The jitted runner is
-        cached on the trainer — repeated predict() calls retrace only
-        when (bins shape, tree count) changes."""
+        Returns raw margins ([N], or [N, n_classes] for softmax);
+        ``proba=True`` applies the sigmoid (logistic) or softmax. The
+        jitted runner is cached on the trainer — repeated predict()
+        calls retrace only when (bins shape, tree count) changes."""
         if self._predict is None:
             cfg = self.cfg
+            softmax = cfg.loss == "softmax"
 
             @jax.jit
             def run(bins, trees):
+                if softmax:
+                    out = jnp.zeros((bins.shape[0], cfg.n_classes),
+                                    jnp.float32)
+                    for per_class in trees:
+                        out = out + cfg.learning_rate * jnp.stack(
+                            [predict_tree(bins, t, cfg)
+                             for t in per_class], axis=1)
+                    return out
                 out = jnp.zeros((bins.shape[0],), jnp.float32)
                 for tree in trees:
                     out = out + cfg.learning_rate * predict_tree(
@@ -458,16 +521,20 @@ class GBDTTrainer(DataParallelTrainer):
             self._predict = run
         bins = np.asarray(bins, np.int32)
         out = np.asarray(self._predict(jnp.asarray(bins), list(trees)))
-        if proba:
-            # two-branch sigmoid: exp only ever sees non-positive
-            # arguments, so large |margin| cannot overflow
-            p = np.empty_like(out)
-            pos = out >= 0
-            p[pos] = 1.0 / (1.0 + np.exp(-out[pos]))
-            e = np.exp(out[~pos])
-            p[~pos] = e / (1.0 + e)
-            return p
-        return out
+        if not proba:
+            return out
+        if self.cfg.loss == "softmax":
+            z = out - out.max(axis=1, keepdims=True)   # overflow-free
+            e = np.exp(z)
+            return e / e.sum(axis=1, keepdims=True)
+        # two-branch sigmoid: exp only ever sees non-positive
+        # arguments, so large |margin| cannot overflow
+        p = np.empty_like(out)
+        pos = out >= 0
+        p[pos] = 1.0 / (1.0 + np.exp(-out[pos]))
+        e = np.exp(out[~pos])
+        p[~pos] = e / (1.0 + e)
+        return p
 
     def save_model(self, path: str, trees, binner=None) -> None:
         """Persist the ensemble (and optionally the fitted binner's
@@ -476,10 +543,13 @@ class GBDTTrainer(DataParallelTrainer):
         from dataclasses import asdict
 
         arrays = {}
-        for i, (tf, tb, lv) in enumerate(trees):
-            arrays[f"feat_{i}"] = np.asarray(tf)
-            arrays[f"bin_{i}"] = np.asarray(tb)
-            arrays[f"leaf_{i}"] = np.asarray(lv)
+        for i, round_trees in enumerate(trees):
+            per_class = (round_trees if self.cfg.loss == "softmax"
+                         else (round_trees,))
+            for c, (tf, tb, lv) in enumerate(per_class):
+                arrays[f"feat_{i}_{c}"] = np.asarray(tf)
+                arrays[f"bin_{i}_{c}"] = np.asarray(tb)
+                arrays[f"leaf_{i}_{c}"] = np.asarray(lv)
         if binner is not None and binner.edges is not None:
             arrays["bin_edges"] = binner.edges
         # write through a file object so the exact user-supplied path is
@@ -497,10 +567,17 @@ class GBDTTrainer(DataParallelTrainer):
 
         with np.load(path, allow_pickle=False) as z:
             cfg = GBDTConfig(**ast.literal_eval(str(z["config"])))
-            trees = [
-                (z[f"feat_{i}"], z[f"bin_{i}"], z[f"leaf_{i}"])
-                for i in range(int(z["n_trees"]))
-            ]
+            C = cfg.n_classes if cfg.loss == "softmax" else 1
+
+            def tree(i, c):
+                return (z[f"feat_{i}_{c}"], z[f"bin_{i}_{c}"],
+                        z[f"leaf_{i}_{c}"])
+
+            if cfg.loss == "softmax":
+                trees = [tuple(tree(i, c) for c in range(C))
+                         for i in range(int(z["n_trees"]))]
+            else:
+                trees = [tree(i, 0) for i in range(int(z["n_trees"]))]
             binner = None
             if "bin_edges" in z:
                 # binning granularity may differ from cfg.n_bins (a
