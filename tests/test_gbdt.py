@@ -94,6 +94,53 @@ def test_bad_loss_rejected():
         GBDTConfig(loss="softmax", n_classes=1)
 
 
+def test_stochastic_boosting(rng):
+    """subsample/colsample < 1: training still fits, is deterministic
+    under a fixed seed, and varies with the seed."""
+    N, F, B = 2048, 6, 16
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    y = (bins[:, 0] / B + 0.05 * rng.standard_normal(N)).astype(np.float32)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, learning_rate=0.3,
+                     n_trees=6, subsample=0.7, colsample=0.7)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(4))
+    trees_a, preds_a = tr.train(bins, y, seed=0)
+    mse = float(np.mean((preds_a[:N] - y) ** 2))
+    assert mse < float(np.var(y)) * 0.5
+
+    tr2 = GBDTTrainer(cfg, mesh=make_mesh(4))
+    trees_b, preds_b = tr2.train(bins, y, seed=0)
+    np.testing.assert_array_equal(preds_a, preds_b)   # same seed
+
+    trees_c, preds_c = tr.train(bins, y, seed=1)
+    assert not np.array_equal(preds_a, preds_c)       # different seed
+
+    with pytest.raises(ValueError):
+        GBDTConfig(subsample=0.0)
+    with pytest.raises(ValueError):
+        GBDTConfig(colsample=1.5)
+
+
+def test_colsample_masks_features(rng):
+    """With only one feature allowed to win, every split must use it
+    (verified by comparing against a run whose data makes the masked
+    features strictly better)."""
+    N, F, B = 1024, 4, 8
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    # feature 3 is perfectly predictive; others noise
+    y = (bins[:, 3] > B // 2).astype(np.float32)
+    # colsample so small the fallback keeps exactly one feature; over
+    # several seeds, some tree must be forced off feature 3 yet still
+    # split on SOME feature in range
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=2, n_trees=3,
+                     subsample=1.0, colsample=0.26)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    trees, _ = tr.train(bins, y, seed=42)
+    feats = np.concatenate([np.asarray(t[0]) for t in trees])
+    assert ((feats >= 0) & (feats < F)).all()
+    # not every split can be feature 3 under aggressive masking
+    assert (feats != 3).any()
+
+
 def test_softmax_out_of_range_labels_rejected(rng):
     cfg = GBDTConfig(n_features=2, n_bins=4, depth=2, n_trees=1,
                      loss="softmax", n_classes=3)

@@ -50,6 +50,13 @@ class GBDTConfig:
     # against the diagonal softmax gradient/hessian
     loss: str = "squared"
     n_classes: int = 2          # used by loss="softmax" only
+    # stochastic boosting (ytk-learn's sample_rate / feature_sample_rate):
+    # per tree, each sample is kept with prob ``subsample`` (dropped
+    # samples get weight 0; kept ones are scaled 1/subsample so
+    # gradient sums stay unbiased) and each feature is kept with prob
+    # ``colsample`` (masked features never win a split)
+    subsample: float = 1.0
+    colsample: float = 1.0
     learning_rate: float = 0.1
     reg_lambda: float = 1.0
     n_trees: int = 10
@@ -73,6 +80,11 @@ class GBDTConfig:
         if self.loss == "softmax" and self.n_classes < 2:
             raise ValueError(
                 f"softmax needs n_classes >= 2, got {self.n_classes}")
+        if not (0.0 < self.subsample <= 1.0
+                and 0.0 < self.colsample <= 1.0):
+            raise ValueError(
+                f"subsample/colsample must be in (0, 1], got "
+                f"{self.subsample}/{self.colsample}")
 
 
 # ----------------------------------------------------------------------
@@ -283,11 +295,13 @@ def _route_samples(bins, node_ids, feat, bin_, n_nodes: int):
     return node_ids * 2 + (v > nb).astype(jnp.int32)
 
 
-def best_splits(hist_g, hist_h, reg_lambda: float):
+def best_splits(hist_g, hist_h, reg_lambda: float, feat_mask=None):
     """Regularized best split per node.
 
     hist_*: [n_nodes, F, B]. Returns (feat [n_nodes], bin [n_nodes],
-    gain [n_nodes]) — the split "bin <= b goes left".
+    gain [n_nodes]) — the split "bin <= b goes left". ``feat_mask``
+    ([F] bool, optional) disqualifies masked-out features (column
+    sampling): their gain is -inf so they can never win.
     """
     cg = jnp.cumsum(hist_g, axis=-1)        # G_left for split at bin b
     ch = jnp.cumsum(hist_h, axis=-1)
@@ -301,6 +315,8 @@ def best_splits(hist_g, hist_h, reg_lambda: float):
     gain = score(cg, ch) + score(Gt - cg, Ht - ch) - score(Gt, Ht)
     # splitting at the last bin sends everything left — not a split
     gain = gain.at[..., -1].set(-jnp.inf)
+    if feat_mask is not None:
+        gain = jnp.where(feat_mask[None, :, None], gain, -jnp.inf)
     flat = gain.reshape(gain.shape[0], -1)
     best = jnp.argmax(flat, axis=-1)
     B = hist_g.shape[-1]
@@ -311,7 +327,8 @@ def best_splits(hist_g, hist_h, reg_lambda: float):
 # ----------------------------------------------------------------------
 # one boosting round (tree build) — per-shard body
 # ----------------------------------------------------------------------
-def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret):
+def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
+                feat_mask=None):
     """Grow one tree from per-sample gradients/hessians; the per-level
     histogram psum over ``axis_name`` is THE distributed allreduce.
     Returns (delta [N] — the learning-rate-scaled leaf value each sample
@@ -330,7 +347,7 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret):
         if axis_name is not None:
             hg = lax.psum(hg, axis_name)     # THE histogram allreduce
             hh = lax.psum(hh, axis_name)
-        feat, bin_, _gain = best_splits(hg, hh, cfg.reg_lambda)
+        feat, bin_, _gain = best_splits(hg, hh, cfg.reg_lambda, feat_mask)
         tree_feat = lax.dynamic_update_slice(tree_feat, feat, (level_start,))
         tree_bin = lax.dynamic_update_slice(tree_bin, bin_, (level_start,))
         # route samples: go right if bin value > split bin (gather-free,
@@ -350,14 +367,47 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret):
     return delta, (tree_feat, tree_bin, leaf_val)
 
 
+def _sampling_masks(rng_key, cfg: GBDTConfig, N: int, axis_name):
+    """Per-tree stochastic-boosting masks (None when inactive).
+
+    Returns (sample_scale [N] f32 | None, feat_mask [F] bool | None).
+    The feature mask is derived from the key alone, so it is identical
+    on every shard; the sample mask folds in the shard index so shards
+    draw independent keeps. Kept samples are scaled 1/subsample to keep
+    gradient sums unbiased; at least one feature always survives."""
+    sample_scale = None
+    feat_mask = None
+    if rng_key is None:
+        return sample_scale, feat_mask
+    if cfg.colsample < 1.0:
+        keep = jax.random.bernoulli(jax.random.fold_in(rng_key, 1),
+                                    cfg.colsample, (cfg.n_features,))
+        # all-dropped draw: rescue a UNIFORMLY RANDOM feature (a fixed
+        # index would bias the ensemble toward it at small colsample)
+        rescue = jax.random.randint(jax.random.fold_in(rng_key, 3), (),
+                                    0, cfg.n_features)
+        fallback = (jnp.arange(cfg.n_features) == rescue) & ~keep.any()
+        feat_mask = keep | fallback
+    if cfg.subsample < 1.0:
+        k = jax.random.fold_in(rng_key, 2)
+        if axis_name is not None:
+            k = jax.random.fold_in(k, lax.axis_index(axis_name))
+        keep = jax.random.bernoulli(k, cfg.subsample, (N,))
+        sample_scale = keep.astype(jnp.float32) / cfg.subsample
+    return sample_scale, feat_mask
+
+
 def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
-                     weights=None, interpret=None):
+                     weights=None, interpret=None, rng_key=None):
     """One boosting round on this shard's samples. Returns
     (new_preds, tree).
 
     ``weights`` ([N] f32, default all-ones) scales each sample's
     gradient/hessian contribution — the driver uses weight 0 to neutralize
     shard-padding rows so padded and unpadded runs are bit-equivalent.
+    ``rng_key`` drives per-tree stochastic boosting when
+    cfg.subsample/colsample < 1 (no key -> deterministic full-data
+    trees regardless of the rates).
 
     Scalar objectives ("squared", "logistic"): preds/y are [N]; one tree
     is grown; tree = (feats [nodes], bins [nodes], leaf values
@@ -367,6 +417,12 @@ def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
     diagonal softmax g/h (g_c = p_c - 1[y=c], h_c = p_c (1 - p_c));
     tree = a C-tuple of per-class trees.
     """
+    sample_scale, feat_mask = _sampling_masks(rng_key, cfg,
+                                              bins.shape[0], axis_name)
+    if sample_scale is not None:
+        weights = (sample_scale if weights is None
+                   else weights * sample_scale)
+
     if cfg.loss == "softmax":
         C = cfg.n_classes
         p = jax.nn.softmax(preds, axis=1)          # [N, C]
@@ -380,7 +436,7 @@ def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
                 g = g * weights
                 h = h * weights
             delta, tree = _build_tree(bins, g, h, cfg, axis_name,
-                                      interpret)
+                                      interpret, feat_mask)
             deltas.append(delta)
             trees.append(tree)
         return preds + jnp.stack(deltas, axis=1), tuple(trees)
@@ -396,7 +452,8 @@ def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
     if weights is not None:
         g = g * weights
         h = h * weights
-    delta, tree = _build_tree(bins, g, h, cfg, axis_name, interpret)
+    delta, tree = _build_tree(bins, g, h, cfg, axis_name, interpret,
+                              feat_mask)
     return preds + delta, tree
 
 
@@ -439,13 +496,17 @@ class GBDTTrainer(DataParallelTrainer):
         # the virtual CPU meshes the tests and the driver dry-run use
         interpret = self.mesh.devices.flat[0].platform != "tpu"
 
+        sampling = cfg.subsample < 1.0 or cfg.colsample < 1.0
+
         @partial(jax.shard_map, mesh=self.mesh,
-                 in_specs=(spec, spec, spec, spec),
+                 in_specs=(spec, spec, spec, spec, P()),
                  out_specs=(spec, P(None)))
-        def step(bins, y, preds, weights):
+        def step(bins, y, preds, weights, key_data):
+            rng_key = (jax.random.wrap_key_data(key_data)
+                       if sampling else None)
             new_preds, tree = train_tree_shard(
                 bins[0], y[0], preds[0], cfg, axes, weights=weights[0],
-                interpret=interpret)
+                interpret=interpret, rng_key=rng_key)
             return new_preds[None], tree
 
         return jax.jit(step)
@@ -454,7 +515,10 @@ class GBDTTrainer(DataParallelTrainer):
         """Pad + reshape host data to [n_shards, N/shard, ...] and place
         on the mesh. Padding rows get sample weight 0 so they contribute
         nothing to histograms or leaves (distributed results stay
-        equivalent to single-device for any N)."""
+        equivalent to single-device for any N — EXCEPT under
+        cfg.subsample < 1, where each shard deliberately draws an
+        independent keep mask, so distributed and single-device runs
+        are different but equally valid stochastic realizations)."""
         (bins, y), per, w = self._pad_rows([bins, y])
         if self.cfg.loss == "softmax":
             preds = np.zeros((y.shape[0], self.cfg.n_classes), np.float32)
@@ -465,9 +529,11 @@ class GBDTTrainer(DataParallelTrainer):
                 self._put_sharded(w, per))
 
     def train(self, bins: np.ndarray, y: np.ndarray,
-              n_trees: int | None = None):
+              n_trees: int | None = None, seed: int = 0):
         """Full boosting run; returns (trees, final margins [padded] —
-        [N] for scalar objectives, [N, n_classes] for softmax)."""
+        [N] for scalar objectives, [N, n_classes] for softmax).
+        ``seed`` drives the per-tree stochastic-boosting masks when
+        cfg.subsample/colsample < 1 (same seed -> same trees)."""
         if self._step is None:
             self._step = self._build_step()
         if self.cfg.loss == "softmax":
@@ -481,9 +547,12 @@ class GBDTTrainer(DataParallelTrainer):
             y = np.asarray(y, np.float32)
         dbins, dy, dpreds, dw = self.shard_data(
             np.asarray(bins, np.int32), y)
+        base_key = jax.random.key(seed)
         trees = []
-        for _ in range(n_trees if n_trees is not None else self.cfg.n_trees):
-            dpreds, tree = self._step(dbins, dy, dpreds, dw)
+        for i in range(n_trees if n_trees is not None
+                       else self.cfg.n_trees):
+            kd = jax.random.key_data(jax.random.fold_in(base_key, i))
+            dpreds, tree = self._step(dbins, dy, dpreds, dw, kd)
             trees.append(tree)
         preds = np.asarray(dpreds)
         if self.cfg.loss == "softmax":
