@@ -64,7 +64,23 @@ class DataParallelTrainer:
         return arrays, per, sw
 
     def _put_sharded(self, a: np.ndarray, per: int):
-        """Reshape [n*per, ...] -> [n, per, ...] and place on the mesh."""
-        return jax.device_put(
-            a.reshape((self.n_shards, per) + a.shape[1:]),
-            self._row_sharding())
+        """Reshape [n*per, ...] -> [n, per, ...] and place on the mesh.
+
+        ``make_array_from_callback`` (each process materializes only its
+        addressable shards) makes this work unchanged on MULTI-PROCESS
+        meshes (jax.distributed), where a plain device_put cannot target
+        non-addressable devices; the callback path is identical to
+        device_put on single-process meshes."""
+        a = a.reshape((self.n_shards, per) + a.shape[1:])
+        return jax.make_array_from_callback(
+            a.shape, self._row_sharding(), lambda idx: a[idx])
+
+    @staticmethod
+    def _to_host(x) -> np.ndarray:
+        """Fetch a (possibly cross-process-sharded) device array to a
+        host numpy array on EVERY process."""
+        if x.is_fully_addressable:
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -554,7 +554,7 @@ class GBDTTrainer(DataParallelTrainer):
             kd = jax.random.key_data(jax.random.fold_in(base_key, i))
             dpreds, tree = self._step(dbins, dy, dpreds, dw, kd)
             trees.append(tree)
-        preds = np.asarray(dpreds)
+        preds = self._to_host(dpreds)
         if self.cfg.loss == "softmax":
             return trees, preds.reshape(-1, self.cfg.n_classes)
         return trees, preds.reshape(-1)
