@@ -114,3 +114,22 @@ def test_config_validation():
         tr.fit(np.full((4, 2), 99, np.int32), np.zeros((4, 2), np.int32),
                np.ones((4, 2), np.float32), np.zeros(4, np.float32),
                n_steps=1)
+
+
+def test_save_load_params_roundtrip(rng, tmp_path):
+    n, NF, nf, K = 256, 64, 3, 4
+    feats = rng.integers(0, NF, (n, K)).astype(np.int32)
+    fields = rng.integers(0, nf, (n, K)).astype(np.int32)
+    vals = np.ones((n, K), np.float32)
+    y = (feats.min(1) < 8).astype(np.float32)
+    cfg = FMConfig(model="ffm", n_features=NF, n_fields=nf, k=3, max_nnz=K)
+    tr = FMTrainer(cfg, mesh=make_mesh(2))
+    params, _ = tr.fit(feats, fields, vals, y, n_steps=10)
+    path = str(tmp_path / "ffm.model")
+    tr.save_params(path, params)
+    cfg2, params2 = FMTrainer.load_params(path, FMConfig)
+    assert cfg2 == cfg
+    serve = FMTrainer(cfg2, mesh=make_mesh(1))
+    np.testing.assert_allclose(
+        serve.predict(params2, feats, fields, vals),
+        tr.predict(params, feats, fields, vals), rtol=1e-6)

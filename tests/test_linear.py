@@ -84,3 +84,17 @@ def test_bad_loss_and_shape_raise(rng):
     with pytest.raises(Mp4jError):
         tr.fit(np.zeros((8, 3), np.float32), np.zeros(8, np.float32),
                n_steps=1)
+
+
+def test_save_load_params_roundtrip(rng, tmp_path):
+    x, y, _ = make_regression(rng, n=256, d=4)
+    cfg = LinearConfig(n_features=4, learning_rate=0.3)
+    tr = LinearTrainer(cfg, mesh=make_mesh(2))
+    params, _ = tr.fit(x, y, n_steps=30)
+    path = str(tmp_path / "linear.model")      # exact path, no suffix
+    tr.save_params(path, params)
+    cfg2, params2 = LinearTrainer.load_params(path, LinearConfig)
+    assert cfg2 == cfg
+    serve = LinearTrainer(cfg2, mesh=make_mesh(1))
+    np.testing.assert_allclose(serve.predict(params2, x),
+                               tr.predict(params, x), rtol=1e-6)

@@ -75,6 +75,40 @@ class DataParallelTrainer:
         return jax.make_array_from_callback(
             a.shape, self._row_sharding(), lambda idx: a[idx])
 
+    def save_params(self, path: str, params) -> None:
+        """Persist a flat tuple of parameter arrays + the trainer config
+        as a portable .npz (the train-then-serve flow; the GBDT trainer
+        has its own tree-structured save_model)."""
+        from dataclasses import asdict
+
+        import jax
+
+        # _to_host is COLLECTIVE on multi-process meshes (params may
+        # span non-addressable devices): every process must reach it;
+        # only process 0 then writes, avoiding N concurrent truncates
+        # of the same file on a shared filesystem
+        arrays = {f"p_{i}": self._to_host(p)
+                  for i, p in enumerate(params)}
+        if jax.process_index() != 0:
+            return
+        # write through a file object so the exact path is honored
+        # (np.savez(path) silently appends ".npz")
+        with open(path, "wb") as f:
+            np.savez(f, n_params=len(arrays),
+                     config=np.array(repr(asdict(self.cfg))), **arrays)
+
+    @staticmethod
+    def load_params(path: str, config_cls):
+        """Load (config, params tuple) saved by :meth:`save_params`;
+        ``config_cls`` is the trainer's config dataclass."""
+        import ast
+
+        with np.load(path, allow_pickle=False) as z:
+            cfg = config_cls(**ast.literal_eval(str(z["config"])))
+            params = tuple(z[f"p_{i}"]
+                           for i in range(int(z["n_params"])))
+        return cfg, params
+
     @staticmethod
     def _to_host(x) -> np.ndarray:
         """Fetch a (possibly cross-process-sharded) device array to a
