@@ -94,6 +94,41 @@ def test_bad_loss_rejected():
         GBDTConfig(loss="softmax", n_classes=1)
 
 
+def test_split_regularization_thresholds(rng):
+    """min_split_gain freezes below-threshold nodes (all samples route
+    left); min_child_hessian disqualifies tiny-child splits; both still
+    train and an absurd min_split_gain yields single-leaf trees."""
+    N, F, B = 1024, 4, 16
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    y = (bins[:, 0] / B + 0.05 * rng.standard_normal(N)).astype(np.float32)
+
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, n_trees=3,
+                     learning_rate=0.3, min_split_gain=1e9)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(2))
+    trees, preds = tr.train(bins, y)
+    # every node frozen -> all samples share one leaf -> the tree output
+    # is constant = learning_rate * global mean correction
+    for t in trees:
+        bins_arr = np.asarray(t[1])
+        assert (bins_arr == B - 1).all()
+
+    cfg2 = GBDTConfig(n_features=F, n_bins=B, depth=3, n_trees=4,
+                      learning_rate=0.3, min_split_gain=1e-4,
+                      min_child_hessian=2.0)
+    tr2 = GBDTTrainer(cfg2, mesh=make_mesh(2))
+    _, preds2 = tr2.train(bins, y)
+    mse = float(np.mean((preds2[:N] - y) ** 2))
+    assert mse < float(np.var(y)) * 0.5
+
+    # min_child_hessian ALONE (min_split_gain=0): a node where every
+    # candidate is disqualified must freeze, not split at feat 0/bin 0
+    cfg3 = GBDTConfig(n_features=F, n_bins=B, depth=6, n_trees=1,
+                      learning_rate=0.3, min_child_hessian=float(N))
+    trees3, _ = GBDTTrainer(cfg3, mesh=make_mesh(1)).train(bins, y)
+    # no split can satisfy both children >= N hessian -> all frozen
+    assert (np.asarray(trees3[0][1]) == B - 1).all()
+
+
 def test_stochastic_boosting(rng):
     """subsample/colsample < 1: training still fits, is deterministic
     under a fixed seed, and varies with the seed."""

@@ -98,3 +98,9 @@ def test_save_load_params_roundtrip(rng, tmp_path):
     serve = LinearTrainer(cfg2, mesh=make_mesh(1))
     np.testing.assert_allclose(serve.predict(params2, x),
                                tr.predict(params, x), rtol=1e-6)
+    # load -> re-save round trip (numpy params, not jax arrays)
+    path2 = str(tmp_path / "resaved.model")
+    serve.save_params(path2, params2)
+    cfg3, params3 = LinearTrainer.load_params(path2, LinearConfig)
+    for a, b in zip(params2, params3):
+        np.testing.assert_array_equal(a, b)

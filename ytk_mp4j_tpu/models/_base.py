@@ -30,6 +30,31 @@ def per_example_loss(z, y, loss: str):
     return 0.5 * (z - y) ** 2
 
 
+def save_npz(path: str, cfg, arrays: dict) -> None:
+    """Model-persistence writer shared by every trainer: the config
+    dataclass (repr of asdict, decoded by literal_eval) plus named
+    arrays. Writes through a file object so the exact user path is
+    honored (np.savez(path) silently appends ".npz"); only process 0
+    writes on multi-process jobs."""
+    from dataclasses import asdict
+
+    if jax.process_index() != 0:
+        return
+    with open(path, "wb") as f:
+        np.savez(f, config=np.array(repr(asdict(cfg))), **arrays)
+
+
+def load_npz(path: str, config_cls):
+    """Counterpart of :func:`save_npz`: returns (config instance,
+    {name: array}) with pickle disabled."""
+    import ast
+
+    with np.load(path, allow_pickle=False) as z:
+        cfg = config_cls(**ast.literal_eval(str(z["config"])))
+        arrays = {k: z[k] for k in z.files if k != "config"}
+    return cfg, arrays
+
+
 class DataParallelTrainer:
     """Mesh bookkeeping + sample sharding shared by the trainers."""
 
@@ -79,40 +104,28 @@ class DataParallelTrainer:
         """Persist a flat tuple of parameter arrays + the trainer config
         as a portable .npz (the train-then-serve flow; the GBDT trainer
         has its own tree-structured save_model)."""
-        from dataclasses import asdict
-
-        import jax
-
         # _to_host is COLLECTIVE on multi-process meshes (params may
-        # span non-addressable devices): every process must reach it;
-        # only process 0 then writes, avoiding N concurrent truncates
-        # of the same file on a shared filesystem
+        # span non-addressable devices): every process must reach it
+        # before the process-0 write gate inside save_npz
         arrays = {f"p_{i}": self._to_host(p)
                   for i, p in enumerate(params)}
-        if jax.process_index() != 0:
-            return
-        # write through a file object so the exact path is honored
-        # (np.savez(path) silently appends ".npz")
-        with open(path, "wb") as f:
-            np.savez(f, n_params=len(arrays),
-                     config=np.array(repr(asdict(self.cfg))), **arrays)
+        save_npz(path, self.cfg, arrays)
 
     @staticmethod
     def load_params(path: str, config_cls):
         """Load (config, params tuple) saved by :meth:`save_params`;
         ``config_cls`` is the trainer's config dataclass."""
-        import ast
-
-        with np.load(path, allow_pickle=False) as z:
-            cfg = config_cls(**ast.literal_eval(str(z["config"])))
-            params = tuple(z[f"p_{i}"]
-                           for i in range(int(z["n_params"])))
-        return cfg, params
+        cfg, arrays = load_npz(path, config_cls)
+        return cfg, tuple(arrays[f"p_{i}"] for i in range(len(arrays)))
 
     @staticmethod
     def _to_host(x) -> np.ndarray:
         """Fetch a (possibly cross-process-sharded) device array to a
-        host numpy array on EVERY process."""
+        host numpy array on EVERY process. Host numpy inputs (e.g.
+        params straight from :meth:`load_params`) pass through."""
+        if isinstance(x, np.ndarray) or not hasattr(
+                x, "is_fully_addressable"):
+            return np.asarray(x)
         if x.is_fully_addressable:
             return np.asarray(x)
         from jax.experimental import multihost_utils

@@ -57,6 +57,13 @@ class GBDTConfig:
     # ``colsample`` (masked features never win a split)
     subsample: float = 1.0
     colsample: float = 1.0
+    # split regularization (ytk-learn's min-gain / min-child thresholds):
+    # a node whose best gain < min_split_gain stops splitting (routes all
+    # samples left, equivalent to keeping the node a leaf); candidate
+    # splits whose left or right hessian sum < min_child_hessian are
+    # disqualified
+    min_split_gain: float = 0.0
+    min_child_hessian: float = 0.0
     learning_rate: float = 0.1
     reg_lambda: float = 1.0
     n_trees: int = 10
@@ -295,13 +302,16 @@ def _route_samples(bins, node_ids, feat, bin_, n_nodes: int):
     return node_ids * 2 + (v > nb).astype(jnp.int32)
 
 
-def best_splits(hist_g, hist_h, reg_lambda: float, feat_mask=None):
+def best_splits(hist_g, hist_h, reg_lambda: float, feat_mask=None,
+                min_child_hessian: float = 0.0):
     """Regularized best split per node.
 
     hist_*: [n_nodes, F, B]. Returns (feat [n_nodes], bin [n_nodes],
     gain [n_nodes]) — the split "bin <= b goes left". ``feat_mask``
     ([F] bool, optional) disqualifies masked-out features (column
-    sampling): their gain is -inf so they can never win.
+    sampling): their gain is -inf so they can never win; candidates
+    whose left or right hessian sum < ``min_child_hessian`` are
+    likewise disqualified.
     """
     cg = jnp.cumsum(hist_g, axis=-1)        # G_left for split at bin b
     ch = jnp.cumsum(hist_h, axis=-1)
@@ -317,6 +327,9 @@ def best_splits(hist_g, hist_h, reg_lambda: float, feat_mask=None):
     gain = gain.at[..., -1].set(-jnp.inf)
     if feat_mask is not None:
         gain = jnp.where(feat_mask[None, :, None], gain, -jnp.inf)
+    if min_child_hessian > 0.0:
+        ok = (ch >= min_child_hessian) & (Ht - ch >= min_child_hessian)
+        gain = jnp.where(ok, gain, -jnp.inf)
     flat = gain.reshape(gain.shape[0], -1)
     best = jnp.argmax(flat, axis=-1)
     B = hist_g.shape[-1]
@@ -347,7 +360,15 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
         if axis_name is not None:
             hg = lax.psum(hg, axis_name)     # THE histogram allreduce
             hh = lax.psum(hh, axis_name)
-        feat, bin_, _gain = best_splits(hg, hh, cfg.reg_lambda, feat_mask)
+        feat, bin_, gain = best_splits(hg, hh, cfg.reg_lambda, feat_mask,
+                                       cfg.min_child_hessian)
+        # freeze below-threshold nodes AND nodes with no admissible
+        # candidate at all (every gain -inf, e.g. min_child_hessian
+        # disqualified everything): bin B-1 routes every sample left
+        # (v > B-1 is never true), keeping the node whole
+        freeze = (gain < cfg.min_split_gain if cfg.min_split_gain > 0.0
+                  else jnp.isneginf(gain))
+        bin_ = jnp.where(freeze, cfg.n_bins - 1, bin_)
         tree_feat = lax.dynamic_update_slice(tree_feat, feat, (level_start,))
         tree_bin = lax.dynamic_update_slice(tree_bin, bin_, (level_start,))
         # route samples: go right if bin value > split bin (gather-free,
@@ -609,9 +630,9 @@ class GBDTTrainer(DataParallelTrainer):
         """Persist the ensemble (and optionally the fitted binner's
         edges) as a portable .npz — the reference consumer's
         train-then-serve flow."""
-        from dataclasses import asdict
+        from ytk_mp4j_tpu.models._base import save_npz
 
-        arrays = {}
+        arrays = {"n_trees": np.int64(len(trees))}
         for i, round_trees in enumerate(trees):
             per_class = (round_trees if self.cfg.loss == "softmax"
                          else (round_trees,))
@@ -621,38 +642,32 @@ class GBDTTrainer(DataParallelTrainer):
                 arrays[f"leaf_{i}_{c}"] = np.asarray(lv)
         if binner is not None and binner.edges is not None:
             arrays["bin_edges"] = binner.edges
-        # write through a file object so the exact user-supplied path is
-        # honored (np.savez(path) silently appends ".npz")
-        with open(path, "wb") as f:
-            np.savez(f, n_trees=len(trees),
-                     config=np.array(repr(asdict(self.cfg))), **arrays)
+        save_npz(path, self.cfg, arrays)
 
     @staticmethod
     def load_model(path: str):
         """Load a saved ensemble; returns (cfg, trees, binner|None)."""
-        import ast
-
+        from ytk_mp4j_tpu.models._base import load_npz
         from ytk_mp4j_tpu.models.binning import QuantileBinner
 
-        with np.load(path, allow_pickle=False) as z:
-            cfg = GBDTConfig(**ast.literal_eval(str(z["config"])))
-            C = cfg.n_classes if cfg.loss == "softmax" else 1
+        cfg, z = load_npz(path, GBDTConfig)
+        C = cfg.n_classes if cfg.loss == "softmax" else 1
 
-            def tree(i, c):
-                return (z[f"feat_{i}_{c}"], z[f"bin_{i}_{c}"],
-                        z[f"leaf_{i}_{c}"])
+        def tree(i, c):
+            return (z[f"feat_{i}_{c}"], z[f"bin_{i}_{c}"],
+                    z[f"leaf_{i}_{c}"])
 
-            if cfg.loss == "softmax":
-                trees = [tuple(tree(i, c) for c in range(C))
-                         for i in range(int(z["n_trees"]))]
-            else:
-                trees = [tree(i, 0) for i in range(int(z["n_trees"]))]
-            binner = None
-            if "bin_edges" in z:
-                # binning granularity may differ from cfg.n_bins (a
-                # coarser binner feeding a finer histogram is legal);
-                # derive it from the saved edges
-                edges = z["bin_edges"]
-                binner = QuantileBinner(edges.shape[1] + 1)
-                binner.edges = edges
+        if cfg.loss == "softmax":
+            trees = [tuple(tree(i, c) for c in range(C))
+                     for i in range(int(z["n_trees"]))]
+        else:
+            trees = [tree(i, 0) for i in range(int(z["n_trees"]))]
+        binner = None
+        if "bin_edges" in z:
+            # binning granularity may differ from cfg.n_bins (a
+            # coarser binner feeding a finer histogram is legal);
+            # derive it from the saved edges
+            edges = z["bin_edges"]
+            binner = QuantileBinner(edges.shape[1] + 1)
+            binner.edges = edges
         return cfg, trees, binner

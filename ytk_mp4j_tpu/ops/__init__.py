@@ -1,3 +1,3 @@
-from ytk_mp4j_tpu.ops import collectives
+from ytk_mp4j_tpu.ops import collectives, ring
 
-__all__ = ["collectives"]
+__all__ = ["collectives", "ring"]

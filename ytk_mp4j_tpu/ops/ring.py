@@ -1,0 +1,116 @@
+"""Hand-scheduled ring collectives over a mesh axis (``lax.ppermute``).
+
+The reference implements its collectives BY HAND over sockets —
+recursive halving/doubling rounds with explicit partner exchanges
+(SURVEY.md section 3b). This module is the TPU-native expression of
+that same idea one level below ``psum``: the classic bandwidth-optimal
+ring algorithms written as explicit ``ppermute`` steps over the ICI
+ring, inside ``shard_map``.
+
+Why it exists alongside ``ops/collectives.py`` (which just emits
+``lax.psum`` etc.):
+
+- it PROVES the transport layer the way the reference's check programs
+  prove the socket rounds — each ring step is an observable ICI
+  neighbor exchange, differentially tested against the one-op XLA path;
+- per-step chunking is under user control, which is what you need to
+  overlap a collective with compute (XLA's fused psum is opaque);
+- it is the scaffold for custom collective schedules (e.g. a
+  bidirectional ring or a hierarchical inter/intra pipeline) that XLA
+  will not emit on its own.
+
+Algorithms (n = axis size, chunk c = my shard split into n pieces):
+
+- ``ring_reduce_scatter``: n-1 steps; at step s each member sends the
+  partially-reduced chunk ``(rank - s)`` to its right neighbor and
+  merges the incoming chunk ``(rank - s - 1)``. After n-1 steps member
+  r holds chunk ``(r + 1) % n`` fully reduced.
+- ``ring_allgather``: n-1 steps of forwarding the newest chunk around
+  the ring until every member holds all chunks.
+- ``ring_allreduce`` = reduce-scatter + allgather (Rabenseifner's
+  bandwidth bound: 2 (n-1)/n of the buffer over the wire, the same
+  total the reference's halving/doubling pays over sockets).
+
+All functions run per-shard inside ``shard_map`` over a 1-D mesh axis;
+leading-dimension length must be divisible by n (pad outside).
+"""
+
+from __future__ import annotations
+
+from jax import lax
+import jax.numpy as jnp
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operators import Operator, Operators
+
+
+def _ring_perm(n: int):
+    """rank -> rank+1 (mod n): the 'send right' permutation."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _chunks(x, n: int):
+    if x.shape[0] % n:
+        raise Mp4jError(
+            f"ring collectives need leading dim divisible by the axis "
+            f"size; got {x.shape[0]} over {n} members (pad outside)")
+    return x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+
+def ring_reduce_scatter(x, operator: Operator = Operators.SUM,
+                        axis_name="mp4j"):
+    """Member r ends with chunk ``(r + 1) % n`` of the element-wise
+    reduction, as a ``[len/n, ...]`` array (tiled layout)."""
+    n = lax.axis_size(axis_name)
+    ch = _chunks(x, n)
+    if n == 1:
+        return ch[0]
+    r = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    # acc starts as my chunk (r); each step: send acc right, receive
+    # the left neighbor's acc, merge my local copy of the chunk the
+    # received acc represents
+    acc = jnp.take(ch, r % n, axis=0)
+    for s in range(n - 1):
+        acc = lax.ppermute(acc, axis_name, perm)
+        idx = (r - s - 1) % n                      # traced, per-member
+        local = jnp.take(ch, idx, axis=0)
+        acc = operator.jnp_fn(acc, local)
+    return acc
+
+
+def ring_allgather(x, axis_name="mp4j"):
+    """Every member ends with ``[n * len, ...]``: member q's shard at
+    block q. ``x`` is this member's shard."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    r = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    cur = x
+    # place my shard, then forward the newest chunk n-1 times; after
+    # step s I hold the shard of member (r - s - 1)
+    out = out.at[r].set(cur)
+    for s in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, perm)
+        src = (r - s - 1) % n
+        out = out.at[src].set(cur)
+    return out.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def ring_allreduce(x, operator: Operator = Operators.SUM,
+                   axis_name="mp4j"):
+    """Bandwidth-optimal ring allreduce: reduce-scatter + allgather.
+
+    Every member ends with the full element-wise reduction (same
+    semantics as ``collectives.allreduce``, hand-scheduled as 2 (n-1)
+    ppermute steps moving 2 (n-1)/n of the buffer over ICI)."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    mine = ring_reduce_scatter(x, operator, axis_name)   # chunk (r+1)%n
+    gathered = ring_allgather(mine, axis_name)
+    # ring_allgather lays member q's chunk at block q, but member q
+    # holds reduced chunk (q+1)%n — roll one block to restore order
+    return jnp.roll(gathered, shift=mine.shape[0], axis=0)
