@@ -68,12 +68,13 @@ def bench_tpu(n=1_000_000, f=28, b=256, depth=6, trees=10):
     bins, y = make_data(n, f, b)
     dbins, dy, dpreds, dw = tr.shard_data(bins, y)
     step = tr._build_step()
+    kd = jax.random.key_data(jax.random.key(0))
     # warmup + compile; np.asarray forces a real host round-trip
-    dpreds, tree = step(dbins, dy, dpreds, dw)
+    dpreds, tree = step(dbins, dy, dpreds, dw, kd)
     np.asarray(tree[0])
     t0 = time.perf_counter()
     for _ in range(trees):
-        dpreds, tree = step(dbins, dy, dpreds, dw)
+        dpreds, tree = step(dbins, dy, dpreds, dw, kd)
     np.asarray(tree[0])  # sync: steps chain on device
     dt = (time.perf_counter() - t0) / trees
     n_chips = jax.device_count()

@@ -1,0 +1,32 @@
+"""bench.py must stay runnable — the driver executes it at round end,
+so an API drift that breaks it would lose the round's headline number.
+Toy-sized smoke runs on the CPU test rig."""
+
+import numpy as np
+
+import bench
+
+
+def test_bench_tpu_smoke():
+    gbs, tps, n_chips = bench.bench_tpu(n=512, f=4, b=256, depth=2,
+                                        trees=1)
+    assert np.isfinite(gbs) and gbs > 0
+    assert np.isfinite(tps) and tps > 0
+    assert n_chips >= 1
+
+
+def test_bench_socket_smoke():
+    gbs, coll = bench.bench_socket(n=400, f=4, b=8, depth=2, procs=2)
+    assert np.isfinite(gbs) and gbs > 0
+    assert np.isfinite(coll) and coll > 0
+
+
+def test_bench_socket_collective_smoke():
+    rate = bench.bench_socket_collective(f=4, b=8, depth=2, procs=2,
+                                         reps=1)
+    assert np.isfinite(rate) and rate > 0
+
+
+def test_bench_socket_map_smoke():
+    rate = bench.bench_socket_map(procs=2, keys=50, reps=1)
+    assert np.isfinite(rate) and rate > 0
