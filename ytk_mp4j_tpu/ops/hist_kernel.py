@@ -99,9 +99,12 @@ def pallas_histograms(bins, g, h, node_ids, n_nodes: int, F: int, B: int,
                       tile: int = _TILE, interpret: bool = False):
     """Per-(node, feature, bin) gradient/hessian sums on the MXU.
 
-    bins: [N, F] int32 in [0, B); g, h: [N] f32; node_ids: [N] int32 in
-    [0, n_nodes). Returns (hist_g, hist_h): [n_nodes, F, B] f32.
-    Rows with g == h == 0 (shard padding) contribute exactly nothing.
+    bins: [N, F] int32 in [0, B); g, h: [N] f32; node_ids: [N] int32 —
+    ids outside [0, n_nodes) contribute exactly nothing (the one-hot
+    matches no column; the GBDT sibling-subtraction path relies on this
+    to exclude right-child samples via a sentinel id). Returns
+    (hist_g, hist_h): [n_nodes, F, B] f32. Rows with g == h == 0
+    (shard padding) contribute exactly nothing.
     """
     N = bins.shape[0]
     if N == 0:
