@@ -356,16 +356,21 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
     tree_feat = jnp.zeros((n_internal,), dtype=jnp.int32)
     tree_bin = jnp.zeros((n_internal,), dtype=jnp.int32)
 
+    def reduced_histograms(ids, n):
+        """Local histogram build + the distributed allreduce (psum)."""
+        a, b = build_histograms(bins, g, h, ids, n, cfg,
+                                interpret=interpret)
+        if axis_name is not None:
+            a = lax.psum(a, axis_name)      # THE histogram allreduce
+            b = lax.psum(b, axis_name)
+        return a, b
+
     level_start = 0
     prev_hg = prev_hh = None
     for d in range(cfg.depth):          # depth static -> unrolled
         n_nodes = 2 ** d
         if d == 0:
-            hg, hh = build_histograms(bins, g, h, node_ids, n_nodes, cfg,
-                                      interpret=interpret)
-            if axis_name is not None:
-                hg = lax.psum(hg, axis_name)   # THE histogram allreduce
-                hh = lax.psum(hh, axis_name)
+            hg, hh = reduced_histograms(node_ids, n_nodes)
         else:
             # histogram-subtraction trick (the classic GBDT sibling
             # identity hist(parent) = hist(left) + hist(right)): build
@@ -384,11 +389,7 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
             n_half = n_nodes // 2
             left_ids = jnp.where(node_ids % 2 == 0, node_ids // 2,
                                  n_half)
-            hl_g, hl_h = build_histograms(bins, g, h, left_ids, n_half,
-                                          cfg, interpret=interpret)
-            if axis_name is not None:
-                hl_g = lax.psum(hl_g, axis_name)
-                hl_h = lax.psum(hl_h, axis_name)
+            hl_g, hl_h = reduced_histograms(left_ids, n_half)
             hg = jnp.stack([hl_g, prev_hg - hl_g],
                            axis=1).reshape(n_nodes, *hl_g.shape[1:])
             hh = jnp.stack([hl_h, jnp.maximum(prev_hh - hl_h, 0.0)],
@@ -523,10 +524,7 @@ def predict_tree(bins, tree, cfg: GBDTConfig):
         level_feat = lax.dynamic_slice(tree_feat, (level_start,),
                                        (n_nodes,))
         level_bin = lax.dynamic_slice(tree_bin, (level_start,), (n_nodes,))
-        nf = _onehot_select(level_feat, node, n_nodes)
-        nb = _onehot_select(level_bin, node, n_nodes)
-        v = _onehot_row_select(bins, nf)
-        node = node * 2 + (v > nb).astype(jnp.int32)
+        node = _route_samples(bins, node, level_feat, level_bin, n_nodes)
         level_start += n_nodes
     return _onehot_select(leaf_val, node, 2 ** cfg.depth)
 
