@@ -94,6 +94,39 @@ def test_bad_loss_rejected():
         GBDTConfig(loss="softmax", n_classes=1)
 
 
+def test_sample_weight_and_importance(rng):
+    """Instance weights steer training (a heavily-weighted subset
+    dominates); feature importance concentrates on the signal feature."""
+    N, F, B = 2048, 4, 16
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    # two conflicting signals: feature 0 for the first half, feature 1
+    # for the second; weights make the second half dominate
+    y = np.where(np.arange(N) < N // 2,
+                 (bins[:, 0] / B), (bins[:, 1] / B)).astype(np.float32)
+    w = np.where(np.arange(N) < N // 2, 1e-3, 1.0).astype(np.float32)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, n_trees=4,
+                     learning_rate=0.3)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(4))
+    trees, _ = tr.train(bins, y, sample_weight=w)
+    imp = tr.feature_importance(trees)
+    assert imp.shape == (F,)
+    assert abs(imp.sum() - 1.0) < 1e-9
+    assert imp[1] > imp[0], imp       # weighted half's feature dominates
+
+    # phantom splits from empty/pure nodes must not count: with signal
+    # only on feature 3 and a deep tree, no importance leaks to feat 0
+    bins2 = rng.integers(0, 4, (8, F)).astype(np.int32)
+    y2 = (bins2[:, 3] > 1).astype(np.float32)
+    cfg2 = GBDTConfig(n_features=F, n_bins=4, depth=5, n_trees=1)
+    tr2 = GBDTTrainer(cfg2, mesh=make_mesh(1))
+    trees2, _ = tr2.train(bins2, y2)
+    imp2 = tr2.feature_importance(trees2)
+    assert imp2[3] == 1.0, imp2
+
+    with pytest.raises(ValueError):
+        tr.train(bins, y, sample_weight=np.ones(N - 1, np.float32))
+
+
 def test_split_regularization_thresholds(rng):
     """min_split_gain freezes below-threshold nodes (all samples route
     left); min_child_hessian disqualifies tiny-child splits; both still

@@ -397,12 +397,14 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
         prev_hg, prev_hh = hg, hh
         feat, bin_, gain = best_splits(hg, hh, cfg.reg_lambda, feat_mask,
                                        cfg.min_child_hessian)
-        # freeze below-threshold nodes AND nodes with no admissible
-        # candidate at all (every gain -inf, e.g. min_child_hessian
-        # disqualified everything): bin B-1 routes every sample left
-        # (v > B-1 is never true), keeping the node whole
-        freeze = (gain < cfg.min_split_gain if cfg.min_split_gain > 0.0
-                  else jnp.isneginf(gain))
+        # freeze any node whose best gain does not clear the threshold:
+        # bin B-1 routes every sample left (v > B-1 is never true),
+        # keeping the node whole. The ~(gain > thr) form also freezes
+        # gain == 0 (empty/pure nodes would otherwise record a phantom
+        # feat-0 "split", poisoning feature_importance), gain == -inf
+        # (no admissible candidate, e.g. min_child_hessian disqualified
+        # everything), and NaN gains (0/0 at reg_lambda == 0).
+        freeze = ~(gain > cfg.min_split_gain)
         bin_ = jnp.where(freeze, cfg.n_bins - 1, bin_)
         tree_feat = lax.dynamic_update_slice(tree_feat, feat, (level_start,))
         tree_bin = lax.dynamic_update_slice(tree_bin, bin_, (level_start,))
@@ -564,15 +566,26 @@ class GBDTTrainer(DataParallelTrainer):
 
         return jax.jit(step)
 
-    def shard_data(self, bins: np.ndarray, y: np.ndarray):
+    def shard_data(self, bins: np.ndarray, y: np.ndarray,
+                   sample_weight: np.ndarray | None = None):
         """Pad + reshape host data to [n_shards, N/shard, ...] and place
         on the mesh. Padding rows get sample weight 0 so they contribute
         nothing to histograms or leaves (distributed results stay
         equivalent to single-device for any N — EXCEPT under
         cfg.subsample < 1, where each shard deliberately draws an
         independent keep mask, so distributed and single-device runs
-        are different but equally valid stochastic realizations)."""
+        are different but equally valid stochastic realizations).
+        ``sample_weight`` ([N] f32, optional — ytk-learn's instance
+        weights) scales each sample's gradient/hessian contribution and
+        composes with the padding zeros."""
+        N = bins.shape[0]
         (bins, y), per, w = self._pad_rows([bins, y])
+        if sample_weight is not None:
+            sw = np.asarray(sample_weight, np.float32)
+            if sw.shape != (N,):
+                raise ValueError(
+                    f"sample_weight must be [N={N}], got {sw.shape}")
+            w[:N] *= sw
         if self.cfg.loss == "softmax":
             preds = np.zeros((y.shape[0], self.cfg.n_classes), np.float32)
         else:
@@ -582,11 +595,13 @@ class GBDTTrainer(DataParallelTrainer):
                 self._put_sharded(w, per))
 
     def train(self, bins: np.ndarray, y: np.ndarray,
-              n_trees: int | None = None, seed: int = 0):
+              n_trees: int | None = None, seed: int = 0,
+              sample_weight: np.ndarray | None = None):
         """Full boosting run; returns (trees, final margins [padded] —
         [N] for scalar objectives, [N, n_classes] for softmax).
         ``seed`` drives the per-tree stochastic-boosting masks when
-        cfg.subsample/colsample < 1 (same seed -> same trees)."""
+        cfg.subsample/colsample < 1 (same seed -> same trees);
+        ``sample_weight`` scales per-instance g/h contributions."""
         if self._step is None:
             self._step = self._build_step()
         if self.cfg.loss == "softmax":
@@ -599,7 +614,7 @@ class GBDTTrainer(DataParallelTrainer):
         else:
             y = np.asarray(y, np.float32)
         dbins, dy, dpreds, dw = self.shard_data(
-            np.asarray(bins, np.int32), y)
+            np.asarray(bins, np.int32), y, sample_weight=sample_weight)
         base_key = jax.random.key(seed)
         trees = []
         for i in range(n_trees if n_trees is not None
@@ -657,6 +672,22 @@ class GBDTTrainer(DataParallelTrainer):
         e = np.exp(out[~pos])
         p[~pos] = e / (1.0 + e)
         return p
+
+    def feature_importance(self, trees) -> np.ndarray:
+        """Split-count feature importance over the ensemble (ytk-learn's
+        model-report style): how many internal nodes split on each
+        feature, normalized to sum to 1. Frozen nodes (split bin B-1
+        routes everything left — no real split) are excluded."""
+        counts = np.zeros(self.cfg.n_features, np.int64)
+        for round_trees in trees:
+            per_class = (round_trees if self.cfg.loss == "softmax"
+                         else (round_trees,))
+            for tf, tb, _ in per_class:
+                real = np.asarray(tb) != self.cfg.n_bins - 1
+                np.add.at(counts, np.asarray(tf)[real], 1)
+        total = counts.sum()
+        return (counts / total if total else
+                np.zeros(self.cfg.n_features)).astype(np.float64)
 
     def save_model(self, path: str, trees, binner=None) -> None:
         """Persist the ensemble (and optionally the fitted binner's
