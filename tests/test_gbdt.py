@@ -94,6 +94,45 @@ def test_bad_loss_rejected():
         GBDTConfig(loss="softmax", n_classes=1)
 
 
+def test_eval_set_and_early_stopping(rng):
+    """Validation metric falls while signal is being learned; on pure
+    noise, early stopping truncates the ensemble to the best round."""
+    N, F, B = 2048, 4, 16
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    y = (bins[:, 0] / B + 0.05 * rng.standard_normal(N)).astype(np.float32)
+    va_bins = rng.integers(0, B, (512, F)).astype(np.int32)
+    va_y = (va_bins[:, 0] / B
+            + 0.05 * rng.standard_normal(512)).astype(np.float32)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, n_trees=8,
+                     learning_rate=0.4)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(4))
+    trees, _ = tr.train(bins, y, eval_set=(va_bins, va_y))
+    hist = tr.eval_history_
+    assert len(hist) == 8
+    assert hist[-1] < hist[0] * 0.5          # metric improves on signal
+    # incremental margins == full re-predict
+    np.testing.assert_allclose(
+        tr._eval_metric(tr.predict(va_bins, trees), va_y), hist[-1],
+        rtol=1e-5)
+
+    # pure-noise labels: stops early, truncates to the best round, and
+    # the returned margins match the truncated ensemble
+    y_noise = rng.standard_normal(N).astype(np.float32)
+    va_noise = rng.standard_normal(512).astype(np.float32)
+    tr2 = GBDTTrainer(cfg, mesh=make_mesh(4))
+    trees2, margins2 = tr2.train(bins, y_noise,
+                                 eval_set=(va_bins, va_noise),
+                                 early_stopping_rounds=2)
+    assert len(trees2) < 8
+    best = int(np.argmin(tr2.eval_history_))
+    assert len(trees2) == best + 1
+    np.testing.assert_allclose(margins2[:N], tr2.predict(bins, trees2),
+                               rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError):
+        tr2.train(bins, y, early_stopping_rounds=3)   # no eval_set
+
+
 def test_sample_weight_and_importance(rng):
     """Instance weights steer training (a heavily-weighted subset
     dominates); feature importance concentrates on the signal feature."""

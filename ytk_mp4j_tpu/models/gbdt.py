@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ytk_mp4j_tpu.models._base import DataParallelTrainer
+from ytk_mp4j_tpu.models._base import (DataParallelTrainer,
+                                       per_example_loss)
 from ytk_mp4j_tpu.ops.hist_kernel import split_bf16
 
 
@@ -542,6 +543,8 @@ class GBDTTrainer(DataParallelTrainer):
         self.cfg = cfg
         self._step = None
         self._predict = None
+        self._margin_step = None
+        self.eval_history_: list[float] = []
 
     def _build_step(self):
         cfg = self.cfg
@@ -596,12 +599,22 @@ class GBDTTrainer(DataParallelTrainer):
 
     def train(self, bins: np.ndarray, y: np.ndarray,
               n_trees: int | None = None, seed: int = 0,
-              sample_weight: np.ndarray | None = None):
+              sample_weight: np.ndarray | None = None,
+              eval_set=None, early_stopping_rounds: int | None = None):
         """Full boosting run; returns (trees, final margins [padded] —
         [N] for scalar objectives, [N, n_classes] for softmax).
         ``seed`` drives the per-tree stochastic-boosting masks when
         cfg.subsample/colsample < 1 (same seed -> same trees);
-        ``sample_weight`` scales per-instance g/h contributions."""
+        ``sample_weight`` scales per-instance g/h contributions.
+
+        ``eval_set=(bins_va, y_va)`` evaluates the objective's metric on
+        held-out data after every round (margins updated incrementally,
+        one tree per round — not a full re-predict); with
+        ``early_stopping_rounds=k`` training stops after k rounds
+        without improvement and the returned ensemble is truncated to
+        the best round. The per-round metric history is available as
+        ``self.eval_history_`` afterwards.
+        """
         if self._step is None:
             self._step = self._build_step()
         if self.cfg.loss == "softmax":
@@ -615,6 +628,22 @@ class GBDTTrainer(DataParallelTrainer):
             y = np.asarray(y, np.float32)
         dbins, dy, dpreds, dw = self.shard_data(
             np.asarray(bins, np.int32), y, sample_weight=sample_weight)
+
+        if early_stopping_rounds is not None and eval_set is None:
+            raise ValueError(
+                "early_stopping_rounds requires an eval_set")
+        va = None
+        if eval_set is not None:
+            va_bins = jnp.asarray(np.asarray(eval_set[0], np.int32))
+            va_y = np.asarray(eval_set[1])
+            va_margins = None
+            va = (va_bins, va_y)
+        self.eval_history_ = []
+        best_metric, best_round = np.inf, -1
+        # device-side margin snapshots of the early-stop window, so the
+        # returned margins can be rolled back to the kept ensemble
+        snaps: dict[int, object] = {}
+
         base_key = jax.random.key(seed)
         trees = []
         for i in range(n_trees if n_trees is not None
@@ -622,10 +651,61 @@ class GBDTTrainer(DataParallelTrainer):
             kd = jax.random.key_data(jax.random.fold_in(base_key, i))
             dpreds, tree = self._step(dbins, dy, dpreds, dw, kd)
             trees.append(tree)
+            if va is not None:
+                va_margins = self._update_margins(va[0], tree, va_margins)
+                metric = self._eval_metric(np.asarray(va_margins), va[1])
+                self.eval_history_.append(metric)
+                if early_stopping_rounds is not None:
+                    snaps[i] = dpreds
+                    snaps.pop(i - early_stopping_rounds - 1, None)
+                if metric < best_metric - 1e-12:
+                    best_metric, best_round = metric, i
+                elif (early_stopping_rounds is not None
+                      and i - best_round >= early_stopping_rounds):
+                    if best_round >= 0:     # a NaN-only history keeps all
+                        trees = trees[:best_round + 1]
+                        dpreds = snaps[best_round]
+                    break
         preds = self._to_host(dpreds)
         if self.cfg.loss == "softmax":
             return trees, preds.reshape(-1, self.cfg.n_classes)
         return trees, preds.reshape(-1)
+
+    def _update_margins(self, bins, tree, margins):
+        """Incrementally add one round's tree output to held-out
+        margins (jitted once per trainer)."""
+        cfg = self.cfg
+        if self._margin_step is None:
+            softmax = cfg.loss == "softmax"
+
+            @jax.jit
+            def add(bins, tree, margins):
+                if softmax:
+                    delta = jnp.stack(
+                        [predict_tree(bins, t, cfg) for t in tree],
+                        axis=1)
+                else:
+                    delta = predict_tree(bins, tree, cfg)
+                return margins + cfg.learning_rate * delta
+
+            self._margin_step = add
+        if margins is None:
+            shape = ((bins.shape[0], cfg.n_classes)
+                     if cfg.loss == "softmax" else (bins.shape[0],))
+            margins = jnp.zeros(shape, jnp.float32)
+        return self._margin_step(bins, tree, margins)
+
+    def _eval_metric(self, margins: np.ndarray, y: np.ndarray) -> float:
+        """The objective's validation metric (lower is better):
+        squared -> mse, logistic -> logloss, softmax -> logloss."""
+        if self.cfg.loss == "squared":
+            return float(np.mean((margins - y) ** 2))
+        if self.cfg.loss == "logistic":
+            return float(np.mean(np.asarray(
+                per_example_loss(margins, y, "logistic"))))
+        z = margins - margins.max(axis=1, keepdims=True)
+        logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+        return float(-np.mean(logp[np.arange(len(y)), y.astype(int)]))
 
     def predict(self, bins: np.ndarray, trees,
                 proba: bool = False) -> np.ndarray:
