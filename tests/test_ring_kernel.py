@@ -1,0 +1,49 @@
+"""Pallas RDMA ring allreduce (ops/ring_kernel.py): interpret-mode
+differential tests on multi-device CPU meshes."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.ops.ring_kernel import ring_allreduce_kernel
+from ytk_mp4j_tpu.parallel import make_mesh
+
+
+def _run(n, data):
+    mesh = make_mesh(n)
+
+    # the pallas interpret path is not vma-aware (see
+    # gbdt.build_histograms); check_vma off for the wrapper
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P("mp4j"), check_vma=False)
+    def f(x):
+        return ring_allreduce_kernel(x[0], "mp4j", interpret=True)[None]
+
+    return np.asarray(jax.jit(f)(jnp.asarray(data)))
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_matches_sum(rng, n):
+    L = 4 * n
+    data = rng.standard_normal((n, L)).astype(np.float32)
+    out = _run(n, data)
+    want = data.sum(0)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-6)
+
+
+def test_single_member_noop(rng):
+    data = rng.standard_normal((1, 8)).astype(np.float32)
+    out = _run(1, data)
+    np.testing.assert_array_equal(out, data)
+
+
+def test_rejects_indivisible(rng):
+    with pytest.raises(Mp4jError):
+        _run(4, np.ones((4, 7), np.float32))

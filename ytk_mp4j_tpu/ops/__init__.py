@@ -1,3 +1,3 @@
-from ytk_mp4j_tpu.ops import collectives, ring
+from ytk_mp4j_tpu.ops import collectives, ring, ring_kernel
 
-__all__ = ["collectives", "ring"]
+__all__ = ["collectives", "ring", "ring_kernel"]
