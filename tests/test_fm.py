@@ -116,6 +116,42 @@ def test_config_validation():
                n_steps=1)
 
 
+def test_eval_set_and_early_stopping(rng):
+    n, NF, nf, K = 512, 64, 3, 4
+    feats = rng.integers(0, NF, (n, K)).astype(np.int32)
+    fields = rng.integers(0, nf, (n, K)).astype(np.int32)
+    vals = np.ones((n, K), np.float32)
+    y = (feats.min(1) < 8).astype(np.float32)
+    va = (feats[:128], fields[:128], vals[:128], y[:128])
+    cfg = FMConfig(model="ffm", n_features=NF, n_fields=nf, k=3,
+                   max_nnz=K, learning_rate=0.5)
+    tr = FMTrainer(cfg, mesh=make_mesh(2))
+    params, losses = tr.fit(feats, fields, vals, y, n_steps=30,
+                            eval_set=va)
+    assert len(tr.eval_history_) == 30
+    assert tr.eval_history_[-1] < tr.eval_history_[0]
+
+    # noise labels: early stopping truncates and returns best params
+    y_noise = (rng.random(n) > 0.5).astype(np.float32)
+    va_noise = (feats[:128], fields[:128], vals[:128],
+                (rng.random(128) > 0.5).astype(np.float32))
+    tr2 = FMTrainer(cfg, mesh=make_mesh(2))
+    params2, losses2 = tr2.fit(feats, fields, vals, y_noise, n_steps=40,
+                               eval_set=va_noise,
+                               early_stopping_rounds=3)
+    assert len(losses2) < 40
+    best = int(np.argmin(tr2.eval_history_))
+    assert len(losses2) == best + 1
+    # returned params reproduce the best round's validation metric
+    assert tr2._eval_loss(params2, tr2._prep_eval(*va_noise)) == (
+        pytest.approx(min(tr2.eval_history_), rel=1e-6))
+
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    with pytest.raises(Mp4jError):
+        tr2.fit(feats, fields, vals, y, n_steps=3,
+                early_stopping_rounds=2)
+
+
 def test_save_load_params_roundtrip(rng, tmp_path):
     n, NF, nf, K = 256, 64, 3, 4
     feats = rng.integers(0, NF, (n, K)).astype(np.int32)

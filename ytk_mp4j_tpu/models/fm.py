@@ -212,6 +212,8 @@ class FMTrainer(DataParallelTrainer):
         self.sparse_capacity = sparse_capacity
         self._step = None
         self._step_key = None
+        self._eval_fn = None
+        self.eval_history_: list[float] = []
 
     @property
     def n_rows(self) -> int:
@@ -283,18 +285,9 @@ class FMTrainer(DataParallelTrainer):
         with value 0); vals: [N, K] float; y: [N].
         """
         feats = np.asarray(feats, np.int32)
-        fields = np.asarray(fields, np.int32)
-        vals = np.asarray(vals, np.float32)
         y = np.asarray(y, np.float32)
-        self._check_instances(feats, fields)
-        N, K = feats.shape
-        padK = self.cfg.max_nnz - K
-        if padK:
-            zK = ((0, 0), (0, padK))
-            feats = np.pad(feats, zK)
-            fields = np.pad(fields, zK)
-            vals = np.pad(vals, zK)
-        mask = (vals != 0).astype(np.float32)
+        feats, fields, vals, mask = self._stage_instances(feats, fields,
+                                                          vals)
         (feats, fields, vals, mask, y), per, sw = self._pad_rows(
             [feats, fields, vals, mask, y])
         put = lambda a: self._put_sharded(a, per)  # noqa: E731
@@ -302,8 +295,17 @@ class FMTrainer(DataParallelTrainer):
                 put(sw))
 
     def fit(self, feats, fields, vals, y, n_steps: int = 100, params=None,
-            seed: int = 0):
-        """Full-batch training; returns (params, losses)."""
+            seed: int = 0, eval_set=None,
+            early_stopping_rounds: int | None = None):
+        """Full-batch training; returns (params, losses).
+
+        ``eval_set=(feats_va, fields_va, vals_va, y_va)`` evaluates the
+        held-out loss after every step (history in
+        ``self.eval_history_``); ``early_stopping_rounds=k`` stops after
+        k non-improving steps and returns the best round's params.
+        """
+        if early_stopping_rounds is not None and eval_set is None:
+            raise Mp4jError("early_stopping_rounds requires an eval_set")
         sharded = self.shard_data(feats, fields, vals, y)
         # the jitted step bakes in the sparse capacity, which depends on
         # the per-shard batch size — rebuild when that changes (a stale
@@ -314,26 +316,73 @@ class FMTrainer(DataParallelTrainer):
             self._step_key = per_shard_slots
         if params is None:
             params = self.init_params(seed)
+        va = None
+        if eval_set is not None:
+            va = self._prep_eval(*eval_set)
+        self.eval_history_ = []
+        best_metric, best_round, best_params = np.inf, -1, None
         losses = []
-        for _ in range(n_steps):
+        for i in range(n_steps):
             params, loss = self._step(params, *sharded)
             # bound in-flight programs; see models/linear.py fit()
             losses.append(jax.block_until_ready(loss))
+            if va is not None:
+                metric = self._eval_loss(params, va)
+                self.eval_history_.append(metric)
+                if metric < best_metric - 1e-12:
+                    best_metric, best_round = metric, i
+                    if early_stopping_rounds is not None:
+                        # rollback snapshot only when it can be used —
+                        # it pins a full second param set on device
+                        best_params = params
+                elif (early_stopping_rounds is not None
+                      and i - best_round >= early_stopping_rounds):
+                    if best_params is not None:
+                        params = best_params
+                        losses = losses[:best_round + 1]
+                    break
         return params, np.asarray(jax.device_get(losses))
 
-    def predict(self, params, feats, fields, vals):
+    def _stage_instances(self, feats, fields, vals):
+        """The one staging path for padded-sparse instances: validate id
+        ranges, pad the slot axis to max_nnz, derive the nonzero mask
+        (padded slots carry value 0). Shared by shard_data, predict and
+        eval so the padding convention cannot drift between them."""
         feats = np.asarray(feats, np.int32)
         fields = np.asarray(fields, np.int32)
+        vals = np.asarray(vals, np.float32)
         self._check_instances(feats, fields)
-        feats = jnp.asarray(feats)
-        fields = jnp.asarray(fields)
-        vals = jnp.asarray(np.asarray(vals, np.float32))
-        K = feats.shape[1]
-        if K < self.cfg.max_nnz:
-            padK = ((0, 0), (0, self.cfg.max_nnz - K))
-            feats = jnp.pad(feats, padK)
-            fields = jnp.pad(fields, padK)
-            vals = jnp.pad(vals, padK)
-        mask = (vals != 0).astype(jnp.float32)
-        return np.asarray(predict(params, feats, fields, vals, mask,
-                                  self.cfg))
+        padK = self.cfg.max_nnz - feats.shape[1]
+        if padK:
+            zK = ((0, 0), (0, padK))
+            feats, fields, vals = (np.pad(feats, zK), np.pad(fields, zK),
+                                   np.pad(vals, zK))
+        mask = (vals != 0).astype(np.float32)
+        return feats, fields, vals, mask
+
+    def _prep_eval(self, feats, fields, vals, y):
+        """Pad + stage a held-out batch once for per-step evaluation."""
+        feats, fields, vals, mask = self._stage_instances(feats, fields,
+                                                          vals)
+        return (jnp.asarray(feats), jnp.asarray(fields),
+                jnp.asarray(vals), jnp.asarray(mask),
+                jnp.asarray(np.asarray(y, np.float32)))
+
+    def _eval_loss(self, params, va) -> float:
+        if self._eval_fn is None:
+            cfg = self.cfg
+
+            @jax.jit
+            def run(params, feats, fields, vals, mask, y):
+                z = _score(params, feats, fields, vals, mask, cfg)
+                return jnp.mean(per_example_loss(z, y, cfg.loss))
+
+            self._eval_fn = run
+        return float(self._eval_fn(params, *va))
+
+    def predict(self, params, feats, fields, vals):
+        feats, fields, vals, mask = self._stage_instances(feats, fields,
+                                                          vals)
+        return np.asarray(predict(params, jnp.asarray(feats),
+                                  jnp.asarray(fields), jnp.asarray(vals),
+                                  jnp.asarray(mask), self.cfg))
