@@ -129,7 +129,8 @@ def test_eval_set_and_early_stopping(rng):
     np.testing.assert_allclose(margins2[:N], tr2.predict(bins, trees2),
                                rtol=1e-5, atol=1e-6)
 
-    with pytest.raises(ValueError):
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    with pytest.raises(Mp4jError):
         tr2.train(bins, y, early_stopping_rounds=3)   # no eval_set
 
 

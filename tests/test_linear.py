@@ -104,3 +104,27 @@ def test_save_load_params_roundtrip(rng, tmp_path):
     cfg3, params3 = LinearTrainer.load_params(path2, LinearConfig)
     for a, b in zip(params2, params3):
         np.testing.assert_array_equal(a, b)
+
+
+def test_eval_set_and_early_stopping(rng):
+    x_all, y_all, _ = make_regression(rng, n=500, d=4)
+    x, y = x_all[:400], y_all[:400]
+    x_va, y_va = x_all[400:], y_all[400:]
+    cfg = LinearConfig(n_features=4, learning_rate=0.3)
+    tr = LinearTrainer(cfg, mesh=make_mesh(2))
+    params, losses = tr.fit(x, y, n_steps=25, eval_set=(x_va, y_va))
+    assert len(tr.eval_history_) == 25
+    assert tr.eval_history_[-1] < tr.eval_history_[0]
+
+    # noise validation labels: early stop truncates to the best round
+    y_noise = rng.standard_normal(100).astype(np.float32)
+    tr2 = LinearTrainer(cfg, mesh=make_mesh(2))
+    params2, losses2 = tr2.fit(x, y, n_steps=60,
+                               eval_set=(x_va, y_noise),
+                               early_stopping_rounds=3)
+    assert len(losses2) < 60
+    best = int(np.argmin(tr2.eval_history_))
+    assert len(losses2) == best + 1
+
+    with pytest.raises(Mp4jError):
+        tr2.fit(x, y, n_steps=3, early_stopping_rounds=2)

@@ -163,7 +163,13 @@ def check_gbdt_global_mesh(comm) -> int:
                      n_trees=2)
 
     dist = GBDTTrainer(cfg, mesh=global_mesh())
-    trees_d, preds_d = dist.train(bins, y)
+    # eval_set exercises the multi-process per-round evaluation path
+    # (trees from the global mesh consumed by a local jit)
+    trees_d, preds_d = dist.train(bins, y, eval_set=(bins[:64], y[:64]))
+    if len(dist.eval_history_) != cfg.n_trees or not all(
+            np.isfinite(m) for m in dist.eval_history_):
+        comm.error("gbdt eval history MISMATCH")
+        fails += 1
 
     local = GBDTTrainer(
         cfg, mesh=make_mesh(1, devices=jax.local_devices()[:1]))

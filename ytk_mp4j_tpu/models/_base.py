@@ -55,6 +55,38 @@ def load_npz(path: str, config_cls):
     return cfg, arrays
 
 
+class EarlyStopper:
+    """The shared early-stopping state machine (GBDT/linear/FM fits).
+
+    ``update(metric, round_idx, state)`` records one round; ``state``
+    is an arbitrary rollback payload kept only for the best round and
+    only when stopping is enabled (a snapshot can pin large device
+    buffers). Returns True when ``rounds`` consecutive non-improving
+    rounds have passed. NaN metrics never count as improvements, so a
+    NaN-only history leaves ``best_round == -1`` (callers keep
+    everything in that case rather than truncating to empty).
+    """
+
+    _MIN_DELTA = 1e-12
+
+    def __init__(self, rounds: int | None):
+        self.rounds = rounds
+        self.best_metric = np.inf
+        self.best_round = -1
+        self.best_state = None
+        self.history: list[float] = []
+
+    def update(self, metric: float, round_idx: int, state=None) -> bool:
+        self.history.append(metric)
+        if metric < self.best_metric - self._MIN_DELTA:
+            self.best_metric, self.best_round = metric, round_idx
+            if self.rounds is not None:
+                self.best_state = state
+            return False
+        return (self.rounds is not None
+                and round_idx - self.best_round >= self.rounds)
+
+
 class DataParallelTrainer:
     """Mesh bookkeeping + sample sharding shared by the trainers."""
 
@@ -117,6 +149,17 @@ class DataParallelTrainer:
         ``config_cls`` is the trainer's config dataclass."""
         cfg, arrays = load_npz(path, config_cls)
         return cfg, tuple(arrays[f"p_{i}"] for i in range(len(arrays)))
+
+    @classmethod
+    def _local_values(cls, tree):
+        """Make every array in a pytree usable in a plain (local) jit:
+        arrays spanning non-addressable devices (multi-process meshes)
+        are fetched via the collective ``_to_host``; everything else
+        passes through untouched. Used by the per-step eval paths."""
+        return jax.tree_util.tree_map(
+            lambda p: (cls._to_host(p)
+                       if not getattr(p, "is_fully_addressable", True)
+                       else p), tree)
 
     @staticmethod
     def _to_host(x) -> np.ndarray:

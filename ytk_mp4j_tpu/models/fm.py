@@ -43,7 +43,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
-from ytk_mp4j_tpu.models._base import DataParallelTrainer, per_example_loss
+from ytk_mp4j_tpu.models._base import (DataParallelTrainer,
+                                       EarlyStopper, per_example_loss)
 from ytk_mp4j_tpu.operators import Operators
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 
@@ -284,7 +285,6 @@ class FMTrainer(DataParallelTrainer):
         feats/fields: [N, K] int (K <= max_nnz; padded slots = any id
         with value 0); vals: [N, K] float; y: [N].
         """
-        feats = np.asarray(feats, np.int32)
         y = np.asarray(y, np.float32)
         feats, fields, vals, mask = self._stage_instances(feats, fields,
                                                           vals)
@@ -319,28 +319,19 @@ class FMTrainer(DataParallelTrainer):
         va = None
         if eval_set is not None:
             va = self._prep_eval(*eval_set)
-        self.eval_history_ = []
-        best_metric, best_round, best_params = np.inf, -1, None
+        stopper = EarlyStopper(early_stopping_rounds)
+        self.eval_history_ = stopper.history
         losses = []
         for i in range(n_steps):
             params, loss = self._step(params, *sharded)
             # bound in-flight programs; see models/linear.py fit()
             losses.append(jax.block_until_ready(loss))
-            if va is not None:
-                metric = self._eval_loss(params, va)
-                self.eval_history_.append(metric)
-                if metric < best_metric - 1e-12:
-                    best_metric, best_round = metric, i
-                    if early_stopping_rounds is not None:
-                        # rollback snapshot only when it can be used —
-                        # it pins a full second param set on device
-                        best_params = params
-                elif (early_stopping_rounds is not None
-                      and i - best_round >= early_stopping_rounds):
-                    if best_params is not None:
-                        params = best_params
-                        losses = losses[:best_round + 1]
-                    break
+            if va is not None and stopper.update(
+                    self._eval_loss(params, va), i, state=params):
+                if stopper.best_state is not None:
+                    params = stopper.best_state
+                    losses = losses[:stopper.best_round + 1]
+                break
         return params, np.asarray(jax.device_get(losses))
 
     def _stage_instances(self, feats, fields, vals):
@@ -378,7 +369,9 @@ class FMTrainer(DataParallelTrainer):
                 return jnp.mean(per_example_loss(z, y, cfg.loss))
 
             self._eval_fn = run
-        return float(self._eval_fn(params, *va))
+        # params may span non-addressable devices on multi-process
+        # meshes; a plain local jit cannot consume those directly
+        return float(self._eval_fn(self._local_values(params), *va))
 
     def predict(self, params, feats, fields, vals):
         feats, fields, vals, mask = self._stage_instances(feats, fields,

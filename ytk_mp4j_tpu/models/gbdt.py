@@ -35,7 +35,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.models._base import (DataParallelTrainer,
-                                       per_example_loss)
+                                       EarlyStopper, per_example_loss)
+from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.ops.hist_kernel import split_bf16
 
 
@@ -630,19 +631,15 @@ class GBDTTrainer(DataParallelTrainer):
             np.asarray(bins, np.int32), y, sample_weight=sample_weight)
 
         if early_stopping_rounds is not None and eval_set is None:
-            raise ValueError(
-                "early_stopping_rounds requires an eval_set")
+            raise Mp4jError("early_stopping_rounds requires an eval_set")
         va = None
         if eval_set is not None:
             va_bins = jnp.asarray(np.asarray(eval_set[0], np.int32))
             va_y = np.asarray(eval_set[1])
             va_margins = None
             va = (va_bins, va_y)
-        self.eval_history_ = []
-        best_metric, best_round = np.inf, -1
-        # device-side margin snapshots of the early-stop window, so the
-        # returned margins can be rolled back to the kept ensemble
-        snaps: dict[int, object] = {}
+        stopper = EarlyStopper(early_stopping_rounds)
+        self.eval_history_ = stopper.history
 
         base_key = jax.random.key(seed)
         trees = []
@@ -654,17 +651,11 @@ class GBDTTrainer(DataParallelTrainer):
             if va is not None:
                 va_margins = self._update_margins(va[0], tree, va_margins)
                 metric = self._eval_metric(np.asarray(va_margins), va[1])
-                self.eval_history_.append(metric)
-                if early_stopping_rounds is not None:
-                    snaps[i] = dpreds
-                    snaps.pop(i - early_stopping_rounds - 1, None)
-                if metric < best_metric - 1e-12:
-                    best_metric, best_round = metric, i
-                elif (early_stopping_rounds is not None
-                      and i - best_round >= early_stopping_rounds):
-                    if best_round >= 0:     # a NaN-only history keeps all
-                        trees = trees[:best_round + 1]
-                        dpreds = snaps[best_round]
+                # state: the margin snapshot matching the kept ensemble
+                if stopper.update(metric, i, state=dpreds):
+                    if stopper.best_state is not None:
+                        trees = trees[:stopper.best_round + 1]
+                        dpreds = stopper.best_state
                     break
         preds = self._to_host(dpreds)
         if self.cfg.loss == "softmax":
@@ -693,7 +684,9 @@ class GBDTTrainer(DataParallelTrainer):
             shape = ((bins.shape[0], cfg.n_classes)
                      if cfg.loss == "softmax" else (bins.shape[0],))
             margins = jnp.zeros(shape, jnp.float32)
-        return self._margin_step(bins, tree, margins)
+        # trees from the shard_map step may span non-addressable devices
+        # on multi-process meshes; fetch them for this local jit
+        return self._margin_step(bins, self._local_values(tree), margins)
 
     def _eval_metric(self, margins: np.ndarray, y: np.ndarray) -> float:
         """The objective's validation metric (lower is better):

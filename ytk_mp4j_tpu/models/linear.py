@@ -32,7 +32,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
-from ytk_mp4j_tpu.models._base import DataParallelTrainer, per_example_loss
+from ytk_mp4j_tpu.models._base import (DataParallelTrainer,
+                                       EarlyStopper, per_example_loss)
 
 LOSSES = ("squared", "logistic")
 
@@ -126,6 +127,8 @@ class LinearTrainer(DataParallelTrainer):
         super().__init__(mesh=mesh, n_devices=n_devices)
         self.cfg = cfg
         self._step = None
+        self._eval_fn = None
+        self.eval_history_: list[float] = []
 
     def init_params(self):
         return (jnp.zeros((self.cfg.n_features,), jnp.float32),
@@ -157,16 +160,38 @@ class LinearTrainer(DataParallelTrainer):
                 self._put_sharded(sw, per))
 
     def fit(self, x: np.ndarray, y: np.ndarray, n_steps: int = 100,
-            params=None):
-        """Run ``n_steps`` full-batch steps; returns (params, losses)."""
+            params=None, eval_set=None,
+            early_stopping_rounds: int | None = None):
+        """Run ``n_steps`` full-batch steps; returns (params, losses).
+
+        ``eval_set=(x_va, y_va)`` tracks held-out loss per step (history
+        in ``self.eval_history_``); ``early_stopping_rounds=k`` stops
+        after k non-improving steps and returns the best round's params.
+        """
+        if early_stopping_rounds is not None and eval_set is None:
+            raise Mp4jError("early_stopping_rounds requires an eval_set")
         if self._step is None:
             self._step = self._build_step()
         dx, dy, dsw = self.shard_data(x, y)
         if params is None:
             params = self.init_params()
         vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+        va = None
+        if eval_set is not None:
+            x_va = np.asarray(eval_set[0], np.float32)
+            y_va = np.asarray(eval_set[1], np.float32)
+            if x_va.ndim != 2 or x_va.shape[1] != self.cfg.n_features:
+                raise Mp4jError(
+                    f"eval x must be [N, {self.cfg.n_features}], "
+                    f"got {x_va.shape}")
+            if y_va.shape != (x_va.shape[0],):
+                raise Mp4jError(
+                    f"eval y must be [{x_va.shape[0]}], got {y_va.shape}")
+            va = (jnp.asarray(x_va), jnp.asarray(y_va))
+        stopper = EarlyStopper(early_stopping_rounds)
+        self.eval_history_ = stopper.history
         losses = []
-        for _ in range(n_steps):
+        for i in range(n_steps):
             params, vel, loss = self._step(params, vel, dx, dy, dsw)
             # Synchronize each step: on hosts with fewer cores than mesh
             # devices, letting hundreds of small multi-collective programs
@@ -176,7 +201,27 @@ class LinearTrainer(DataParallelTrainer):
             # anyway) and keeps the thread demand bounded.
             loss = jax.block_until_ready(loss)
             losses.append(loss)
+            if va is not None and stopper.update(
+                    self._eval_loss(params, va), i, state=(params, vel)):
+                if stopper.best_state is not None:
+                    params, vel = stopper.best_state
+                    losses = losses[:stopper.best_round + 1]
+                break
         return params, np.asarray(jax.device_get(losses))
+
+    def _eval_loss(self, params, va) -> float:
+        if self._eval_fn is None:
+            cfg = self.cfg
+
+            @jax.jit
+            def run(params, x, y):
+                w, b = params
+                return jnp.mean(per_example_loss(x @ w + b, y, cfg.loss))
+
+            self._eval_fn = run
+        # params may span non-addressable devices on multi-process
+        # meshes; a plain local jit cannot consume those directly
+        return float(self._eval_fn(self._local_values(params), *va))
 
     def predict(self, params, x: np.ndarray) -> np.ndarray:
         x = jnp.asarray(np.asarray(x, np.float32))
