@@ -163,7 +163,8 @@ def test_sample_weight_and_importance(rng):
     imp2 = tr2.feature_importance(trees2)
     assert imp2[3] == 1.0, imp2
 
-    with pytest.raises(ValueError):
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    with pytest.raises(Mp4jError):
         tr.train(bins, y, sample_weight=np.ones(N - 1, np.float32))
 
 
@@ -254,9 +255,10 @@ def test_softmax_out_of_range_labels_rejected(rng):
                      loss="softmax", n_classes=3)
     tr = GBDTTrainer(cfg, mesh=make_mesh(1))
     bins = rng.integers(0, 4, (32, 2)).astype(np.int32)
-    with pytest.raises(ValueError):
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    with pytest.raises(Mp4jError):
         tr.train(bins, np.full(32, 3, np.int32))     # == n_classes
-    with pytest.raises(ValueError):
+    with pytest.raises(Mp4jError):
         tr.train(bins, np.full(32, -1, np.int32))
 
 

@@ -260,6 +260,25 @@ class ProcessCommSlave(CommSlave):
     def _recv_buf(self, operand: Operand, n: int) -> np.ndarray:
         return np.empty(n, dtype=operand.dtype)
 
+    def _send_segment(self, peer: int, chunk, operand: Operand) -> None:
+        """One-directional segment send for the tree/rooted collectives:
+        raw when the job+operand allow, framed otherwise."""
+        if self._raw_ok(operand):
+            self._exchange_raw(peer, peer, chunk, None)
+        else:
+            self._send(peer, np.ascontiguousarray(chunk)
+                       if isinstance(chunk, np.ndarray) else chunk,
+                       compress=operand.compress)
+
+    def _recv_segment(self, peer: int, n: int, operand: Operand):
+        """Counterpart of :meth:`_send_segment`: returns the received
+        ``n``-element array (raw path) or framed payload."""
+        if self._raw_ok(operand):
+            buf = self._recv_buf(operand, n)
+            self._exchange_raw(peer, peer, None, buf)
+            return buf
+        return self._recv(peer)
+
     def _exchange_raw_into(self, send_peer: int, recv_peer: int,
                            sarr: np.ndarray | None, rview: np.ndarray,
                            operand: Operand) -> np.ndarray:
@@ -541,14 +560,13 @@ class ProcessCommSlave(CommSlave):
         while mask < self._n:
             if vr & mask:
                 peer = ((vr - mask) + root) % self._n
-                self._send(peer, acc if not isinstance(acc, np.ndarray)
-                           else np.ascontiguousarray(acc),
-                           compress=operand.compress)
+                self._send_segment(peer, acc, operand)
                 break
             else:
                 src_vr = vr + mask
                 if src_vr < self._n:
-                    recv = self._recv((src_vr + root) % self._n)
+                    peer = (src_vr + root) % self._n
+                    recv = self._recv_segment(peer, hi - lo, operand)
                     acc = self._merge(operator, operand, acc, recv)
             mask <<= 1
         if self._rank == root:
@@ -570,14 +588,11 @@ class ProcessCommSlave(CommSlave):
                 # every holder (vr < mask) sends to vr + mask this round
                 dst_vr = vr + mask
                 if dst_vr < self._n:
-                    chunk = arr[lo:hi]
-                    self._send((dst_vr + root) % self._n,
-                               np.ascontiguousarray(chunk)
-                               if isinstance(chunk, np.ndarray) else chunk,
-                               compress=operand.compress)
+                    self._send_segment((dst_vr + root) % self._n,
+                                       arr[lo:hi], operand)
             elif mask <= vr < 2 * mask:
-                recv = self._recv(((vr - mask) + root) % self._n)
-                arr[lo:hi] = recv
+                peer = ((vr - mask) + root) % self._n
+                arr[lo:hi] = self._recv_segment(peer, hi - lo, operand)
                 have = True
             mask <<= 1
         return arr
@@ -596,14 +611,10 @@ class ProcessCommSlave(CommSlave):
                 if peer == root:
                     continue
                 s, e = ranges[peer]
-                recv = self._recv(peer)
-                arr[s:e] = recv
+                arr[s:e] = self._recv_segment(peer, e - s, operand)
         else:
             s, e = ranges[self._rank]
-            chunk = arr[s:e]
-            self._send(root, np.ascontiguousarray(chunk)
-                       if isinstance(chunk, np.ndarray) else chunk,
-                       compress=operand.compress)
+            self._send_segment(root, arr[s:e], operand)
         return arr
 
     def scatter_array(self, arr, operand: Operand = Operands.FLOAT,
@@ -620,13 +631,10 @@ class ProcessCommSlave(CommSlave):
                 if peer == root:
                     continue
                 s, e = ranges[peer]
-                chunk = arr[s:e]
-                self._send(peer, np.ascontiguousarray(chunk)
-                           if isinstance(chunk, np.ndarray) else chunk,
-                           compress=operand.compress)
+                self._send_segment(peer, arr[s:e], operand)
         else:
             s, e = ranges[self._rank]
-            arr[s:e] = self._recv(root)
+            arr[s:e] = self._recv_segment(root, e - s, operand)
         return arr
 
 

@@ -587,7 +587,7 @@ class GBDTTrainer(DataParallelTrainer):
         if sample_weight is not None:
             sw = np.asarray(sample_weight, np.float32)
             if sw.shape != (N,):
-                raise ValueError(
+                raise Mp4jError(
                     f"sample_weight must be [N={N}], got {sw.shape}")
             w[:N] *= sw
         if self.cfg.loss == "softmax":
@@ -621,7 +621,7 @@ class GBDTTrainer(DataParallelTrainer):
         if self.cfg.loss == "softmax":
             y = np.asarray(y, np.int32)
             if y.size and (y.min() < 0 or y.max() >= self.cfg.n_classes):
-                raise ValueError(
+                raise Mp4jError(
                     f"softmax labels must lie in [0, "
                     f"{self.cfg.n_classes}), got range "
                     f"[{y.min()}, {y.max()}]")
