@@ -88,9 +88,10 @@ def test_logistic_objective_fits_and_matches_distributed(rng):
 
 
 def test_bad_loss_rejected():
-    with pytest.raises(ValueError):
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    with pytest.raises(Mp4jError):
         GBDTConfig(loss="hinge")
-    with pytest.raises(ValueError):
+    with pytest.raises(Mp4jError):
         GBDTConfig(loss="softmax", n_classes=1)
 
 
@@ -223,9 +224,10 @@ def test_stochastic_boosting(rng):
     trees_c, preds_c = tr.train(bins, y, seed=1)
     assert not np.array_equal(preds_a, preds_c)       # different seed
 
-    with pytest.raises(ValueError):
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    with pytest.raises(Mp4jError):
         GBDTConfig(subsample=0.0)
-    with pytest.raises(ValueError):
+    with pytest.raises(Mp4jError):
         GBDTConfig(colsample=1.5)
 
 

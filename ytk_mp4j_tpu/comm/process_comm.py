@@ -272,12 +272,24 @@ class ProcessCommSlave(CommSlave):
 
     def _recv_segment(self, peer: int, n: int, operand: Operand):
         """Counterpart of :meth:`_send_segment`: returns the received
-        ``n``-element array (raw path) or framed payload."""
+        ``n``-element array (raw path) or framed payload. For receives
+        whose destination view already exists, prefer
+        :meth:`_recv_segment_into` (no temp buffer)."""
         if self._raw_ok(operand):
             buf = self._recv_buf(operand, n)
             self._exchange_raw(peer, peer, None, buf)
             return buf
         return self._recv(peer)
+
+    def _recv_segment_into(self, peer: int, arr, s: int, e: int,
+                           operand: Operand) -> None:
+        """Receive a segment directly into ``arr[s:e]`` — in place on
+        the raw path (no temp buffer/copy); framed and list containers
+        assign through the container."""
+        if self._raw_ok(operand) and isinstance(arr, np.ndarray):
+            self._exchange_raw_into(peer, peer, None, arr[s:e], operand)
+        else:
+            arr[s:e] = self._recv(peer)
 
     def _exchange_raw_into(self, send_peer: int, recv_peer: int,
                            sarr: np.ndarray | None, rview: np.ndarray,
@@ -592,7 +604,7 @@ class ProcessCommSlave(CommSlave):
                                        arr[lo:hi], operand)
             elif mask <= vr < 2 * mask:
                 peer = ((vr - mask) + root) % self._n
-                arr[lo:hi] = self._recv_segment(peer, hi - lo, operand)
+                self._recv_segment_into(peer, arr, lo, hi, operand)
                 have = True
             mask <<= 1
         return arr
@@ -611,7 +623,7 @@ class ProcessCommSlave(CommSlave):
                 if peer == root:
                     continue
                 s, e = ranges[peer]
-                arr[s:e] = self._recv_segment(peer, e - s, operand)
+                self._recv_segment_into(peer, arr, s, e, operand)
         else:
             s, e = ranges[self._rank]
             self._send_segment(root, arr[s:e], operand)
@@ -634,7 +646,7 @@ class ProcessCommSlave(CommSlave):
                 self._send_segment(peer, arr[s:e], operand)
         else:
             s, e = ranges[self._rank]
-            arr[s:e] = self._recv_segment(root, e - s, operand)
+            self._recv_segment_into(root, arr, s, e, operand)
         return arr
 
 

@@ -78,20 +78,22 @@ class GBDTConfig:
     hist_mode: str = "pallas"
 
     def __post_init__(self):
+        # Mp4jError for ALL input validation, matching train() and the
+        # linear/FM config classes (the library-wide exception type)
         if self.hist_mode not in ("pallas", "matmul", "pair", "flat"):
-            raise ValueError(
+            raise Mp4jError(
                 f"hist_mode must be 'pallas', 'matmul', 'pair' or "
                 f"'flat', got {self.hist_mode!r}")
         if self.loss not in ("squared", "logistic", "softmax"):
-            raise ValueError(
+            raise Mp4jError(
                 f"loss must be 'squared', 'logistic' or 'softmax', "
                 f"got {self.loss!r}")
         if self.loss == "softmax" and self.n_classes < 2:
-            raise ValueError(
+            raise Mp4jError(
                 f"softmax needs n_classes >= 2, got {self.n_classes}")
         if not (0.0 < self.subsample <= 1.0
                 and 0.0 < self.colsample <= 1.0):
-            raise ValueError(
+            raise Mp4jError(
                 f"subsample/colsample must be in (0, 1], got "
                 f"{self.subsample}/{self.colsample}")
 
