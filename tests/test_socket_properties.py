@@ -1,0 +1,121 @@
+"""Property-based fuzz of the socket collective family: random rank
+counts (including 1 and non-powers-of-2), dtypes (including BFLOAT16),
+compression, algorithms, operators, and sub-ranges — all against the
+numpy oracle over real loopback TCP (SURVEY.md section 4's check-program
+pattern, driven by hypothesis)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from tests.helpers import run_slaves
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+_DTYPES = {
+    "FLOAT": Operands.FLOAT,
+    "DOUBLE": Operands.DOUBLE,
+    "INT": Operands.INT,
+    "LONG": Operands.LONG,
+    "SHORT": Operands.SHORT,
+}
+_BF16 = getattr(Operands, "BFLOAT16", None)
+if _BF16 is not None:
+    _DTYPES["BFLOAT16"] = _BF16
+
+_NP_OPS = {"SUM": np.sum, "MAX": np.max, "MIN": np.min}
+
+
+@settings(max_examples=12, deadline=None)
+@given(
+    n=st.integers(1, 5),
+    length=st.integers(1, 60),
+    dtype_name=st.sampled_from(sorted(_DTYPES)),
+    op_name=st.sampled_from(sorted(_NP_OPS)),
+    algo=st.sampled_from(["rhd", "ring"]),
+    compress=st.booleans(),
+    data=st.data(),
+)
+def test_allreduce_fuzz(n, length, dtype_name, op_name, algo, compress,
+                        data):
+    operand = _DTYPES[dtype_name]
+    if compress:
+        operand = Operands.compressed(operand)
+    lo = data.draw(st.integers(0, length), label="lo")
+    hi = data.draw(st.integers(lo, length), label="hi")
+    seed = data.draw(st.integers(0, 2**31), label="seed")
+    rng = np.random.default_rng(seed)
+
+    if operand.dtype.kind == "f" or operand.dtype.kind == "V":
+        base = [rng.uniform(-4, 4, length).astype(operand.dtype)
+                for _ in range(n)]
+    else:
+        base = [rng.integers(-20, 20, length).astype(operand.dtype)
+                for _ in range(n)]
+    want = (_NP_OPS[op_name](
+        np.stack([b[lo:hi].astype(np.float64) for b in base]), axis=0)
+        if hi > lo else None)
+
+    def fn(slave, rank):
+        arr = base[rank].copy()
+        slave.allreduce_array(arr, operand, Operators.by_name(op_name),
+                              from_=lo, to=hi, algo=algo)
+        return arr
+
+    outs = run_slaves(n, fn)
+    # tolerance scaled to the dtype: bf16 rounds at ~2^-8 RELATIVE TO
+    # THE INTERMEDIATE partial sums (magnitude up to n*4), so the
+    # absolute floor must cover cancellation down to |want| ~ 0;
+    # f32/f64/int paths are (near-)exact
+    if dtype_name == "BFLOAT16":
+        rtol, atol = 0.05, n * 4 * 2 ** -8 * 2
+    else:
+        rtol, atol = 1e-5, 1e-5
+    for out, orig in zip(outs, base):
+        if hi > lo:
+            np.testing.assert_allclose(
+                np.asarray(out[lo:hi], np.float64), want, rtol=rtol,
+                atol=atol)
+        np.testing.assert_array_equal(np.asarray(out[:lo]),
+                                      np.asarray(orig[:lo]))
+        np.testing.assert_array_equal(np.asarray(out[hi:]),
+                                      np.asarray(orig[hi:]))
+
+
+@settings(max_examples=8, deadline=None)
+@given(
+    n=st.integers(2, 5),
+    length=st.integers(2, 40),
+    root=st.integers(0, 4),
+    seed=st.integers(0, 2**31),
+)
+def test_rooted_collectives_fuzz(n, length, root, seed):
+    """broadcast + gather + scatter with a random root and ranges."""
+    root = root % n
+    rng = np.random.default_rng(seed)
+    base = [rng.standard_normal(length).astype(np.float32)
+            for _ in range(n)]
+
+    def fn(slave, rank):
+        a = base[rank].copy()
+        slave.broadcast_array(a, Operands.FLOAT, root=root)
+        b = base[rank].copy()
+        slave.gather_array(b, Operands.FLOAT, root=root)
+        c = base[rank].copy()
+        slave.scatter_array(c, Operands.FLOAT, root=root)
+        return a, b, c
+
+    outs = run_slaves(n, fn)
+    from ytk_mp4j_tpu import meta
+
+    ranges = meta.partition_range(0, length, n)
+    for rank, (a, b, c) in enumerate(outs):
+        np.testing.assert_array_equal(a, base[root])
+        if rank == root:
+            for q, (s, e) in enumerate(ranges):
+                np.testing.assert_array_equal(b[s:e], base[q][s:e])
+        s, e = ranges[rank]
+        np.testing.assert_array_equal(c[s:e], base[root][s:e])
+        # untouched positions keep the local values
+        np.testing.assert_array_equal(c[:s], base[rank][:s])
+        np.testing.assert_array_equal(c[e:], base[rank][e:])
