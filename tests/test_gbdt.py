@@ -408,3 +408,23 @@ def test_distributed_uneven_n_matches_single_device(rng):
     _, preds_s = single.train(bins, y)
     np.testing.assert_allclose(preds_d[:N], preds_s[:N], rtol=1e-4,
                                atol=1e-5)
+
+
+def test_wrong_bins_width_rejected(rng):
+    """A bin matrix whose width differs from cfg.n_features must raise,
+    not silently route every sample left (one-hot feature select yields
+    0 for out-of-range split features)."""
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    N, F, B = 256, 5, 8
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=2, n_trees=1)
+    bins = rng.integers(0, B, (N, F)).astype(np.int32)
+    y = rng.standard_normal(N).astype(np.float32)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    trees, _ = tr.train(bins, y)
+    narrow = bins[:, : F - 1]
+    with pytest.raises(Mp4jError):
+        tr.predict(narrow, trees)
+    with pytest.raises(Mp4jError):
+        tr.train(narrow, y)
+    with pytest.raises(Mp4jError):
+        tr.train(bins, y, eval_set=(narrow, y))

@@ -232,3 +232,37 @@ def test_noncontiguous_2d_allreduce(cluster, rng):
     cluster.allreduce_array(arrs, Operands.DOUBLE, Operators.SUM)
     for a in arrs:
         np.testing.assert_allclose(a, want)
+
+
+def test_native_reduce_fallback_matches(cluster, rng):
+    """With native pmax/pmin emission forced off (the axon-style
+    compiler-rejection scenario), MAX/MIN allreduce must transparently
+    take the gathered tree path and produce identical results."""
+    from ytk_mp4j_tpu.ops import collectives as coll
+    arrs = make_inputs(cluster.n, 33, Operands.FLOAT, rng)
+    native = [a.copy() for a in arrs]
+    cluster.allreduce_array(native, Operands.FLOAT, Operators.MAX)
+    coll.set_native_reduce(False)
+    try:
+        fb_cluster = TpuCommCluster(cluster.n)   # fresh jit cache
+        fallback = [a.copy() for a in arrs]
+        fb_cluster.allreduce_array(fallback, Operands.FLOAT, Operators.MAX)
+        mins = [a.copy() for a in arrs]
+        fb_cluster.allreduce_array(mins, Operands.FLOAT, Operators.MIN)
+    finally:
+        coll.set_native_reduce(None)
+    want = expected_reduce(arrs, "MAX")
+    for a, b in zip(native, fallback):
+        np.testing.assert_array_equal(a, want)
+        np.testing.assert_array_equal(b, want)
+    want_min = expected_reduce(arrs, "MIN")
+    for a in mins:
+        np.testing.assert_array_equal(a, want_min)
+
+
+def test_native_reduce_probe_caches():
+    from ytk_mp4j_tpu.ops import collectives as coll
+    coll.set_native_reduce(None)
+    r1 = coll._native_reduce_ok("pmax")
+    assert ("cpu", "pmax") in coll._PROBE_CACHE
+    assert coll._native_reduce_ok("pmax") == r1   # cached, no re-probe

@@ -1,0 +1,243 @@
+"""Device-path correctness smoke — runs on the DEFAULT jax backend.
+
+The device-path analogue of ``checkprocess``/``checkthread`` (the
+reference's check-program strategy, SURVEY.md section 4): exercises
+every collective x operator on BOTH device backends —
+
+- ``TpuCommCluster`` (driver mode, host buffers in/out), and
+- ``ops.collectives`` / ``ops.sparse`` inside a jitted ``shard_map``
+  (the perf path),
+
+against numpy oracles, on whatever devices the default backend exposes.
+Run plainly on the axon tunnel this is the ONE-REAL-TPU-CHIP truth: it
+proves the emitted all_reduce / all_gather / reduce_scatter /
+collective_permute HLO compiles and executes on actual TPU hardware
+(VERDICT round 1 item 1 — the axon compiler rejected non-SUM all-reduce
+in round 1; ``ops.collectives`` now probes per platform and falls back
+to the gathered tree reduction when that recurs).
+
+    python -m ytk_mp4j_tpu.check.checktpu [--out artifact.json]
+
+Exit code 0 iff every check passes; the artifact records platform,
+device count, probe results, and per-family pass/fail counts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.check._oracle import expected_reduce, rank_data
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.ops import collectives as coll
+from ytk_mp4j_tpu.ops import ring
+from ytk_mp4j_tpu.ops import sparse as sparse_ops
+from ytk_mp4j_tpu.parallel import make_mesh
+
+SEED_BASE = 4200
+OPS = ("SUM", "MAX", "MIN", "PROD")
+
+
+class Tally:
+    def __init__(self):
+        self.passed = 0
+        self.failures: list[str] = []
+
+    def expect(self, name: str, got, want, exact: bool):
+        ok = (np.array_equal(got, want) if exact
+              else np.allclose(got, want, rtol=1e-4, atol=1e-5))
+        if ok:
+            self.passed += 1
+        else:
+            self.failures.append(name)
+            print(f"FAIL {name}", file=sys.stderr)
+
+
+def _operands():
+    """Device-eligible operands for this backend (64-bit needs x64)."""
+    ops = [Operands.FLOAT, Operands.INT]
+    if jax.config.jax_enable_x64:
+        ops += [Operands.DOUBLE, Operands.LONG]
+    return ops
+
+
+def check_cluster(t: Tally, n: int, length: int = 192):
+    """Driver mode: all 7 dense collectives x operators + map family."""
+    cluster = TpuCommCluster(n)
+    for operand in _operands():
+        exact = operand.dtype.kind != "f"
+        alls = [rank_data(r, length, operand, SEED_BASE) for r in range(n)]
+        for op_name in OPS:
+            op = Operators.by_name(op_name)
+            arrs = [a.copy() for a in alls]
+            cluster.allreduce_array(arrs, operand, op)
+            want = expected_reduce(alls, op_name)
+            for r in range(n):
+                t.expect(f"cluster/allreduce/{operand.name}/{op_name}",
+                         arrs[r], want, exact)
+            arrs = [a.copy() for a in alls]
+            cluster.reduce_array(arrs, operand, op, root=n - 1)
+            t.expect(f"cluster/reduce/{operand.name}/{op_name}",
+                     arrs[n - 1], want, exact)
+            arrs = [a.copy() for a in alls]
+            cluster.reduce_scatter_array(arrs, operand, op)
+            for r, (s, e) in enumerate(meta.partition_range(0, length, n)):
+                t.expect(f"cluster/reduce_scatter/{operand.name}/{op_name}",
+                         arrs[r][s:e], want[s:e], exact)
+        root = 1 % n
+        arrs = [a.copy() for a in alls]
+        cluster.broadcast_array(arrs, operand, root=root)
+        for r in range(n):
+            t.expect(f"cluster/broadcast/{operand.name}", arrs[r],
+                     alls[root], True)
+        ranges = meta.partition_range(0, length, n)
+        want_cat = np.concatenate(
+            [alls[q][s:e] for q, (s, e) in enumerate(ranges)])
+        arrs = [a.copy() for a in alls]
+        cluster.allgather_array(arrs, operand)
+        for r in range(n):
+            t.expect(f"cluster/allgather/{operand.name}", arrs[r],
+                     want_cat, True)
+        arrs = [a.copy() for a in alls]
+        cluster.gather_array(arrs, operand, root=0)
+        t.expect(f"cluster/gather/{operand.name}", arrs[0], want_cat, True)
+        arrs = [a.copy() for a in alls]
+        cluster.scatter_array(arrs, operand, root=0)
+        for r, (s, e) in enumerate(ranges):
+            t.expect(f"cluster/scatter/{operand.name}", arrs[r][s:e],
+                     alls[0][s:e], True)
+    # sparse map family (values ride the device)
+    for op_name in OPS:
+        op = Operators.by_name(op_name)
+        maps = [{f"k{j}": float(r + j + 1) for j in range(r + 1)}
+                for r in range(n)]
+        want: dict = {}
+        for m in maps:
+            for k, v in m.items():
+                want[k] = op.np_fn(want[k], v) if k in want else v
+        cluster.allreduce_map(maps, Operands.FLOAT, op)
+        for m in maps:
+            t.expect(f"cluster/allreduce_map/{op_name}",
+                     np.array([m.get(k, np.nan) for k in sorted(want)]),
+                     np.array([want[k] for k in sorted(want)]), False)
+    cluster.barrier()
+
+
+def check_functional(t: Tally, n: int, length: int = 64):
+    """The perf path: collectives inside one jitted shard_map program."""
+    length = ((length + n - 1) // n) * n  # reduce_scatter/ring need n | L
+    mesh = make_mesh(n)
+    axis = mesh.axis_names[0]
+    alls = [np.random.default_rng(SEED_BASE + r)
+            .standard_normal(length).astype(np.float32) for r in range(n)]
+    stacked = np.stack(alls)  # [n, L]
+    custom = Operator.custom("ABSMAX",
+                             lambda a, b: jnp.maximum(jnp.abs(a), jnp.abs(b)),
+                             0.0)
+
+    cases = {
+        "allreduce_sum": (lambda x: coll.allreduce(x, Operators.SUM, axis),
+                          lambda: expected_reduce(alls, "SUM")[None]
+                          .repeat(n, 0)),
+        "allreduce_max": (lambda x: coll.allreduce(x, Operators.MAX, axis),
+                          lambda: expected_reduce(alls, "MAX")[None]
+                          .repeat(n, 0)),
+        "allreduce_min": (lambda x: coll.allreduce(x, Operators.MIN, axis),
+                          lambda: expected_reduce(alls, "MIN")[None]
+                          .repeat(n, 0)),
+        "allreduce_prod": (lambda x: coll.allreduce(x, Operators.PROD, axis),
+                           lambda: expected_reduce(alls, "PROD")[None]
+                           .repeat(n, 0)),
+        # singleton reduction applies the binary op n-1 = 0 times, so a
+        # non-idempotent custom op returns the input unchanged at n=1
+        # (same as the socket path's merge loop)
+        "allreduce_custom": (lambda x: coll.allreduce(x, custom, axis),
+                             lambda: (stacked if n == 1 else
+                                      np.abs(stacked).max(0)[None]
+                                      .repeat(n, 0))),
+        "broadcast": (lambda x: coll.broadcast(x, 0, axis),
+                      lambda: stacked[0][None].repeat(n, 0)),
+        "reduce_scatter": (
+            lambda x: coll.reduce_scatter(x[0], Operators.SUM, axis)[None],
+            lambda: expected_reduce(alls, "SUM").reshape(n, -1)),
+        "ring_allreduce": (
+            lambda x: ring.ring_allreduce(x[0], Operators.SUM, axis)[None],
+            lambda: expected_reduce(alls, "SUM")[None].repeat(n, 0)),
+    }
+    for name, (body, want) in cases.items():
+        f = jax.jit(partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=P(axis), out_specs=P(axis))(body))
+        got = np.asarray(f(stacked)).reshape(n, -1)
+        t.expect(f"functional/{name}", got, want().reshape(n, -1), False)
+    # allgather replicates: output spec P(None)
+    f = jax.jit(partial(
+        jax.shard_map, mesh=mesh, check_vma=False,
+        in_specs=P(axis), out_specs=P(None, None))(
+        lambda x: coll.allgather(x, axis, tiled=True)))
+    t.expect("functional/allgather", np.asarray(f(stacked)), stacked, False)
+    # sparse allreduce on device
+    idx = np.stack([np.array([r, n + r], np.int32) for r in range(n)])
+    val = np.stack([np.array([1.0, 2.0], np.float32) for r in range(n)])
+    f = jax.jit(partial(
+        jax.shard_map, mesh=mesh, check_vma=False,
+        in_specs=(P(axis), P(axis)), out_specs=(P(None), P(None)))(
+        lambda i, v: sparse_ops.sparse_allreduce(
+            i[0], v[0], 2 * n, Operators.SUM, axis)))
+    oi, ov = f(idx, val)
+    got = {int(i): float(v) for i, v in zip(np.asarray(oi), np.asarray(ov))
+           if i != sparse_ops.SENTINEL}
+    want = {r: 1.0 for r in range(n)}
+    want.update({n + r: 2.0 for r in range(n)})
+    t.expect("functional/sparse_allreduce",
+             np.array(sorted(got.items())), np.array(sorted(want.items())),
+             False)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="write JSON artifact here")
+    ap.add_argument("--n", type=int, default=None,
+                    help="ranks (default: all devices)")
+    args = ap.parse_args(argv)
+    devs = jax.devices()
+    n = args.n or len(devs)
+    t = Tally()
+    result = {
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+        "n_devices_used": n,
+        "native_reduce_probe": coll.prime_native_reduce_probe(),
+    }
+    try:
+        check_cluster(t, n)
+        check_functional(t, n)
+        result["error"] = None
+    except Exception:
+        traceback.print_exc()
+        result["error"] = traceback.format_exc(limit=3)
+    result["passed"] = t.passed
+    result["failures"] = t.failures
+    result["ok"] = result["error"] is None and not t.failures
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -285,8 +285,17 @@ class ProcessCommSlave(CommSlave):
                            operand: Operand) -> None:
         """Receive a segment directly into ``arr[s:e]`` — in place on
         the raw path (no temp buffer/copy); framed and list containers
-        assign through the container."""
-        if self._raw_ok(operand) and isinstance(arr, np.ndarray):
+        assign through the container.
+
+        The raw/framed decision must mirror :meth:`_send_segment`
+        exactly — both are pure functions of ``_raw_ok(operand)`` — or
+        sender and receiver would disagree on the wire format.
+        """
+        if self._raw_ok(operand):
+            # check_array coerces numeric operands to ndarray; the raw
+            # path is therefore always receivable in place.
+            assert isinstance(arr, np.ndarray), \
+                "numeric operand implies ndarray container (check_array)"
             self._exchange_raw_into(peer, peer, None, arr[s:e], operand)
         else:
             arr[s:e] = self._recv(peer)

@@ -128,9 +128,15 @@ class TpuCommCluster:
         stacked = np.stack(blocks, axis=0)
         return jax.device_put(stacked, self._row_sharding)
 
-    def _jit(self, key, build):
+    def _jit(self, key, build, operator: Operator | None = None):
         fn = self._jits.get(key)
         if fn is None:
+            if operator is not None and operator.lax_collective in (
+                    "pmax", "pmin"):
+                # probe the backend's non-SUM all-reduce support NOW,
+                # outside tracing, so coll.allreduce's trace-time lookup
+                # hits the cache (probing mid-trace is impossible)
+                coll.prime_native_reduce_probe()
             fn = build()
             self._jits[key] = fn
         return fn
@@ -156,7 +162,8 @@ class TpuCommCluster:
                 return coll.allreduce(x, operator, self.axis_name)
             return jax.jit(f)
 
-        fn = self._jit(("allreduce", L, operand.dtype, operator), build)
+        fn = self._jit(("allreduce", L, operand.dtype, operator), build,
+                       operator)
         res = np.asarray(fn(self._stack(flat)))
         for r, a in enumerate(arrs):
             if a.ndim == 1:
@@ -184,7 +191,8 @@ class TpuCommCluster:
                 return coll.reduce(x, operator, root, self.axis_name)
             return jax.jit(f)
 
-        fn = self._jit(("reduce", L, operand.dtype, operator), build)
+        fn = self._jit(("reduce", L, operand.dtype, operator), build,
+                       operator)
         res = np.asarray(fn(self._stack(flat)))
         a = arrs[root]
         if a.ndim == 1:
@@ -339,7 +347,7 @@ class TpuCommCluster:
             return jax.jit(f)
 
         fn = self._jit(("reduce_scatter", pad, operand.dtype, operator),
-                       build)
+                       build, operator)
         res = np.asarray(fn(self._stack(blocks)))  # [n, B]
         # Padded-block layout: device block r covers [lo + r*B, lo + (r+1)*B).
         # Write each rank's owned (uneven) range from the covering blocks.

@@ -86,8 +86,21 @@ class QuantileBinner:
         if X.ndim != 2 or X.shape[1] != self.edges.shape[0]:
             raise Mp4jError(
                 f"X must be [N, {self.edges.shape[0]}], got {X.shape}")
-        return np.asarray(_transform_device(jnp.asarray(X),
-                                            jnp.asarray(self.edges)))
+        # The compare-count broadcasts to an [rows, F, B-1] intermediate
+        # before the reduction; if the backend fails to fuse it (seen on
+        # CPU), a Higgs-scale transform would transiently need ~7 GB.
+        # Chunk rows so the worst-case intermediate stays ~256 MB.
+        fb = self.edges.shape[0] * max(1, self.edges.shape[1])
+        chunk = max(1, (64 << 20) // fb)
+        edges_d = jnp.asarray(self.edges)
+        if X.shape[0] <= chunk:
+            return np.asarray(_transform_device(jnp.asarray(X), edges_d))
+        out = np.empty(X.shape, np.int32)
+        for s in range(0, X.shape[0], chunk):
+            e = min(s + chunk, X.shape[0])
+            out[s:e] = np.asarray(
+                _transform_device(jnp.asarray(X[s:e]), edges_d))
+        return out
 
     def fit_transform(self, X, **kw) -> np.ndarray:
         return self.fit(X, **kw).transform(X)

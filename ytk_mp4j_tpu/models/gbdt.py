@@ -584,6 +584,7 @@ class GBDTTrainer(DataParallelTrainer):
         ``sample_weight`` ([N] f32, optional — ytk-learn's instance
         weights) scales each sample's gradient/hessian contribution and
         composes with the padding zeros."""
+        self._check_bins_width(bins)
         N = bins.shape[0]
         (bins, y), per, w = self._pad_rows([bins, y])
         if sample_weight is not None:
@@ -636,7 +637,9 @@ class GBDTTrainer(DataParallelTrainer):
             raise Mp4jError("early_stopping_rounds requires an eval_set")
         va = None
         if eval_set is not None:
-            va_bins = jnp.asarray(np.asarray(eval_set[0], np.int32))
+            va_host = np.asarray(eval_set[0], np.int32)
+            self._check_bins_width(va_host, "eval_set bins")
+            va_bins = jnp.asarray(va_host)
             va_y = np.asarray(eval_set[1])
             va_margins = None
             va = (va_bins, va_y)
@@ -663,6 +666,16 @@ class GBDTTrainer(DataParallelTrainer):
         if self.cfg.loss == "softmax":
             return trees, preds.reshape(-1, self.cfg.n_classes)
         return trees, preds.reshape(-1)
+
+    def _check_bins_width(self, bins, what: str = "bins") -> None:
+        """A bin matrix narrower/wider than cfg.n_features would make
+        one-hot feature routing silently select value 0 for
+        out-of-range split features (routing every sample left), so
+        wrong widths must be an error, not plausible-looking margins."""
+        if bins.ndim != 2 or bins.shape[1] != self.cfg.n_features:
+            raise Mp4jError(
+                f"{what} must be [N, n_features={self.cfg.n_features}], "
+                f"got {bins.shape}")
 
     def _update_margins(self, bins, tree, margins):
         """Incrementally add one round's tree output to held-out
@@ -732,6 +745,7 @@ class GBDTTrainer(DataParallelTrainer):
 
             self._predict = run
         bins = np.asarray(bins, np.int32)
+        self._check_bins_width(bins)
         out = np.asarray(self._predict(jnp.asarray(bins), list(trees)))
         if not proba:
             return out

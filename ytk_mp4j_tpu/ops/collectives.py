@@ -28,11 +28,119 @@ control flow, static axis sizes.
 
 from __future__ import annotations
 
+import functools
+import os
+
+import jax
 from jax import lax
 import jax.numpy as jnp
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operators import Operator, Operators
+
+
+# ----------------------------------------------------------------------
+# native-reduce capability probe
+#
+# Not every backend compiler accepts every all-reduce computation: the
+# axon remote compiler rejected non-SUM all-reduce HLO ("Supported
+# lowering only of Sum all reduce") in round 1, then accepted it in
+# round 2 — so support is probed at runtime, once per (platform, op),
+# by AOT-compiling a tiny shard_map program on the default backend.
+# On an unsupported backend MAX/MIN transparently fall back to the
+# gathered tree reduction (same semantics, more bandwidth).
+#
+# Override with MP4J_NATIVE_REDUCE=1 (always native) / =0 (always
+# fallback) or set_native_reduce(); unset/None means auto-probe.
+# ----------------------------------------------------------------------
+_PROBE_CACHE: dict[tuple[str, str], bool] = {}
+_FORCE_NATIVE: bool | None = None
+
+
+def set_native_reduce(enabled: bool | None) -> None:
+    """Force pmax/pmin emission on (True) / off (False); None = probe."""
+    global _FORCE_NATIVE
+    _FORCE_NATIVE = enabled
+
+
+def _tracing() -> bool:
+    """True when called under an ambient jax trace (inside jit/shard_map
+    tracing), where the probe cannot compile its own program — nested
+    shard_map under a manual mesh fails on the mesh context."""
+    try:
+        from jax._src import core as _core
+        return not _core.trace_state_clean()
+    except Exception:
+        pass
+    try:  # pragma: no cover - only if the internal API moves
+        return not jax.core.trace_state_clean()
+    except Exception:  # pragma: no cover
+        return True  # can't tell: behave as if tracing (don't probe)
+
+
+def prime_native_reduce_probe() -> dict:
+    """Run the pmax/pmin capability probe now (outside any trace) and
+    return the {kind: supported} map. Driver layers call this before
+    building shard_map programs so trace-time lookups hit the cache."""
+    return {k: _native_reduce_ok(k, probe_now=True) for k in ("pmax", "pmin")}
+
+
+def _native_reduce_ok(kind: str, probe_now: bool = False) -> bool:
+    if _FORCE_NATIVE is not None:
+        return _FORCE_NATIVE
+    env = os.environ.get("MP4J_NATIVE_REDUCE")
+    if env in ("0", "1"):
+        return env == "1"
+    try:
+        devs = jax.devices()
+    except Exception:  # pragma: no cover - no backend at all
+        return True
+    key = (devs[0].platform, kind)
+    ok = _PROBE_CACHE.get(key)
+    if ok is None:
+        if not probe_now and _tracing():
+            # Can't compile a probe mid-trace; emit the native op
+            # (uncached — a later outside-trace call will probe). On a
+            # rejecting backend the user sees the compiler's own error,
+            # no worse than having no fallback at all.
+            return True
+        ok = _probe(kind, devs)
+        if ok is not None:
+            _PROBE_CACHE[key] = ok
+        else:
+            return True  # transient infra failure: optimistic, uncached
+    return ok
+
+
+# Exception-text fragments that identify a DEFINITIVE compiler rejection
+# of the collective (vs a transient tunnel/infra failure, which must not
+# poison the cache with False). The first is the axon round-1 message.
+_REJECTION_MARKERS = ("all reduce", "all-reduce", "allreduce", "lowering",
+                      "unsupported", "unimplemented", "not supported")
+
+
+def _probe(kind: str, devs) -> bool | None:
+    """True = compiles; False = definitive rejection; None = transient
+    failure (do not cache)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    fn = {"pmax": lax.pmax, "pmin": lax.pmin}[kind]
+    n = min(2, len(devs))
+    mesh = Mesh(np.array(devs[:n]), ("_mp4j_probe",))
+    body = functools.partial(
+        jax.shard_map, mesh=mesh, check_vma=False,
+        in_specs=P("_mp4j_probe"), out_specs=P("_mp4j_probe"),
+    )(lambda v: fn(v, "_mp4j_probe"))
+    try:
+        jax.jit(body).lower(
+            jax.ShapeDtypeStruct((n, 8), jnp.float32)).compile()
+        return True
+    except Exception as e:
+        msg = str(e).lower()
+        if any(m in msg for m in _REJECTION_MARKERS):
+            return False
+        return None
 
 
 def _axes(axis_name) -> tuple:
@@ -80,12 +188,17 @@ def _tree_reduce_gathered(x, operator: Operator, axis_name):
 
 
 def allreduce(x, operator: Operator = Operators.SUM, axis_name="mp4j"):
-    """Element-wise reduce across the axis; every member gets the result."""
+    """Element-wise reduce across the axis; every member gets the result.
+
+    MAX/MIN emit ``lax.pmax/pmin`` only when the backend compiler
+    accepts non-SUM all-reduce HLO (probed once per platform — see
+    :func:`set_native_reduce`); otherwise they use the gathered tree
+    reduction, like PROD and user-defined operators."""
     if operator.lax_collective == "psum":
         return lax.psum(x, axis_name)
-    if operator.lax_collective == "pmax":
+    if operator.lax_collective == "pmax" and _native_reduce_ok("pmax"):
         return lax.pmax(x, axis_name)
-    if operator.lax_collective == "pmin":
+    if operator.lax_collective == "pmin" and _native_reduce_ok("pmin"):
         return lax.pmin(x, axis_name)
     return _tree_reduce_gathered(x, operator, axis_name)
 

@@ -159,7 +159,16 @@ def sendrecv_raw(send_fd: int, recv_fd: int, sarr: np.ndarray | None,
     lib = _load()
     if lib is None:
         return False
-    timeout_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+    # Round sub-millisecond (but positive) timeouts up to 1 ms so they
+    # keep their "tiny grace period" meaning instead of degenerating to
+    # an instant -3 failure; the framed path's socket timeout behaves
+    # the same way for an instantly-ready peer.
+    if timeout is None:
+        timeout_ms = -1
+    elif timeout <= 0:
+        timeout_ms = 0
+    else:
+        timeout_ms = max(1, int(timeout * 1000))
     rc = lib.mp4j_sendrecv_raw(send_fd, recv_fd, _data_ptr(sarr),
                                _nbytes(sarr), _data_ptr(rarr),
                                _nbytes(rarr), timeout_ms)
