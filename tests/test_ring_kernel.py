@@ -1,5 +1,8 @@
-"""Pallas RDMA ring allreduce (ops/ring_kernel.py): interpret-mode
-differential tests on multi-device CPU meshes."""
+"""Pallas RDMA ring kernels (ops/ring_kernel.py): interpret-mode
+differential tests on multi-device CPU meshes, plus a host-side
+property model of the credit-backpressure protocol (the compiled-path
+logic the interpreter cannot execute — remote semaphores don't exist
+there; see the module docstring)."""
 
 from functools import partial
 
@@ -11,11 +14,16 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
-from ytk_mp4j_tpu.ops.ring_kernel import ring_allreduce_kernel
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.ops.ring_kernel import (ring_allgather_kernel,
+                                          ring_allreduce_kernel,
+                                          ring_reduce_scatter_kernel)
 from ytk_mp4j_tpu.parallel import make_mesh
 
+OPS = {"SUM": np.sum, "MAX": np.max, "MIN": np.min, "PROD": np.prod}
 
-def _run(n, data):
+
+def _allreduce(n, data, op=Operators.SUM):
     mesh = make_mesh(n)
 
     # the pallas interpret path is not vma-aware (see
@@ -23,27 +31,298 @@ def _run(n, data):
     @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
              out_specs=P("mp4j"), check_vma=False)
     def f(x):
-        return ring_allreduce_kernel(x[0], "mp4j", interpret=True)[None]
+        return ring_allreduce_kernel(x[0], op, "mp4j",
+                                     interpret=True)[None]
 
     return np.asarray(jax.jit(f)(jnp.asarray(data)))
 
 
 @pytest.mark.parametrize("n", [2, 4, 8])
-def test_matches_sum(rng, n):
+@pytest.mark.parametrize("op_name", sorted(OPS))
+def test_allreduce_matches(rng, n, op_name):
     L = 4 * n
     data = rng.standard_normal((n, L)).astype(np.float32)
-    out = _run(n, data)
+    out = _allreduce(n, data, Operators.by_name(op_name))
+    want = OPS[op_name](data, axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("L", [1, 7, 13])
+def test_allreduce_any_length(rng, L):
+    """Arbitrary L: identity padding inside the kernel wrapper."""
+    n = 4
+    data = rng.standard_normal((n, L)).astype(np.float32)
+    out = _allreduce(n, data)
     want = data.sum(0)
     for r in range(n):
         np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("n", [2, 4])
+def test_reduce_scatter_chunk_layout(rng, n):
+    """Member r ends with chunk r — the coll.reduce_scatter contract."""
+    L = 6 * n
+    data = rng.standard_normal((n, L)).astype(np.float32)
+    mesh = make_mesh(n)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P("mp4j"), check_vma=False)
+    def f(x):
+        return ring_reduce_scatter_kernel(x[0], Operators.SUM, "mp4j",
+                                          interpret=True)[None]
+
+    out = np.asarray(jax.jit(f)(jnp.asarray(data)))   # [n, L/n]
+    np.testing.assert_allclose(out, data.sum(0).reshape(n, -1),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_allgather_block_layout(rng, n):
+    c = 5
+    data = rng.standard_normal((n, c)).astype(np.float32)
+    mesh = make_mesh(n)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P(None, None), check_vma=False)
+    def f(x):
+        return ring_allgather_kernel(x[0], "mp4j",
+                                     interpret=True).reshape(n, c)
+
+    out = np.asarray(jax.jit(f)(jnp.asarray(data)))
+    np.testing.assert_allclose(out, data)
+
+
 def test_single_member_noop(rng):
     data = rng.standard_normal((1, 8)).astype(np.float32)
-    out = _run(1, data)
+    out = _allreduce(1, data)
     np.testing.assert_array_equal(out, data)
 
 
-def test_rejects_indivisible(rng):
+def test_reduce_scatter_rejects_indivisible(rng):
+    mesh = make_mesh(4)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P("mp4j"), check_vma=False)
+    def f(x):
+        return ring_reduce_scatter_kernel(x[0], Operators.SUM, "mp4j",
+                                          interpret=True)[None]
+
     with pytest.raises(Mp4jError):
-        _run(4, np.ones((4, 7), np.float32))
+        jax.jit(f)(np.ones((4, 7), np.float32))
+
+
+# ----------------------------------------------------------------------
+# Host-side model of the compiled-path credit protocol.
+#
+# The slot-reuse race the credits guard is exactly what interpret mode
+# cannot surface (members run serially there), so the protocol is
+# verified against this discrete-event model instead: every member runs
+# the same exchange() sequence as the kernel, a scheduler interleaves
+# members and DMA deliveries ADVERSARIALLY (including stalling one
+# victim member as long as possible), and the model checks
+#   (a) no DMA delivery ever overwrites an unconsumed receive slot,
+#   (b) every semaphore drains to zero at exit,
+#   (c) the allreduce result is correct on every member.
+# Without credits the same adversarial scheduler DOES produce the
+# overwrite (the final test) — proof the guard is load-bearing, not
+# decorative.
+# ----------------------------------------------------------------------
+class _RingModel:
+    def __init__(self, n, use_credits, seed=0, victim=None,
+                 mode="allreduce"):
+        self.n = n
+        self.use_credits = use_credits
+        self.mode = mode
+        self.rng = np.random.default_rng(seed)
+        self.victim = victim          # member to stall when possible
+        self.credit = [[0, 0] for _ in range(n)]
+        self.send_sem = [[0, 0] for _ in range(n)]
+        self.recv_sem = [[0, 0] for _ in range(n)]
+        # rbuf[r][slot] = (value, unconsumed)
+        self.rbuf = [[(None, False), (None, False)] for _ in range(n)]
+        self.pending = []             # in-flight DMAs: (src, slot, value)
+        self.violations = 0
+        self.out = [None] * n
+
+    # --- the member program: mirrors _ring_kernel's three modes ---
+    def _member(self, me, chunks):
+        n = self.n
+
+        def exchange(g, value):
+            slot = g % 2
+            if self.use_credits and g >= 2:
+                yield ("wait_credit", slot)
+            yield ("send", slot, value)
+            yield ("wait_send", slot)
+            yield ("wait_recv", slot)
+            got = yield ("consume", slot)
+            if self.use_credits:
+                yield ("signal_credit", slot)
+            return got
+
+        shift = -1 if self.mode == "reduce_scatter" else 0
+
+        def sel(j):
+            return chunks[(j + shift) % n]
+
+        steps = 0
+        if self.mode in ("allreduce", "reduce_scatter"):
+            out = [None] * n
+            acc = sel(me)
+            for s in range(n - 1):
+                acc = (yield from exchange(steps, acc)) + sel(me - s - 1)
+                steps += 1
+            if self.mode == "reduce_scatter":
+                result = acc                     # chunk me, reduced
+            else:
+                out[(me + 1) % n] = acc
+                cur = acc
+                for s in range(n - 1):
+                    cur = yield from exchange(steps, cur)
+                    out[(me - s) % n] = cur
+                    steps += 1
+                result = out
+        else:                                    # allgather
+            out = [None] * n
+            out[me] = chunks[0]
+            cur = chunks[0]
+            for s in range(n - 1):
+                cur = yield from exchange(steps, cur)
+                out[(me - s - 1) % n] = cur
+                steps += 1
+            result = out
+        if self.use_credits:
+            for slot in range(min(2, steps)):
+                yield ("wait_credit", slot)
+        self.out[me] = result
+
+    def _runnable(self, r, action):
+        kind = action[0]
+        slot = action[1]
+        if kind == "wait_credit":
+            return self.credit[r][slot] >= 1
+        if kind == "wait_send":
+            return self.send_sem[r][slot] >= 1
+        if kind == "wait_recv":
+            return self.recv_sem[r][slot] >= 1
+        return True                   # send / consume / signal_credit
+
+    def _apply(self, r, gen, action):
+        """Execute one runnable action; returns the value to send into
+        the generator (consume) or None."""
+        kind, slot = action[0], action[1]
+        if kind == "wait_credit":
+            self.credit[r][slot] -= 1
+        elif kind == "wait_send":
+            self.send_sem[r][slot] -= 1
+        elif kind == "wait_recv":
+            self.recv_sem[r][slot] -= 1
+        elif kind == "send":
+            # sbuf integrity: the previous outbound on this slot must
+            # have drained (send_sem wait at its step) — model-checked
+            assert not any(s == r and sl == slot
+                           for s, sl, _ in self.pending), \
+                "sbuf overwritten with DMA in flight"
+            self.pending.append((r, slot, action[2]))
+        elif kind == "consume":
+            value, unconsumed = self.rbuf[r][slot]
+            if not unconsumed:
+                # stale re-read: the slot's fresh value was consumed
+                # already — the paired overwrite was counted when the
+                # extra delivery landed; the broken run reads garbage
+                self.violations += 1
+            self.rbuf[r][slot] = (value, False)
+            return value
+        elif kind == "signal_credit":
+            self.credit[(r - 1) % self.n][slot] += 1
+        return None
+
+    def _deliver(self, i):
+        src, slot, value = self.pending.pop(i)
+        dst = (src + 1) % self.n
+        if self.rbuf[dst][slot][1]:   # unconsumed data overwritten!
+            self.violations += 1
+        self.rbuf[dst][slot] = (value, True)
+        self.recv_sem[dst][slot] += 1
+        self.send_sem[src][slot] += 1
+
+    def run(self, data):
+        """data: [n, n] — member r's chunk j at data[r, j]."""
+        n = self.n
+        gens = [self._member(r, list(data[r])) for r in range(n)]
+        actions = [g.send(None) for g in gens]
+        done = [False] * n
+        while not all(done):
+            # candidate moves: deliveries (any in-flight DMA) and
+            # runnable member actions
+            moves = [("dma", i) for i in range(len(self.pending))]
+            moves += [("mem", r) for r in range(n)
+                      if not done[r] and self._runnable(r, actions[r])]
+            assert moves, "deadlock: no runnable member, no DMA in flight"
+            # adversarial preference: stall the victim while anything
+            # else can move
+            if self.victim is not None:
+                non_victim = [m for m in moves
+                              if m != ("mem", self.victim)]
+                if non_victim:
+                    moves = non_victim
+            kind, i = moves[self.rng.integers(len(moves))]
+            if kind == "dma":
+                self._deliver(i)
+                continue
+            r = i
+            ret = self._apply(r, gens[r], actions[r])
+            try:
+                actions[r] = gens[r].send(ret)
+            except StopIteration:
+                done[r] = True
+        return self
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("mode",
+                         ["allreduce", "reduce_scatter", "allgather"])
+def test_credit_protocol_safe_under_any_schedule(n, seed, mode):
+    """With credits: no receive-slot overwrite, semaphores drain to
+    zero, results correct — for random and victim-stalling schedules,
+    in every kernel mode (each has its own step count and drain)."""
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((n, n)).astype(np.float64)
+    for victim in [None, 0, n - 1]:
+        m = _RingModel(n, use_credits=True, seed=seed, victim=victim,
+                       mode=mode)
+        m.run(data)
+        assert m.violations == 0
+        assert not m.pending
+        assert all(c == [0, 0] for c in m.credit), m.credit
+        assert all(s == [0, 0] for s in m.send_sem)
+        assert all(s == [0, 0] for s in m.recv_sem)
+        if mode == "allreduce":
+            want = data.sum(0)
+            for r in range(n):
+                np.testing.assert_allclose(m.out[r], want, rtol=1e-12)
+        elif mode == "reduce_scatter":
+            for r in range(n):       # member r ends with chunk r
+                np.testing.assert_allclose(m.out[r], data[:, r].sum(),
+                                           rtol=1e-12)
+        else:                        # member q's shard at slot q
+            for r in range(n):
+                np.testing.assert_allclose(m.out[r], data[:, 0],
+                                           rtol=1e-12)
+
+
+def test_without_credits_adversary_overwrites_slot():
+    """The race is REAL: stalling one member while its upstream runs
+    free overwrites an unconsumed receive slot once the double buffer
+    wraps — the credits exist to prevent exactly this."""
+    n = 4
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((n, n)).astype(np.float64)
+    hits = 0
+    for victim in range(n):
+        m = _RingModel(n, use_credits=False, seed=1, victim=victim)
+        m.run(data)
+        hits += m.violations
+    assert hits > 0

@@ -266,3 +266,88 @@ def test_native_reduce_probe_caches():
     r1 = coll._native_reduce_ok("pmax")
     assert ("cpu", "pmax") in coll._PROBE_CACHE
     assert coll._native_reduce_ok("pmax") == r1   # cached, no re-probe
+
+
+# ----------------------------------------------------------------------
+# algorithm selection (reference parity: ProcessCommSlave's algo arg):
+# "xla" / "ring" (ppermute) / "rdma" (Pallas kernel, interpreted on CPU
+# meshes) must be result-identical through the driver API
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("algo", ["ring", "rdma"])
+def test_allreduce_algo_equivalence(cluster, algo, rng):
+    operand = Operands.FLOAT
+    for op_name in ("SUM", "MAX"):
+        arrs = make_inputs(cluster.n, 37, operand, rng)   # 37: pads
+        want = [a.copy() for a in arrs]
+        cluster.allreduce_array(want, operand, Operators.by_name(op_name))
+        got = [a.copy() for a in arrs]
+        cluster.allreduce_array(got, operand, Operators.by_name(op_name),
+                                algo=algo)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+@pytest.mark.parametrize("algo", ["ring", "rdma"])
+def test_reduce_scatter_algo_equivalence(cluster, algo, rng):
+    operand = Operands.FLOAT
+    arrs = make_inputs(cluster.n, 41, operand, rng)
+    want = [a.copy() for a in arrs]
+    cluster.reduce_scatter_array(want, operand, Operators.SUM)
+    got = [a.copy() for a in arrs]
+    cluster.reduce_scatter_array(got, operand, Operators.SUM, algo=algo)
+    for a, b in zip(got, want):
+        # ring merges sequentially; XLA's reduction order differs
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("algo", ["ring", "rdma"])
+def test_allgather_algo_equivalence(cluster, algo, rng):
+    operand = Operands.FLOAT
+    arrs = make_inputs(cluster.n, 29, operand, rng)
+    want = [a.copy() for a in arrs]
+    cluster.allgather_array(want, operand)
+    got = [a.copy() for a in arrs]
+    cluster.allgather_array(got, operand, algo=algo)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_algo_validation(cluster, rng):
+    arrs = make_inputs(cluster.n, 8, Operands.FLOAT, rng)
+    with pytest.raises(Mp4jError):
+        cluster.allreduce_array(arrs, Operands.FLOAT, Operators.SUM,
+                                algo="bogus")
+
+
+def test_algo_rejects_hierarchical_mesh(rng):
+    from ytk_mp4j_tpu.parallel import make_hier_mesh
+    cl = TpuCommCluster(mesh=make_hier_mesh(2, 2))
+    arrs = make_inputs(4, 8, Operands.FLOAT, rng)
+    with pytest.raises(Mp4jError):
+        cl.allreduce_array(arrs, Operands.FLOAT, Operators.SUM,
+                           algo="rdma")
+
+
+def test_native_reduce_flip_rebuilds_same_cluster(cluster, rng):
+    """set_native_reduce after a MAX allreduce must take effect on the
+    SAME cluster: the resolved decision is part of the jit cache key,
+    so the flip builds a fallback program instead of replaying the
+    cached native one."""
+    from ytk_mp4j_tpu.ops import collectives as coll
+    arrs = make_inputs(cluster.n, 17, Operands.FLOAT, rng)
+    first = [a.copy() for a in arrs]
+    cluster.allreduce_array(first, Operands.FLOAT, Operators.MAX)
+    coll.set_native_reduce(False)
+    try:
+        flipped = [a.copy() for a in arrs]
+        cluster.allreduce_array(flipped, Operands.FLOAT, Operators.MAX)
+    finally:
+        coll.set_native_reduce(None)
+    want = expected_reduce(arrs, "MAX")
+    for a, b in zip(first, flipped):
+        np.testing.assert_array_equal(a, want)
+        np.testing.assert_array_equal(b, want)
+    natives = {k[5] for k in cluster._jits
+               if k[0] == "allreduce" and k[3] is Operators.MAX
+               and k[4] == "xla"}
+    assert False in natives and len(natives) == 2, natives

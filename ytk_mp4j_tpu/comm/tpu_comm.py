@@ -32,6 +32,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -40,6 +41,8 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.ops import collectives as coll
+from ytk_mp4j_tpu.ops import ring as ring_ops
+from ytk_mp4j_tpu.ops import ring_kernel
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.parallel.mesh import make_mesh, DEFAULT_AXIS
 from ytk_mp4j_tpu.utils import trace
@@ -128,42 +131,104 @@ class TpuCommCluster:
         stacked = np.stack(blocks, axis=0)
         return jax.device_put(stacked, self._row_sharding)
 
-    def _jit(self, key, build, operator: Operator | None = None):
+    # -- algorithm selection (reference parity: ProcessCommSlave's
+    # algo="rhd"/"ring"). "xla": one fused XLA collective (default —
+    # the compiler schedules ICI DMA). "ring": hand-scheduled ppermute
+    # ring (ops.ring). "rdma": the Pallas RDMA ring kernel
+    # (ops.ring_kernel) — the explicit-transport path; interpreted on
+    # non-TPU meshes, compiled (barrier + credit backpressure) on TPU.
+    _ALGOS = ("xla", "ring", "rdma")
+
+    def _check_algo(self, algo: str):
+        if algo not in self._ALGOS:
+            raise Mp4jError(f"algo must be one of {self._ALGOS}, "
+                            f"got {algo!r}")
+        if algo != "xla" and isinstance(self.axis_name, tuple):
+            raise Mp4jError(
+                f"algo={algo!r} rings over a single ICI axis; "
+                "hierarchical meshes use the default 'xla' path")
+
+    def _interpret_kernels(self) -> bool:
+        """Pallas kernels compile only on TPU meshes; interpret them on
+        the virtual CPU meshes the tests and the driver dry-run use."""
+        return self.mesh.devices.flat[0].platform != "tpu"
+
+    def _jit(self, key, build):
         fn = self._jits.get(key)
         if fn is None:
-            if operator is not None and operator.lax_collective in (
-                    "pmax", "pmin"):
-                # probe the backend's non-SUM all-reduce support NOW,
-                # outside tracing, so coll.allreduce's trace-time lookup
-                # hits the cache (probing mid-trace is impossible)
-                coll.prime_native_reduce_probe()
             fn = build()
             self._jits[key] = fn
         return fn
+
+    def _resolve_native(self, operator: Operator) -> bool | None:
+        """The native pmax/pmin decision for THIS mesh's devices,
+        resolved outside tracing (the trace-time probe can only see the
+        default backend, which may differ from the mesh — e.g. a CPU
+        dry-run mesh on a TPU-default machine). The value joins the jit
+        cache key so a later ``set_native_reduce`` / env flip rebuilds
+        instead of replaying a stale executable."""
+        return coll.resolve_native_reduce(operator,
+                                          list(self.mesh.devices.flat))
 
     # ------------------------------------------------------------------
     # dense collectives (reference: *Array methods, SURVEY.md section 2)
     # ------------------------------------------------------------------
     def allreduce_array(self, arrs, operand: Operand = Operands.FLOAT,
                         operator: Operator = Operators.SUM,
-                        from_: int = 0, to: int | None = None):
-        """Element-wise reduce ``arr[from_:to]`` across ranks, in place."""
+                        from_: int = 0, to: int | None = None,
+                        algo: str = "xla"):
+        """Element-wise reduce ``arr[from_:to]`` across ranks, in place.
+
+        ``algo`` selects the schedule (see ``_ALGOS``): the fused XLA
+        collective (default), the ppermute ring, or the Pallas RDMA
+        ring kernel — all wire-identical in results."""
         self._check_operand(operand)
+        self._check_algo(algo)
         arrs, lo, hi = self._norm_arrays(arrs, operand, from_, to)
         if hi == lo:
             return arrs
         flat = [a[lo:hi] if a.ndim == 1 else a.reshape(-1) for a in arrs]
         L = flat[0].size
+        # native only affects the xla build; resolving (and keying) it
+        # on ring/rdma would probe needlessly and recompile identical
+        # programs on a set_native_reduce flip
+        native = self._resolve_native(operator) if algo == "xla" else None
 
         def build():
-            @partial(shard_map, mesh=self.mesh,
-                     in_specs=P(self.axis_name), out_specs=P(self.axis_name))
+            if algo == "xla":
+                @partial(shard_map, mesh=self.mesh,
+                         in_specs=P(self.axis_name),
+                         out_specs=P(self.axis_name))
+                def f(x):  # x: [1, L]
+                    return coll.allreduce(x, operator, self.axis_name,
+                                          native)
+                return jax.jit(f)
+
+            axis = self.axis_name
+            n = self.n
+            interpret = self._interpret_kernels()
+
+            # the pallas interpreter / the ring's data-dependent chunk
+            # walk defeat static replication inference; differential
+            # tests cover algo equivalence
+            @partial(shard_map, mesh=self.mesh, check_vma=False,
+                     in_specs=P(axis), out_specs=P(axis))
             def f(x):  # x: [1, L]
-                return coll.allreduce(x, operator, self.axis_name)
+                v = x[0]
+                if algo == "rdma":
+                    return ring_kernel.ring_allreduce_kernel(
+                        v, operator, axis, interpret=interpret)[None]
+                padL = meta.padded_block(L, n) * n
+                if padL != L:
+                    ident = jnp.asarray(operator.identity(v.dtype),
+                                        dtype=v.dtype)
+                    v = jnp.concatenate(
+                        [v, jnp.full((padL - L,), ident, v.dtype)])
+                return ring_ops.ring_allreduce(v, operator, axis)[:L][None]
             return jax.jit(f)
 
-        fn = self._jit(("allreduce", L, operand.dtype, operator), build,
-                       operator)
+        fn = self._jit(("allreduce", L, operand.dtype, operator, algo,
+                        native), build)
         res = np.asarray(fn(self._stack(flat)))
         for r, a in enumerate(arrs):
             if a.ndim == 1:
@@ -183,16 +248,18 @@ class TpuCommCluster:
             return arrs
         flat = [a[lo:hi] if a.ndim == 1 else a.reshape(-1) for a in arrs]
         L = flat[0].size
+        native = self._resolve_native(operator)
 
         def build():
             @partial(shard_map, mesh=self.mesh,
                      in_specs=P(self.axis_name), out_specs=P(self.axis_name))
             def f(x):
-                return coll.reduce(x, operator, root, self.axis_name)
+                return coll.reduce(x, operator, root, self.axis_name,
+                                   native)
             return jax.jit(f)
 
-        fn = self._jit(("reduce", L, operand.dtype, operator), build,
-                       operator)
+        fn = self._jit(("reduce", L, operand.dtype, operator, native),
+                       build)
         res = np.asarray(fn(self._stack(flat)))
         a = arrs[root]
         if a.ndim == 1:
@@ -251,13 +318,18 @@ class TpuCommCluster:
     def _max_block(ranges) -> int:
         return max(1, max(e - s for s, e in ranges))
 
-    def _run_segment_gather(self, arrs, operand: Operand, ranges):
+    def _run_segment_gather(self, arrs, operand: Operand, ranges,
+                            algo: str = "xla"):
         """Shared core of (all)gather: pad each rank's segment to the max
         block, all_gather on device, return the [n, B] result."""
         if arrs[0].ndim != 1:
             raise Mp4jError("segment collectives require 1-D arrays")
+        self._check_algo(algo)
         ranges = self._norm_ranges(arrs, ranges)
         B = self._max_block(ranges)
+        if algo == "rdma":
+            B = ring_kernel.round_up_chunk(B, operand.dtype,
+                                           self._interpret_kernels())
         blocks = []
         for r, (s, e) in enumerate(ranges):
             b = np.zeros(B, dtype=operand.dtype)
@@ -265,22 +337,40 @@ class TpuCommCluster:
             blocks.append(b)
 
         def build():
+            if algo == "xla":
+                @partial(shard_map, mesh=self.mesh, check_vma=False,
+                         in_specs=P(self.axis_name),
+                         out_specs=P(None, None))
+                def f(x):  # x: [1, B] -> [n, B] replicated
+                    return coll.allgather(x, self.axis_name, tiled=True)
+                return jax.jit(f)
+
+            axis = self.axis_name
+            n = self.n
+            interpret = self._interpret_kernels()
+
             @partial(shard_map, mesh=self.mesh, check_vma=False,
-                     in_specs=P(self.axis_name), out_specs=P(None, None))
+                     in_specs=P(axis), out_specs=P(None, None))
             def f(x):  # x: [1, B] -> [n, B] replicated
-                return coll.allgather(x, self.axis_name, tiled=True)
+                if algo == "rdma":
+                    y = ring_kernel.ring_allgather_kernel(
+                        x[0], axis, interpret=interpret)
+                else:
+                    y = ring_ops.ring_allgather(x[0], axis)
+                return y.reshape(n, B)
             return jax.jit(f)
 
-        fn = self._jit(("allgather", B, operand.dtype), build)
+        fn = self._jit(("allgather", B, operand.dtype, algo), build)
         return np.asarray(fn(self._stack(blocks))), ranges
 
     def allgather_array(self, arrs, operand: Operand = Operands.FLOAT,
-                        ranges=None):
+                        ranges=None, algo: str = "xla"):
         """Each rank owns ``arr[ranges[rank]]``; afterwards every rank's
-        array holds all segments."""
+        array holds all segments. ``algo`` selects the schedule (see
+        ``_ALGOS``)."""
         self._check_operand(operand)
         arrs, _, _ = self._norm_arrays(arrs, operand, 0, None)
-        res, ranges = self._run_segment_gather(arrs, operand, ranges)
+        res, ranges = self._run_segment_gather(arrs, operand, ranges, algo)
         for a in arrs:
             for r, (s, e) in enumerate(ranges):
                 a[s:e] = res[r, : e - s]
@@ -319,17 +409,22 @@ class TpuCommCluster:
         return arrs
 
     def reduce_scatter_array(self, arrs, operand: Operand = Operands.FLOAT,
-                             operator: Operator = Operators.SUM, ranges=None):
+                             operator: Operator = Operators.SUM, ranges=None,
+                             algo: str = "xla"):
         """Every rank contributes its full array; rank r ends with segment
         ``ranges[r]`` of the element-wise reduction (other positions
-        unchanged)."""
+        unchanged). ``algo`` selects the schedule (see ``_ALGOS``)."""
         self._check_operand(operand)
+        self._check_algo(algo)
         arrs, _, _ = self._norm_arrays(arrs, operand, 0, None)
         if arrs[0].ndim != 1:
             raise Mp4jError("segment collectives require 1-D arrays")
         ranges = self._norm_ranges(arrs, ranges)
         lo, hi = ranges[0][0], ranges[-1][1]
         B = meta.padded_block(hi - lo, self.n)
+        if algo == "rdma":
+            B = ring_kernel.round_up_chunk(B, operand.dtype,
+                                           self._interpret_kernels())
         pad = self.n * B
         ident = operator.identity(operand.dtype)
         blocks = []
@@ -337,17 +432,41 @@ class TpuCommCluster:
             b = np.full(pad, ident, dtype=operand.dtype)
             b[: hi - lo] = arrs[r][lo:hi]
             blocks.append(b)
+        native = self._resolve_native(operator) if algo == "xla" else None
 
         def build():
-            @partial(shard_map, mesh=self.mesh,
-                     in_specs=P(self.axis_name), out_specs=P(self.axis_name))
+            if algo == "xla":
+                @partial(shard_map, mesh=self.mesh,
+                         in_specs=P(self.axis_name),
+                         out_specs=P(self.axis_name))
+                def f(x):  # x: [1, n*B]
+                    y = coll.reduce_scatter(x[0], operator, self.axis_name,
+                                            native)
+                    return y[None]  # [1, B]
+                return jax.jit(f)
+
+            axis = self.axis_name
+            n = self.n
+            interpret = self._interpret_kernels()
+
+            @partial(shard_map, mesh=self.mesh, check_vma=False,
+                     in_specs=P(axis), out_specs=P(axis))
             def f(x):  # x: [1, n*B]
-                y = coll.reduce_scatter(x[0], operator, self.axis_name)
+                if algo == "rdma":
+                    y = ring_kernel.ring_reduce_scatter_kernel(
+                        x[0], operator, axis, interpret=interpret)
+                else:
+                    # the ppermute ring leaves member r with chunk
+                    # (r+1)%n; one further hop right restores the
+                    # block-r-to-rank-r layout of the XLA path
+                    y = ring_ops.ring_reduce_scatter(x[0], operator, axis)
+                    y = lax.ppermute(y, axis,
+                                     [(i, (i + 1) % n) for i in range(n)])
                 return y[None]  # [1, B]
             return jax.jit(f)
 
-        fn = self._jit(("reduce_scatter", pad, operand.dtype, operator),
-                       build, operator)
+        fn = self._jit(("reduce_scatter", pad, operand.dtype, operator,
+                        algo, native), build)
         res = np.asarray(fn(self._stack(blocks)))  # [n, B]
         # Padded-block layout: device block r covers [lo + r*B, lo + (r+1)*B).
         # Write each rank's owned (uneven) range from the covering blocks.

@@ -78,23 +78,47 @@ def _tracing() -> bool:
         return True  # can't tell: behave as if tracing (don't probe)
 
 
-def prime_native_reduce_probe() -> dict:
+def prime_native_reduce_probe(devices=None) -> dict:
     """Run the pmax/pmin capability probe now (outside any trace) and
     return the {kind: supported} map. Driver layers call this before
-    building shard_map programs so trace-time lookups hit the cache."""
-    return {k: _native_reduce_ok(k, probe_now=True) for k in ("pmax", "pmin")}
+    building shard_map programs so trace-time lookups hit the cache.
+    ``devices``: probe THESE devices (e.g. the executing mesh's) instead
+    of the default backend — a CPU mesh on a TPU-default machine must
+    not inherit the TPU's verdict."""
+    return {k: _native_reduce_ok(k, probe_now=True, devices=devices)
+            for k in ("pmax", "pmin")}
 
 
-def _native_reduce_ok(kind: str, probe_now: bool = False) -> bool:
+def resolve_native_reduce(operator: Operator, devices=None) -> bool | None:
+    """The effective native-reduce decision for ``operator`` on
+    ``devices`` (default backend if None), resolved OUTSIDE tracing.
+
+    None when the operator has no probed native collective (SUM always
+    lowers natively; PROD/custom always tree-reduce) — the decision is
+    irrelevant there. Driver layers key their jit caches on this value
+    and pass it back via the ``native_reduce`` override so a later
+    ``set_native_reduce`` / env flip rebuilds rather than replaying a
+    stale executable."""
+    kind = operator.lax_collective
+    if kind not in ("pmax", "pmin"):
+        return None
+    return _native_reduce_ok(kind, probe_now=True, devices=devices)
+
+
+def _native_reduce_ok(kind: str, probe_now: bool = False,
+                      devices=None) -> bool:
     if _FORCE_NATIVE is not None:
         return _FORCE_NATIVE
     env = os.environ.get("MP4J_NATIVE_REDUCE")
     if env in ("0", "1"):
         return env == "1"
-    try:
-        devs = jax.devices()
-    except Exception:  # pragma: no cover - no backend at all
-        return True
+    if devices is not None:
+        devs = list(devices)
+    else:
+        try:
+            devs = jax.devices()
+        except Exception:  # pragma: no cover - no backend at all
+            return True
     key = (devs[0].platform, kind)
     ok = _PROBE_CACHE.get(key)
     if ok is None:
@@ -112,11 +136,17 @@ def _native_reduce_ok(kind: str, probe_now: bool = False) -> bool:
     return ok
 
 
-# Exception-text fragments that identify a DEFINITIVE compiler rejection
-# of the collective (vs a transient tunnel/infra failure, which must not
-# poison the cache with False). The first is the axon round-1 message.
+# Exception-text classification. Transient infra failures (tunnel/RPC
+# hiccups) must NOT poison the cache with False, and they can contain
+# compiler-ish words ("RPC failed while lowering request"), so they are
+# checked FIRST; only then do the rejection fragments decide. The first
+# rejection marker is the axon round-1 message.
+_TRANSIENT_MARKERS = ("unavailable", "deadline", "cancelled", "canceled",
+                      "connection", "socket", "rpc", "tunnel", "timeout",
+                      "transient")
 _REJECTION_MARKERS = ("all reduce", "all-reduce", "allreduce", "lowering",
-                      "unsupported", "unimplemented", "not supported")
+                      "unsupported", "unimplemented", "not supported",
+                      "not implemented", "invalid_argument")
 
 
 def _probe(kind: str, devs) -> bool | None:
@@ -138,6 +168,8 @@ def _probe(kind: str, devs) -> bool | None:
         return True
     except Exception as e:
         msg = str(e).lower()
+        if any(m in msg for m in _TRANSIENT_MARKERS):
+            return None
         if any(m in msg for m in _REJECTION_MARKERS):
             return False
         return None
@@ -187,31 +219,40 @@ def _tree_reduce_gathered(x, operator: Operator, axis_name):
     return parts[0]
 
 
-def allreduce(x, operator: Operator = Operators.SUM, axis_name="mp4j"):
+def allreduce(x, operator: Operator = Operators.SUM, axis_name="mp4j",
+              native_reduce: bool | None = None):
     """Element-wise reduce across the axis; every member gets the result.
 
     MAX/MIN emit ``lax.pmax/pmin`` only when the backend compiler
     accepts non-SUM all-reduce HLO (probed once per platform — see
     :func:`set_native_reduce`); otherwise they use the gathered tree
-    reduction, like PROD and user-defined operators."""
+    reduction, like PROD and user-defined operators. ``native_reduce``
+    overrides the probe — driver layers resolve it against the
+    EXECUTING mesh's devices (:func:`resolve_native_reduce`) since the
+    trace-time probe can only see the default backend."""
     if operator.lax_collective == "psum":
         return lax.psum(x, axis_name)
-    if operator.lax_collective == "pmax" and _native_reduce_ok("pmax"):
+
+    def ok(kind):
+        return (native_reduce if native_reduce is not None
+                else _native_reduce_ok(kind))
+
+    if operator.lax_collective == "pmax" and ok("pmax"):
         return lax.pmax(x, axis_name)
-    if operator.lax_collective == "pmin" and _native_reduce_ok("pmin"):
+    if operator.lax_collective == "pmin" and ok("pmin"):
         return lax.pmin(x, axis_name)
     return _tree_reduce_gathered(x, operator, axis_name)
 
 
 def reduce(x, operator: Operator = Operators.SUM, root: int = 0,
-           axis_name="mp4j"):
+           axis_name="mp4j", native_reduce: bool | None = None):
     """Reduce across the axis; only ``root``'s output is meaningful.
 
     XLA has no rooted-reduce primitive over ICI; the allreduce is the
     bandwidth-optimal lowering and non-root results are simply unused (the
     compiler may DCE per-device work it can prove dead).
     """
-    return allreduce(x, operator, axis_name)
+    return allreduce(x, operator, axis_name, native_reduce)
 
 
 def broadcast(x, root: int = 0, axis_name="mp4j"):
@@ -248,7 +289,8 @@ def scatter(x, root: int = 0, axis_name="mp4j"):
     return lax.dynamic_slice_in_dim(full, idx * block, block, axis=0)
 
 
-def reduce_scatter(x, operator: Operator = Operators.SUM, axis_name="mp4j"):
+def reduce_scatter(x, operator: Operator = Operators.SUM, axis_name="mp4j",
+                   native_reduce: bool | None = None):
     """Element-wise reduce then split: member i receives block i of the
     reduction. ``x.shape[0]`` must be divisible by the axis size."""
     n = _axis_size(axis_name)
@@ -257,7 +299,7 @@ def reduce_scatter(x, operator: Operator = Operators.SUM, axis_name="mp4j"):
             f"reduce_scatter dim0 {x.shape[0]} not divisible by axis size {n}")
     if operator.lax_collective == "psum" and not isinstance(axis_name, tuple):
         return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
-    full = allreduce(x, operator, axis_name)
+    full = allreduce(x, operator, axis_name, native_reduce)
     block = x.shape[0] // n
     idx = flat_index(axis_name)
     return lax.dynamic_slice_in_dim(full, idx * block, block, axis=0)

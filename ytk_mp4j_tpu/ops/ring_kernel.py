@@ -1,4 +1,4 @@
-"""Pallas ring allreduce — explicit inter-chip RDMA, one level below XLA.
+"""Pallas ring collectives — explicit inter-chip RDMA, one level below XLA.
 
 Where ``ops/ring.py`` hand-schedules the ring as ``lax.ppermute`` steps
 (XLA still owns the transfers), this module writes the transport itself:
@@ -8,32 +8,52 @@ the closest TPU analogue of the reference's hand-written socket rounds
 (SURVEY.md section 3b), where every send/recv and every merge is
 explicit in user code.
 
-Algorithm (n = ring size, input [L] split into n chunks of c):
+Three entry points (all run inside ``shard_map`` over a 1-D mesh axis):
 
-- reduce-scatter, n-1 steps: at step s each member sends its running
-  partial sum (of chunk ``(me - s) % n``) to the right neighbor and
-  merges the incoming partial (chunk ``(me - s - 1) % n``) with its
-  local copy. After n-1 steps member r holds chunk ``(r + 1) % n``
-  fully reduced. Each step moves c elements per link.
-- allgather, n-1 steps: forward the newest finished chunk around the
-  ring. Total wire traffic: 2 (n-1)/n of the buffer per member —
-  Rabenseifner's bandwidth bound, the same the reference's
-  halving/doubling pays over sockets.
+- :func:`ring_allreduce_kernel` — reduce-scatter + allgather fused in
+  one kernel (2(n-1) steps, Rabenseifner's 2(n-1)/n bandwidth bound);
+  ANY length (identity-padded internally to lane-aligned chunks) and
+  any element-wise operator (the merge is fused into the ring step on
+  the VPU).
+- :func:`ring_reduce_scatter_kernel` — n-1 steps; member r ends with
+  chunk r of the reduction (the ``coll.reduce_scatter`` contract, so
+  the driver backend can substitute it directly).
+- :func:`ring_allgather_kernel` — n-1 steps of forwarding this
+  member's shard around the ring.
 
-Slot discipline: separate send/recv buffers, alternating slots per
-global step, plus CREDIT-BASED BACKPRESSURE. The DMA waits alone do
-not bound ring skew (sends go right but a member's waits are satisfied
-by its LEFT neighbor, so a delayed rank's upstream can run ahead and
-overwrite an unconsumed receive slot). After consuming a receive slot,
-a member signals a credit to its left neighbor on a regular semaphore;
-the sender waits for that credit before reusing the slot (first use of
-each slot needs none — the buffer starts free). Residual credits are
-drained at kernel exit so every semaphore returns to zero.
+Data layout: chunks travel as 2-D ``[rows, 128]`` tiles (Mosaic's
+native (sublane x lane) tiling — 1-D dynamic slices would need
+start-alignment proofs the compiler cannot make), so compiled chunk
+sizes are multiples of 128 x sublane(dtype) elements; the allreduce
+entry pads internally, the reduce-scatter/allgather entries require it
+(their chunk boundaries are the caller's contract). Interpret mode
+uses ``[c, 1]`` tiles — no alignment, tiny test shapes stay tiny.
+
+Protocol, in three layers:
+
+1. ENTRY BARRIER (compiled path): a remote DMA must not land on a
+   device that has not entered the kernel yet, so every member signals
+   both ring neighbors on the Mosaic barrier semaphore
+   (``get_barrier_semaphore``, keyed by ``collective_id``) and waits
+   for both of its own signals before any transfer.
+2. SLOT DISCIPLINE: separate send/recv buffers, alternating slots per
+   global step, DMA send/recv semaphores per slot.
+3. CREDIT-BASED BACKPRESSURE: the DMA waits alone do not bound ring
+   skew (sends go right but a member's waits are satisfied by its LEFT
+   neighbor, so a delayed rank's upstream can run ahead and overwrite
+   an unconsumed receive slot). After consuming a receive slot, a
+   member signals a credit to its left neighbor on a regular semaphore;
+   the sender waits for that credit before reusing the slot (first use
+   of each slot needs none — the buffer starts free). Residual credits
+   are drained at kernel exit so every semaphore returns to zero. The
+   accounting is property-tested host-side against a skew-adversarial
+   scheduler in ``tests/test_ring_kernel.py``.
 
 Tested in Pallas interpret mode on multi-device CPU meshes (the
-driver's virtual-pod pattern); on real hardware the kernel compiles for
-a multi-chip mesh (chunk size must then be lane-aligned; single-chip
-rings are a no-op).
+driver's virtual-pod pattern; the interpreter serializes members and
+has no remote semaphores, so barrier+credits are compiled-path only)
+and AOT-compiled for a real v5e-8 TPU topology by
+``check/checkaot.py`` (barrier + credit path included).
 """
 
 from __future__ import annotations
@@ -47,13 +67,47 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operators import Operator, Operators
+
+_LANES = 128
+# minimum sublane count per dtype byte-width (Mosaic tiling table)
+_SUBLANE = {8: 8, 4: 8, 2: 16, 1: 32}
+
+
+def min_chunk_elems(dtype) -> int:
+    """Compiled-path chunk-size granule: one full (sublane x lane)
+    tile of ``dtype``. Callers padding for ``algo='rdma'`` align to
+    this."""
+    return _LANES * _SUBLANE.get(jnp.dtype(dtype).itemsize, 8)
+
+
+def round_up_chunk(n_elems: int, dtype, interpret: bool = False) -> int:
+    """``n_elems`` rounded up to the compiled-path chunk granule (the
+    ONE place the Mosaic tiling rule turns into a padding amount);
+    identity in interpret mode."""
+    if interpret:
+        return max(n_elems, 1)
+    g = min_chunk_elems(dtype)
+    return -(-max(n_elems, 1) // g) * g
 
 
 def _ring_kernel(x_ref, out_ref, sbuf, rbuf, send_sem, recv_sem,
-                 credit_sem, *, n, c, axis_name, use_credits):
+                 credit_sem, *, n, rows, axis_name, mode, op_fn,
+                 use_credits, use_barrier):
     me = lax.axis_index(axis_name)
     right = jnp.mod(me + 1, n)
     left = jnp.mod(me - 1, n)
+
+    if use_barrier:
+        # remote DMA may not target a device still outside its
+        # pallas_call: handshake with both ring neighbors first (Mosaic
+        # requires the collective_id barrier semaphore for this)
+        bar = pltpu.get_barrier_semaphore()
+        for nb in (left, right):
+            pltpu.semaphore_signal(
+                bar, inc=1, device_id=nb,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(bar, 2)
 
     def exchange(g, value):
         """Global step g: send ``value`` right, return what arrived from
@@ -82,69 +136,76 @@ def _ring_kernel(x_ref, out_ref, sbuf, rbuf, send_sem, recv_sem,
                 device_id_type=pltpu.DeviceIdType.LOGICAL)
         return got
 
-    def chunk(idx):
-        return x_ref[pl.ds(idx * c, c)]
+    # chunk index shift: 0 makes member r finish the reduce-scatter
+    # holding chunk (r+1)%n (the classic ring layout); -1 shifts every
+    # selection one chunk left so member r finishes holding chunk r
+    # (the coll.reduce_scatter contract)
+    shift = -1 if mode == "reduce_scatter" else 0
 
-    # ---- reduce-scatter: n-1 partial-sum pushes (steps 0..n-2) -----
-    acc = chunk(me)                           # running partial, [c]
-    for s in range(n - 1):
-        got = exchange(s, acc)
-        acc = got + chunk(jnp.mod(me - s - 1, n))
+    def rds(idx):
+        """Row slice of chunk ``(idx + shift) % n``; the dynamic start
+        is a multiple of the static ``rows``, which Mosaic can prove
+        tile-aligned."""
+        return pl.ds(jnp.mod(idx + shift, n) * rows, rows)
 
-    # acc now holds chunk (me + 1) % n fully reduced
-    mine = jnp.mod(me + 1, n)
-    out_ref[pl.ds(mine * c, c)] = acc
+    steps = 0
+    if mode in ("allreduce", "reduce_scatter"):
+        # ---- reduce-scatter: n-1 partial-merge pushes ----------------
+        acc = x_ref[rds(me), :]               # running partial
+        for s in range(n - 1):
+            got = exchange(steps, acc)
+            acc = op_fn(got, x_ref[rds(me - s - 1), :])
+            steps += 1
+        if mode == "reduce_scatter":
+            out_ref[...] = acc                # chunk me, fully reduced
+        else:
+            # acc holds chunk (me + 1) % n fully reduced
+            out_ref[rds(me + 1), :] = acc
+            # ---- allgather: forward the newest chunk -----------------
+            # the global step index continues across the phase boundary
+            # so successive transfers always alternate slots
+            cur = acc
+            for s in range(n - 1):
+                cur = exchange(steps, cur)
+                out_ref[rds(me - s), :] = cur       # owner of arrival
+                steps += 1
+    else:  # pure allgather of this member's shard
+        out_ref[rds(me), :] = x_ref[...]
+        cur = x_ref[...]
+        for s in range(n - 1):
+            cur = exchange(steps, cur)
+            out_ref[rds(me - s - 1), :] = cur
+            steps += 1
 
-    # ---- allgather: forward the newest chunk (steps n-1..2n-3) -----
-    # the global step index continues across the phase boundary so
-    # successive transfers always alternate slots
-    cur = acc
-    for s in range(n - 1):
-        cur = exchange(n - 1 + s, cur)
-        src = jnp.mod(me - s, n)      # owner of the arrival
-        out_ref[pl.ds(src * c, c)] = cur
-
-    # drain the final credits (one per slot, granted by the right
-    # neighbor's last consumptions) so every semaphore exits at zero
+    # drain the final credits (one per slot that was used, granted by
+    # the right neighbor's last consumptions) so every semaphore exits
+    # at zero
     if use_credits:
-        total = 2 * (n - 1)
-        for slot in range(min(2, total)):
+        for slot in range(min(2, steps)):
             pltpu.semaphore_wait(credit_sem.at[slot], 1)
 
 
-def ring_allreduce_kernel(x, axis_name="mp4j", interpret: bool = False):
-    """SUM-allreduce of a per-member [L] array via explicit ICI RDMA.
-
-    Runs inside ``shard_map`` over a 1-D mesh axis; L must be divisible
-    by the axis size. SUM only: the merge is fused into the ring step
-    (other operators belong to the ppermute ring in ops/ring.py).
-    """
-    n = lax.axis_size(axis_name)
-    if n == 1:
-        return x
-    if x.ndim != 1 or x.shape[0] % n:
-        raise Mp4jError(
-            f"ring kernel needs a 1-D length divisible by {n}, "
-            f"got shape {x.shape}")
-    L = x.shape[0]
-    c = L // n
-    vma = getattr(jax.typeof(x), "vma", None)
-    if vma:
-        out_shape = jax.ShapeDtypeStruct((L,), x.dtype, vma=vma)
-    else:
-        out_shape = jax.ShapeDtypeStruct((L,), x.dtype)
+def _pallas_ring(x2d, out_rows, mode, op_fn, n, rows, axis_name,
+                 interpret):
+    lanes = x2d.shape[1]
+    vma = getattr(jax.typeof(x2d), "vma", None)
+    shape = (out_rows, lanes)
+    out_shape = (jax.ShapeDtypeStruct(shape, x2d.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(shape, x2d.dtype))
     # the interpreter serializes members (races are impossible) and
-    # does not implement REMOTE semaphore signals, so the credit
-    # protocol is compiled-path only
+    # does not implement REMOTE semaphores, so the entry barrier and
+    # the credit protocol are compiled-path only
     return pl.pallas_call(
-        functools.partial(_ring_kernel, n=n, c=c, axis_name=axis_name,
-                          use_credits=not interpret),
+        functools.partial(_ring_kernel, n=n, rows=rows,
+                          axis_name=axis_name, mode=mode, op_fn=op_fn,
+                          use_credits=not interpret,
+                          use_barrier=not interpret),
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, c), x.dtype),      # send slots
-            pltpu.VMEM((2, c), x.dtype),      # recv slots
+            pltpu.VMEM((2, rows, lanes), x2d.dtype),   # send slots
+            pltpu.VMEM((2, rows, lanes), x2d.dtype),   # recv slots
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),  # slot-free credits
@@ -152,4 +213,87 @@ def ring_allreduce_kernel(x, axis_name="mp4j", interpret: bool = False):
         compiler_params=pltpu.CompilerParams(has_side_effects=True,
                                              collective_id=0),
         interpret=interpret,
-    )(x)
+    )(x2d)
+
+
+def _check_1d(x, what: str):
+    if x.ndim != 1:
+        raise Mp4jError(f"{what} needs a 1-D array, got shape {x.shape}")
+
+
+def _tile(c: int, dtype, interpret: bool, what: str):
+    """(rows, lanes) layout of a c-element chunk: full Mosaic tiles on
+    the compiled path, [c, 1] in interpret mode."""
+    if interpret:
+        return c, 1
+    granule = min_chunk_elems(dtype)
+    if c % granule:
+        raise Mp4jError(
+            f"{what}: compiled chunks must be multiples of {granule} "
+            f"elements for {jnp.dtype(dtype).name} (Mosaic tiling); "
+            f"got {c} (see min_chunk_elems)")
+    return c // _LANES, _LANES
+
+
+def ring_allreduce_kernel(x, operator: Operator = Operators.SUM,
+                          axis_name="mp4j", interpret: bool = False):
+    """Allreduce of a per-member [L] array via explicit ICI RDMA.
+
+    Any element-wise associative+commutative ``operator`` (the merge
+    runs on the VPU inside the ring step); ANY length L — the buffer is
+    padded with the operator identity to n equal tile-aligned chunks
+    and sliced back, so padding never perturbs the result.
+    """
+    n = lax.axis_size(axis_name)
+    _check_1d(x, "ring allreduce kernel")
+    if n == 1:
+        return x
+    L = x.shape[0]
+    c = round_up_chunk(-(-L // n), x.dtype, interpret)
+    pad = n * c - L
+    if pad:
+        ident = jnp.asarray(operator.identity(x.dtype), dtype=x.dtype)
+        x = jnp.concatenate([x, jnp.full((pad,), ident, x.dtype)])
+    rows, lanes = _tile(c, x.dtype, interpret, "ring allreduce kernel")
+    out = _pallas_ring(x.reshape(n * rows, lanes), n * rows, "allreduce",
+                       operator.jnp_fn, n, rows, axis_name, interpret)
+    out = out.reshape(n * c)
+    return out[:L] if pad else out
+
+
+def ring_reduce_scatter_kernel(x, operator: Operator = Operators.SUM,
+                               axis_name="mp4j", interpret: bool = False):
+    """Member r ends with chunk r ([L/n]) of the element-wise reduction
+    (the ``coll.reduce_scatter`` layout). L must be divisible by the
+    axis size, and compiled chunks by ``min_chunk_elems`` (pad outside
+    — the chunk boundaries are the caller's contract)."""
+    n = lax.axis_size(axis_name)
+    _check_1d(x, "ring reduce-scatter kernel")
+    if x.shape[0] % n:
+        raise Mp4jError(
+            f"ring reduce-scatter kernel needs a length divisible by "
+            f"{n}, got shape {x.shape}")
+    if n == 1:
+        return x
+    c = x.shape[0] // n
+    rows, lanes = _tile(c, x.dtype, interpret,
+                        "ring reduce-scatter kernel")
+    out = _pallas_ring(x.reshape(n * rows, lanes), rows,
+                       "reduce_scatter", operator.jnp_fn, n, rows,
+                       axis_name, interpret)
+    return out.reshape(c)
+
+
+def ring_allgather_kernel(x, axis_name="mp4j", interpret: bool = False):
+    """Every member ends with [n * c]: member q's [c] shard at block q
+    (the ``ring.ring_allgather`` layout). Compiled shards must be
+    multiples of ``min_chunk_elems``."""
+    n = lax.axis_size(axis_name)
+    _check_1d(x, "ring allgather kernel")
+    if n == 1:
+        return x
+    c = x.shape[0]
+    rows, lanes = _tile(c, x.dtype, interpret, "ring allgather kernel")
+    out = _pallas_ring(x.reshape(rows, lanes), n * rows, "allgather",
+                       None, n, rows, axis_name, interpret)
+    return out.reshape(n * c)
