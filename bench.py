@@ -38,8 +38,8 @@ Prints exactly one JSON line.
 
 import json
 import os
+import queue as pyqueue
 import sys
-import threading
 import time
 
 import numpy as np
@@ -97,34 +97,72 @@ def _numpy_histograms(bins, g, h, node_ids, n_nodes, f, b):
 
 
 def _run_socket_job(procs, body, native_transport, join_timeout=300.0):
-    """Master + ``procs`` slave worker threads; ``body(slave, rank)``
+    """Master + ``procs`` slave worker PROCESSES; ``body(slave, rank)``
     returns a per-rank result. Raises the first worker error, or a
     RuntimeError naming the hung ranks if any worker missed the join
-    deadline without raising."""
+    deadline without raising.
+
+    Real OS processes (fork), matching the reference's unit of
+    parallelism — N slave JVMs on one host (SURVEY.md section 4). A
+    thread-based harness would share the GIL, understating the baseline
+    (pickle framing holds the GIL); fork also lets ``body`` closures
+    capture the benchmark data without pickling. Socket benches must
+    run BEFORE any TPU client exists in this process (see main) — the
+    children inherit the parent image and a forked device runtime is
+    not fork-safe."""
+    import multiprocessing as mp
+
     from ytk_mp4j_tpu.comm.master import Master
     from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
 
+    ctx = mp.get_context("fork")
     master = Master(procs, timeout=60.0).serve_in_thread()
-    results = [None] * procs
-    errors = []
+    q = ctx.Queue()
 
     def worker():
         try:
             slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
                                      native_transport=native_transport)
-            results[slave.rank] = body(slave, slave.rank)
+            res = body(slave, slave.rank)
             slave.close(0)
+            q.put(("ok", slave.rank, res))
         except Exception as e:  # pragma: no cover
-            errors.append(e)
+            q.put(("err", -1, repr(e)))
 
-    ts = [threading.Thread(target=worker, daemon=True)
-          for _ in range(procs)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(join_timeout)
-    if errors:
-        raise errors[0]
+    ps = [ctx.Process(target=worker, daemon=True) for _ in range(procs)]
+    for p in ps:
+        p.start()
+    results = [None] * procs
+    deadline = time.monotonic() + join_timeout
+    got = 0
+    while got < procs:
+        try:
+            kind, rank, payload = q.get(timeout=1.0)
+        except pyqueue.Empty:
+            # fail fast on a child killed by a signal (segfault / OOM):
+            # it can never report, so waiting out the deadline would
+            # misdiagnose the crash as a hang
+            dead = [p.exitcode for p in ps
+                    if not p.is_alive() and p.exitcode not in (0, None)]
+            if dead:
+                for p in ps:
+                    p.terminate()
+                raise RuntimeError(
+                    f"socket benchmark worker died without reporting "
+                    f"(exit codes {dead})")
+            if time.monotonic() > deadline:
+                break
+            continue
+        if kind == "err":
+            for p in ps:
+                p.terminate()
+            raise RuntimeError(f"socket benchmark worker failed: {payload}")
+        results[rank] = payload
+        got += 1
+    for p in ps:
+        p.join(max(0.1, deadline - time.monotonic()))
+        if p.is_alive():
+            p.terminate()
     if any(r is None for r in results):
         hung = [i for i, r in enumerate(results) if r is None]
         raise RuntimeError(
@@ -250,10 +288,14 @@ def bench_ffm_tpu(n=8192, n_features=100_000, n_fields=8, k=8,
     return 1.0 / dt
 
 
-def bench_socket_map(procs=4, keys=20_000, reps=3):
+def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
     """Map<String,Double> sparse-grad allreduce over loopback TCP
     (BASELINE.md configs[2], the reference's Kryo operand path —
-    pickle-framed here). Returns merged keys/sec."""
+    pickle-framed here). Returns merged keys/sec.
+
+    ``int_keys=True`` uses {feature id -> value} integer keys — the
+    actual ytk-learn sparse-gradient shape (cheaper to pickle than
+    strings; the merge loop is identical)."""
     from ytk_mp4j_tpu.operands import Operands
     from ytk_mp4j_tpu.operators import Operators
 
@@ -261,9 +303,11 @@ def bench_socket_map(procs=4, keys=20_000, reps=3):
         # 50% overlap across ranks, like sparse gradient updates; one
         # dict per rep (allreduce_map merges in place), built OUTSIDE
         # the timed region so only the collective is measured
+        def key(i):
+            c = (r * keys // 2 + i) % (procs * keys)
+            return c if int_keys else f"w{c}"
         dicts = [
-            {f"w{(r * keys // 2 + i) % (procs * keys)}": float(i)
-             for i in range(keys)}
+            {key(i): float(i) for i in range(keys)}
             for _ in range(reps)
         ]
         slave.barrier()
@@ -285,11 +329,15 @@ def main():
     # per-byte measure and was measured slightly HIGHER at 11M: 3.33 vs
     # 3.05 GB/s/chip, so the default understates nothing).
     n_tpu = int(float(os.environ.get("MP4J_BENCH_N", "1e6")))
-    tpu_gbs, trees_per_sec, n_chips = bench_tpu(n=n_tpu)
+    # socket benches FIRST: they fork real slave processes, and forking
+    # after the TPU client exists is not fork-safe (the children would
+    # inherit live device-runtime threads/fds)
     sock_gbs, sock_coll_gbs = bench_socket()
     sock_native_coll_gbs = bench_socket_collective(native_transport=True)
-    ffm_steps = bench_ffm_tpu()
     map_keys = bench_socket_map()
+    map_int_keys = bench_socket_map(int_keys=True)
+    tpu_gbs, trees_per_sec, n_chips = bench_tpu(n=n_tpu)
+    ffm_steps = bench_ffm_tpu()
     print(json.dumps({
         "metric": "gbdt-histogram-allreduce GB/s/chip",
         "value": round(tpu_gbs, 4),
@@ -302,6 +350,7 @@ def main():
             "socket_native_collective_gbs": round(sock_native_coll_gbs, 4),
             "ffm_sparse_steps_per_sec": round(ffm_steps, 3),
             "socket_map_allreduce_keys_per_sec": round(map_keys, 0),
+            "socket_map_int_allreduce_keys_per_sec": round(map_int_keys, 0),
             "n_chips": n_chips,
             "config": f"Higgs-like synthetic, F=28, B=256, depth=6, "
                       f"N_tpu={n_tpu:.0e}, N_socket=2e5/4 procs; 10 "

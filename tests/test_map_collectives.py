@@ -243,3 +243,24 @@ def test_tpu_map_mixed_value_shapes_rejected(cluster):
     maps = [{"a": 1.0}, {"b": np.ones(3)}, {}, {}]
     with pytest.raises(Mp4jError):
         cluster.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+
+
+@pytest.mark.parametrize("op", ["SUM", "MAX"])
+def test_socket_allreduce_map_int_keys(op, rng):
+    """Integer feature-id keys (the ytk-learn sparse-gradient shape)
+    must merge exactly like string keys through the socket path."""
+    n = 4
+    maps = [{int(k): float(v) for k, v in
+             zip(rng.integers(0, 400, 120), rng.standard_normal(120))}
+            for _ in range(n)]
+    want = expected_map_reduce(maps, op)
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.by_name(op))
+        return d
+
+    for got in run_slaves(n, fn):
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-12)

@@ -46,21 +46,6 @@ def test_native_backend_is_active():
     assert native.HAVE_NATIVE
 
 
-def test_merge_unique_u64():
-    a = np.array([1, 3, 5, 7], dtype=np.uint64)
-    b = np.array([2, 3, 6, 7, 9], dtype=np.uint64)
-    got = native.merge_unique_u64(a, b)
-    np.testing.assert_array_equal(got, np.array([1, 2, 3, 5, 6, 7, 9],
-                                                dtype=np.uint64))
-
-
-def test_merge_unique_u64_random(rng):
-    a = np.unique(rng.integers(0, 1000, 300).astype(np.uint64))
-    b = np.unique(rng.integers(0, 1000, 300).astype(np.uint64))
-    got = native.merge_unique_u64(a, b)
-    np.testing.assert_array_equal(got, np.union1d(a, b))
-
-
 def test_custom_operator():
     absmax = Operator.custom("ABSMAX",
                              lambda x, y: np.where(np.abs(x) >= np.abs(y), x, y),

@@ -302,6 +302,8 @@ class DistributedComm(CommSlave):
     # -- map collectives (pickled-object path) -------------------------
     @staticmethod
     def _merge_maps(operator: Operator, acc: dict, src: dict) -> dict:
+        # plain per-key loop by measurement — see
+        # process_comm._merge_maps
         for k, v in src.items():
             acc[k] = operator.np_fn(acc[k], v) if k in acc else v
         return acc

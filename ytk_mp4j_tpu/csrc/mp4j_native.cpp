@@ -83,23 +83,4 @@ int mp4j_reduce(int32_t dtype, int32_t op, void* acc, const void* src,
   }
 }
 
-// Merge two ascending u64 key arrays into `out` (caller-allocated, size
-// >= na + nb), dropping duplicates across (and within) inputs. Returns the
-// merged length. Used for sparse-map key union.
-int64_t mp4j_merge_unique_u64(const uint64_t* __restrict a, int64_t na,
-                              const uint64_t* __restrict b, int64_t nb,
-                              uint64_t* __restrict out) {
-  int64_t i = 0, j = 0, k = 0;
-  while (i < na || j < nb) {
-    uint64_t v;
-    if (j >= nb || (i < na && a[i] <= b[j])) {
-      v = a[i++];
-    } else {
-      v = b[j++];
-    }
-    if (k == 0 || out[k - 1] != v) out[k++] = v;
-  }
-  return k;
-}
-
 }  // extern "C"

