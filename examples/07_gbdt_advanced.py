@@ -40,3 +40,27 @@ np.testing.assert_allclose(
     serve.predict(binner2.transform(X[N - 5000:]), trees2, proba=True),
     proba, rtol=1e-5)
 print("saved, reloaded, and served identically")
+
+# -- missing values + categorical features (ytk-learn data handling) --
+# NaN-laden continuous features: missing_bucket reserves bin 0 for NaN
+# and the trainer LEARNS each split's default direction. Feature 9 is a
+# TRUE categorical: its small integer codes are placed directly as bin
+# ids (in [1, B-2] — bin 0 is the missing bucket, bin B-1 the freeze
+# sentinel), NOT quantile-binned; equality splits ("code == c goes
+# right") need real category codes, not ordered quantile buckets.
+Xm = X.copy()
+Xm[rng.random(N) < 0.25, 2] = np.nan
+codes = rng.integers(0, 6, N)                 # 6 categories
+ym = ((np.isnan(Xm[:, 2]) | (Xm[:, 2] > 0.8))
+      & (codes == 2)).astype(np.float32)
+mbinner = QuantileBinner(B, missing_bucket=True).fit(Xm)
+mbins = np.array(mbinner.transform(Xm))       # writable copy
+mbins[:, 9] = codes + 1                       # codes -> bins [1, 6]
+mcfg = GBDTConfig(n_features=F, n_bins=B, depth=4, n_trees=20,
+                  learning_rate=0.3, loss="logistic",
+                  missing_bin=True, categorical_features=(9,))
+mtr = GBDTTrainer(mcfg)
+mtrees, _ = mtr.train(mbins, ym)
+macc = float(((mtr.predict(mbins, mtrees, proba=True) > 0.5) == ym).mean())
+print(f"missing+categorical acc: {macc:.3f}")
+assert macc > 0.95

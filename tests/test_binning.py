@@ -92,6 +92,7 @@ def test_predict_proba_extreme_margins_no_overflow(rng):
     tr = GBDTTrainer(cfg, mesh=make_mesh(1))
     # a tree whose leaves are huge margins
     trees = [(np.zeros(1, np.int32), np.zeros(1, np.int32),
+              np.zeros(1, np.int32),
               np.array([-500.0, 500.0], np.float32))]
     bins = rng.integers(0, B, (64, F)).astype(np.int32)
     with np.errstate(over="raise"):

@@ -311,7 +311,7 @@ def test_empty_leaf_nan_stays_isolated(rng):
     new_preds, tree = train_tree_shard(
         jnp.array(bins), jnp.array(y), jnp.array(preds), cfg)
     # depth-4 over 256 samples: empty leaves are essentially guaranteed
-    assert np.isnan(np.asarray(tree[2])).any(), "test needs an empty leaf"
+    assert np.isnan(np.asarray(tree[3])).any(), "test needs an empty leaf"
     assert np.isfinite(np.asarray(new_preds)).all()
     applied = np.asarray(predict_tree(jnp.array(bins), tree, cfg))
     assert np.isfinite(applied).all()
@@ -327,10 +327,11 @@ def test_best_splits_prefers_separating_feature():
     hg[0, 1, 1] = -8.0
     hg[0, 1, 2] = 9.0
     hg[0, 1, 3] = 9.0
-    feat, bin_, gain = best_splits(jnp.array(hg), jnp.array(hh), 1.0)
+    feat, bin_, gain, dir_ = best_splits(jnp.array(hg), jnp.array(hh), 1.0)
     assert int(feat[0]) == 1
     assert int(bin_[0]) == 1
     assert float(gain[0]) > 0
+    assert int(dir_[0]) == 0          # no missing handling: always left
 
 
 def test_single_device_tree_reduces_loss(rng):
@@ -374,9 +375,11 @@ def test_distributed_training_matches_single_device(mesh_builder, rng):
 
     np.testing.assert_allclose(preds_d[:N], preds_s[:N], rtol=1e-4,
                                atol=1e-5)
-    for (f_d, b_d, v_d), (f_s, b_s, v_s) in zip(trees_d, trees_s):
+    for (f_d, b_d, d_d, v_d), (f_s, b_s, d_s, v_s) in zip(trees_d,
+                                                          trees_s):
         np.testing.assert_array_equal(np.asarray(f_d), np.asarray(f_s))
         np.testing.assert_array_equal(np.asarray(b_d), np.asarray(b_s))
+        np.testing.assert_array_equal(np.asarray(d_d), np.asarray(d_s))
         np.testing.assert_allclose(np.asarray(v_d), np.asarray(v_s),
                                    rtol=1e-4, atol=1e-5)
 
@@ -428,3 +431,265 @@ def test_wrong_bins_width_rejected(rng):
         tr.train(narrow, y)
     with pytest.raises(Mp4jError):
         tr.train(bins, y, eval_set=(narrow, y))
+
+
+# ----------------------------------------------------------------------
+# missing-value default direction + categorical splits (ytk-learn's
+# data-handling features), checked against a compact numpy oracle
+# ----------------------------------------------------------------------
+def _oracle_tree(bins, g, h, cfg):
+    """Depth-d level-wise numpy mirror of _build_tree with missing
+    direction + categorical handling (exact f64 histograms)."""
+    F, B, lam = cfg.n_features, cfg.n_bins, cfg.reg_lambda
+    cats = set(cfg.categorical_features)
+    N = bins.shape[0]
+    node = np.zeros(N, np.int64)
+    feats, bs, dirs = [], [], []
+    for d in range(cfg.depth):
+        n_nodes = 2 ** d
+        bf, bb, bd, bg = (np.zeros(n_nodes, int), np.zeros(n_nodes, int),
+                          np.zeros(n_nodes, int),
+                          np.full(n_nodes, -np.inf))
+        for n in range(n_nodes):
+            m = node == n
+            for f in range(F):
+                hg = np.bincount(bins[m, f], weights=g[m], minlength=B)
+                hh = np.bincount(bins[m, f], weights=h[m], minlength=B)
+                Gt, Ht = hg.sum(), hh.sum()
+
+                def score(G, H):
+                    return G * G / (H + lam)
+
+                for b in range(B - 1):      # B-1 excluded everywhere
+                    if f in cats:
+                        GL, HL = Gt - hg[b], Ht - hh[b]
+                        variants = [(GL, HL, 0)]
+                    else:
+                        GL = hg[: b + 1].sum()
+                        HL = hh[: b + 1].sum()
+                        variants = [(GL, HL, 0)]
+                        if cfg.missing_bin:
+                            variants.append((GL - hg[0], HL - hh[0], 1))
+                    for GL, HL, dr in variants:
+                        gain = (score(GL, HL) + score(Gt - GL, Ht - HL)
+                                - score(Gt, Ht))
+                        if gain > bg[n]:
+                            bf[n], bb[n], bd[n], bg[n] = f, b, dr, gain
+            if not bg[n] > cfg.min_split_gain:
+                bf[n], bb[n], bd[n] = 0, B - 1, 0
+        feats.append(bf)
+        bs.append(bb)
+        dirs.append(bd)
+        v = bins[np.arange(N), bf[node]]
+        go_right = v > bb[node]
+        if cfg.missing_bin:
+            go_right = np.where(v == 0, bd[node] > 0, go_right)
+        is_cat = np.isin(bf[node], list(cats)) if cats else np.zeros(N, bool)
+        go_right = np.where(is_cat, (v == bb[node]) & (bb[node] != B - 1),
+                            go_right)
+        node = node * 2 + go_right
+    leaves = 2 ** cfg.depth
+    lg = np.bincount(node, weights=g, minlength=leaves)
+    lh = np.bincount(node, weights=h, minlength=leaves)
+    leaf = -lg / (lh + lam)
+    return (np.concatenate(feats), np.concatenate(bs),
+            np.concatenate(dirs), leaf)
+
+
+def _train_one(bins, y, cfg):
+    preds = np.zeros(len(y), np.float32)
+    new_preds, tree = train_tree_shard(
+        jnp.array(bins), jnp.array(y), jnp.array(preds), cfg)
+    return np.asarray(new_preds), [np.asarray(t) for t in tree]
+
+
+@pytest.mark.parametrize("missing_bin", [False, True])
+def test_missing_direction_matches_oracle(rng, missing_bin):
+    N, F, B = 512, 4, 8
+    # min_split_gain > 0: a pure/empty node's mathematically-zero gain
+    # rounds to a small positive in the device's f32 while the f64
+    # oracle gets exactly 0; a common threshold freezes both the same
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, hist_mode="flat",
+                     learning_rate=1.0, missing_bin=missing_bin,
+                     min_split_gain=0.01)
+    bins = rng.integers(1, B, (N, F)).astype(np.int32)
+    missing = rng.random(N) < 0.3
+    bins[missing, 0] = 0                   # bin 0 = the missing bucket
+    # missing samples behave like HIGH values of f0 (the case where a
+    # learned direction matters: an ordered split at b >= 1 wants the
+    # missing bucket on its RIGHT side, which forced-left cannot do;
+    # splitting at b = 0 instead would mis-pool missing with the lows)
+    y = (((bins[:, 0] >= B // 2) | missing) * 2.0
+         + 0.01 * rng.standard_normal(N)).astype(np.float32)
+    g = (np.zeros(N) - y).astype(np.float64)   # squared loss at preds=0
+    h = np.ones(N, np.float64)
+    of, ob, od, ol = _oracle_tree(bins, g, h, cfg)
+    new_preds, (tf, tb, td, lv) = _train_one(bins, y, cfg)
+    np.testing.assert_array_equal(tb, ob)
+    # frozen nodes (bin == B-1) keep an arbitrary argmax feature on the
+    # device (routing ignores it); compare features on real splits only
+    live = ob != B - 1
+    np.testing.assert_array_equal(tf[live], of[live])
+    np.testing.assert_array_equal(td[live], od[live])
+    np.testing.assert_allclose(lv, ol, rtol=1e-4, atol=1e-5)
+    if missing_bin:
+        assert (td > 0).any(), "signal-bearing missing should go right"
+    else:
+        assert (td == 0).all()
+
+
+def test_missing_direction_improves_fit(rng):
+    """Learned direction must beat forced-left on data where missing
+    correlates with the target."""
+    N, F, B = 1024, 3, 8
+    bins = rng.integers(1, B, (N, F)).astype(np.int32)
+    missing = rng.random(N) < 0.4
+    bins[missing, 0] = 0
+    y = (missing * 3.0
+         + 0.05 * rng.standard_normal(N)).astype(np.float32)
+    mses = {}
+    for mb in (False, True):
+        cfg = GBDTConfig(n_features=F, n_bins=B, depth=2,
+                         hist_mode="flat", learning_rate=1.0,
+                         missing_bin=mb)
+        new_preds, _ = _train_one(bins, y, cfg)
+        mses[mb] = float(np.mean((new_preds - y) ** 2))
+    assert mses[True] <= mses[False] * 1.0001
+
+
+def test_categorical_split_matches_oracle(rng):
+    N, F, B = 512, 3, 8
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=2, hist_mode="flat",
+                     learning_rate=1.0, categorical_features=(0,),
+                     min_split_gain=0.01)
+    bins = rng.integers(0, B - 1, (N, F)).astype(np.int32)
+    # y depends on f0 == 3 EXACTLY — an ordered split cannot isolate it
+    # in one level; the equality split can
+    y = ((bins[:, 0] == 3) * 2.0
+         + 0.01 * rng.standard_normal(N)).astype(np.float32)
+    g = (np.zeros(N) - y).astype(np.float64)
+    h = np.ones(N, np.float64)
+    of, ob, od, ol = _oracle_tree(bins, g, h, cfg)
+    new_preds, (tf, tb, td, lv) = _train_one(bins, y, cfg)
+    np.testing.assert_array_equal(tb, ob)
+    live = ob != B - 1          # frozen nodes keep an arbitrary feature
+    np.testing.assert_array_equal(tf[live], of[live])
+    np.testing.assert_allclose(lv, ol, rtol=1e-4, atol=1e-5)
+    # the root must be the equality split on (f0, category 3)
+    assert tf[0] == 0 and tb[0] == 3
+    mse = float(np.mean((new_preds - y) ** 2))
+    assert mse < 0.01
+
+
+def test_categorical_beats_numeric_on_equality_signal(rng):
+    N, F, B = 1024, 2, 16
+    bins = rng.integers(0, B - 1, (N, F)).astype(np.int32)
+    y = ((bins[:, 0] == 7) * 1.0
+         + 0.02 * rng.standard_normal(N)).astype(np.float32)
+    mses = {}
+    for cats in ((), (0,)):
+        cfg = GBDTConfig(n_features=F, n_bins=B, depth=1,
+                         hist_mode="flat", learning_rate=1.0,
+                         categorical_features=cats)
+        new_preds, _ = _train_one(bins, y, cfg)
+        mses[cats] = float(np.mean((new_preds - y) ** 2))
+    assert mses[(0,)] < mses[()] * 0.5
+
+
+def test_missing_and_categorical_roundtrip_predict(rng, tmp_path):
+    """predict_tree replays training-time routing (missing + cat), and
+    the dir array survives save/load."""
+    N, F, B = 256, 4, 8
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=3, hist_mode="flat",
+                     missing_bin=True, categorical_features=(2,),
+                     learning_rate=0.7, n_trees=2)
+    bins = rng.integers(1, B - 1, (N, F)).astype(np.int32)
+    bins[rng.random(N) < 0.3, 0] = 0
+    y = (bins[:, 2] == 2) * 1.5 + (bins[:, 0] == 0) * 1.0
+    y = y.astype(np.float32)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    trees, preds = tr.train(bins, y)
+    re_pred = tr.predict(bins, trees)
+    np.testing.assert_allclose(re_pred, preds[:N], rtol=1e-4, atol=1e-5)
+    path = str(tmp_path / "m.npz")
+    tr.save_model(path, trees)
+    cfg2, trees2, _ = GBDTTrainer.load_model(path)
+    assert cfg2.missing_bin and cfg2.categorical_features == (2,)
+    tr2 = GBDTTrainer(cfg2, mesh=make_mesh(1))
+    np.testing.assert_allclose(tr2.predict(bins, trees2), re_pred,
+                               rtol=1e-5)
+
+
+def test_binner_missing_bucket(rng):
+    from ytk_mp4j_tpu.models.binning import QuantileBinner
+    X = rng.standard_normal((500, 3)).astype(np.float32)
+    X[rng.random(500) < 0.2, 0] = np.nan
+    b = QuantileBinner(8, missing_bucket=True).fit(X)
+    out = b.transform(X)
+    nan_mask = np.isnan(X)
+    assert (out[nan_mask] == 0).all()
+    assert (out[~nan_mask] >= 1).all() and (out[~nan_mask] < 8).all()
+    # default mode: bin 0 shared between NaN and the lowest quantile
+    b0 = QuantileBinner(8).fit(X)
+    out0 = b0.transform(X)
+    assert (out0[nan_mask] == 0).all()
+    assert (out0[~nan_mask] == 0).any()
+
+
+def test_missing_bin_learns_at_zero_reg(rng):
+    """reg_lambda=0: the b=0 missing-right variant is an empty-left
+    0/0 = NaN that must not poison argmax and freeze every node."""
+    N, F, B = 512, 3, 8
+    bins = rng.integers(1, B, (N, F)).astype(np.int32)
+    bins[rng.random(N) < 0.3, 0] = 0
+    y = (bins[:, 0] / B).astype(np.float32)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=2, hist_mode="flat",
+                     learning_rate=1.0, missing_bin=True, reg_lambda=0.0)
+    new_preds, (tf, tb, td, lv) = _train_one(bins, y, cfg)
+    assert (tb != B - 1).any(), "all nodes frozen: NaN poisoned argmax"
+    assert float(np.mean((new_preds - y) ** 2)) < 0.5 * float(np.var(y))
+
+
+def test_load_model_without_dir_arrays(tmp_path, rng):
+    """Models saved before default-direction support (feat/bin/leaf
+    triples) must still load, with all-left directions."""
+    F, B = 3, 8
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=2, n_trees=1)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    bins = rng.integers(0, B, (64, F)).astype(np.int32)
+    y = (bins[:, 0] / B).astype(np.float32)
+    trees, _ = tr.train(bins, y)
+    path = str(tmp_path / "old.npz")
+    tr.save_model(path, trees)
+    # rewrite the file without the dir arrays (the old format)
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files if not k.startswith("dir_")}
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    cfg2, trees2, _ = GBDTTrainer.load_model(path)
+    for (tf, tb, td, lv), (of, ob, od, ol) in zip(trees2, trees):
+        np.testing.assert_array_equal(td, 0)
+        np.testing.assert_array_equal(tf, np.asarray(of))
+    np.testing.assert_allclose(
+        GBDTTrainer(cfg2, mesh=make_mesh(1)).predict(bins, trees2),
+        tr.predict(bins, trees), rtol=1e-6)
+
+
+def test_config_rejects_bad_categorical_types():
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    for bad in ((1.5,), ("x",), (True,)):
+        with pytest.raises(Mp4jError):
+            GBDTConfig(n_features=4, categorical_features=bad)
+    # numpy integer indices normalize to plain ints
+    cfg = GBDTConfig(n_features=4,
+                     categorical_features=(np.int64(2), np.int32(0)))
+    assert cfg.categorical_features == (2, 0)
+
+
+def test_binner_missing_bucket_needs_three_bins():
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    from ytk_mp4j_tpu.models.binning import QuantileBinner
+    with pytest.raises(Mp4jError):
+        QuantileBinner(2, missing_bucket=True)
+    QuantileBinner(3, missing_bucket=True)    # fine
+    QuantileBinner(2)                         # fine without the bucket

@@ -18,6 +18,7 @@ ytk-learn-scale datasets (one numpy quantile pass).
 from __future__ import annotations
 
 import warnings
+from functools import partial
 
 import numpy as np
 
@@ -32,13 +33,25 @@ class QuantileBinner:
 
     fit: edges[f, j] = the (j+1)/B quantile of feature f (B-1 internal
     edges). transform: bin = number of edges <= x, in [0, B).
+
+    ``missing_bucket=True`` RESERVES bin 0 for missing values: finite
+    values bin into [1, B) over B-2 internal edges and NaN maps to
+    exactly bin 0 — the convention ``GBDTConfig(missing_bin=True)``
+    expects for learned-default-direction routing. (The default mode
+    also sends NaN to bin 0, but shares it with the lowest quantile.)
     """
 
-    def __init__(self, n_bins: int = 256):
-        if not 2 <= n_bins <= 65536:
-            raise Mp4jError(f"n_bins must be in [2, 65536], got {n_bins}")
+    def __init__(self, n_bins: int = 256, missing_bucket: bool = False):
+        lo = 3 if missing_bucket else 2   # the bucket consumes one bin;
+        if not lo <= n_bins <= 65536:     # 2 would leave zero edges
+            raise Mp4jError(
+                f"n_bins must be in [{lo}, 65536]"
+                f"{' with missing_bucket' if missing_bucket else ''}, "
+                f"got {n_bins}")
         self.n_bins = n_bins
-        self.edges: np.ndarray | None = None    # [F, B-1] f32
+        self.missing_bucket = missing_bucket
+        # [F, B-1] f32 ([F, B-2] under missing_bucket)
+        self.edges: np.ndarray | None = None
 
     def fit(self, X, sample: int | None = 1_000_000, seed: int = 0):
         """Fit per-feature quantile edges from (a row sample of) X.
@@ -63,7 +76,8 @@ class QuantileBinner:
             raise Mp4jError(
                 f"features {np.flatnonzero(bad).tolist()} have no "
                 "finite values to fit quantile edges from")
-        qs = np.arange(1, self.n_bins) / self.n_bins
+        nb = self.n_bins - 1 if self.missing_bucket else self.n_bins
+        qs = np.arange(1, nb) / nb
         with warnings.catch_warnings():
             # inf sentinels make nanquantile warn on inf-inf interpolation
             warnings.simplefilter("ignore", RuntimeWarning)
@@ -79,7 +93,8 @@ class QuantileBinner:
 
         NaN inputs land in bin 0 (the missing bucket; see fit) — this
         deliberately diverges from ``np.searchsorted``, which sorts NaN
-        after every edge."""
+        after every edge. Under ``missing_bucket`` finite values land
+        in [1, n_bins) and bin 0 is EXACTLY the NaN set."""
         if self.edges is None:
             raise Mp4jError("binner is not fitted")
         X = np.asarray(X, np.float32)
@@ -93,21 +108,27 @@ class QuantileBinner:
         fb = self.edges.shape[0] * max(1, self.edges.shape[1])
         chunk = max(1, (64 << 20) // fb)
         edges_d = jnp.asarray(self.edges)
+        run = partial(_transform_device, shift=self.missing_bucket)
         if X.shape[0] <= chunk:
-            return np.asarray(_transform_device(jnp.asarray(X), edges_d))
+            return np.asarray(run(jnp.asarray(X), edges_d))
         out = np.empty(X.shape, np.int32)
         for s in range(0, X.shape[0], chunk):
             e = min(s + chunk, X.shape[0])
-            out[s:e] = np.asarray(
-                _transform_device(jnp.asarray(X[s:e]), edges_d))
+            out[s:e] = np.asarray(run(jnp.asarray(X[s:e]), edges_d))
         return out
 
     def fit_transform(self, X, **kw) -> np.ndarray:
         return self.fit(X, **kw).transform(X)
 
 
-@jax.jit
-def _transform_device(X, edges):
+@partial(jax.jit, static_argnames=("shift",))
+def _transform_device(X, edges, shift: bool = False):
     # bin = #edges <= x; comparison count instead of searchsorted keeps
-    # the op off the serial gather unit (see module docstring)
-    return (X[:, :, None] >= edges[None, :, :]).sum(-1, dtype=jnp.int32)
+    # the op off the serial gather unit (see module docstring). With
+    # ``shift`` (the reserved missing bucket), finite values move up to
+    # [1, B) and NaN — for which every comparison is False — stays the
+    # SOLE occupant of bin 0.
+    b = (X[:, :, None] >= edges[None, :, :]).sum(-1, dtype=jnp.int32)
+    if shift:
+        b = jnp.where(jnp.isnan(X), 0, b + 1)
+    return b

@@ -76,6 +76,21 @@ class GBDTConfig:
     # hold); "pair": feature-pair joint scatter histograms (exact in
     # f32, the differential oracle); "flat": one scatter per feature
     hist_mode: str = "pallas"
+    # Missing-value handling (ytk-learn routes missing by a learned
+    # per-split default direction): when True, bin 0 is the RESERVED
+    # missing bucket across all features (QuantileBinner(...,
+    # missing_bucket=True) emits this convention) and every split
+    # evaluates both "missing goes left" and "missing goes right",
+    # keeping the better gain; the chosen direction is stored per node
+    # and replayed at predict time.
+    missing_bin: bool = False
+    # Categorical features (ytk-learn's one-hot split type): listed
+    # feature indices split by EQUALITY — "bin == b goes right, rest
+    # left" — instead of the ordered "bin <= b" rule. Bin B-1 cannot be
+    # a split category (it doubles as the node-freeze sentinel); bin
+    # categorical values into [0, B-2] (and into [1, B-2] under
+    # missing_bin, where 0 is the missing bucket).
+    categorical_features: tuple = ()
 
     def __post_init__(self):
         # Mp4jError for ALL input validation, matching train() and the
@@ -96,6 +111,28 @@ class GBDTConfig:
             raise Mp4jError(
                 f"subsample/colsample must be in (0, 1], got "
                 f"{self.subsample}/{self.colsample}")
+        cats = []
+        for f in self.categorical_features:
+            if isinstance(f, bool) or not isinstance(f, (int, np.integer)):
+                raise Mp4jError(
+                    f"categorical_features must be int feature indices, "
+                    f"got {f!r}")
+            if not 0 <= f < self.n_features:
+                raise Mp4jError(
+                    f"categorical_features must be indices in [0, "
+                    f"{self.n_features}), got {f}")
+            cats.append(int(f))
+        object.__setattr__(self, "categorical_features", tuple(cats))
+
+    def _cat_mask(self) -> np.ndarray | None:
+        """Static [F] bool mask of equality-split features (None when
+        there are none — keeps the all-numeric compiled graph
+        unchanged)."""
+        if not self.categorical_features:
+            return None
+        m = np.zeros(self.n_features, bool)
+        m[list(self.categorical_features)] = True
+        return m
 
 
 # ----------------------------------------------------------------------
@@ -297,25 +334,50 @@ def _onehot_segment_sum2(val_a, val_b, seg_ids, n_segments: int):
     return out[0] + out[1], out[2] + out[3]         # [n_segments] f32 x2
 
 
-def _route_samples(bins, node_ids, feat, bin_, n_nodes: int):
-    """One level of sample routing: ``node_ids*2 + [bins[i, feat[n]] >
-    bin_[n]]`` via the exact one-hot selects. (A fused Pallas version
-    was measured 2x SLOWER — 13.3 vs 7.6 ms standalone at N=1M — a
-    kernel block of [tile, F] pins F=28 on the 128-wide lane dimension
-    at 22% occupancy, while XLA is free to lay the N axis across lanes
-    and to fuse the selects into neighboring passes.)"""
+def _route_samples(bins, node_ids, feat, bin_, n_nodes: int, dir_=None,
+                   cat_mask=None, missing_bin: bool = False,
+                   n_bins: int | None = None):
+    """One level of sample routing: ``node_ids*2 + go_right`` via the
+    exact one-hot selects, where ``go_right`` is ``bins[i, feat[n]] >
+    bin_[n]`` for numeric features, ``== bin_[n]`` for categorical ones
+    (never at the freeze sentinel B-1), and the node's learned default
+    direction ``dir_`` for the missing bucket (bin 0) under
+    ``missing_bin``. The all-numeric default compiles to exactly the
+    round-1 graph. (A fused Pallas version was measured 2x SLOWER —
+    13.3 vs 7.6 ms standalone at N=1M — a kernel block of [tile, F]
+    pins F=28 on the 128-wide lane dimension at 22% occupancy, while
+    XLA is free to lay the N axis across lanes and to fuse the selects
+    into neighboring passes.)"""
     nf = _onehot_select(feat, node_ids, n_nodes)
     nb = _onehot_select(bin_, node_ids, n_nodes)
     v = _onehot_row_select(bins, nf)
-    return node_ids * 2 + (v > nb).astype(jnp.int32)
+    go_right = v > nb
+    if missing_bin:
+        nd = _onehot_select(dir_, node_ids, n_nodes)
+        go_right = jnp.where(v == 0, nd > 0, go_right)
+    if cat_mask is not None:
+        # is this sample's node split on a categorical feature?
+        node_cat = jnp.asarray(cat_mask)[feat]        # [n_nodes] bool
+        sc = _onehot_select(node_cat.astype(jnp.int32), node_ids,
+                            n_nodes) > 0
+        go_right = jnp.where(sc, (v == nb) & (nb != n_bins - 1),
+                             go_right)
+    return node_ids * 2 + go_right.astype(jnp.int32)
 
 
 def best_splits(hist_g, hist_h, reg_lambda: float, feat_mask=None,
-                min_child_hessian: float = 0.0):
+                min_child_hessian: float = 0.0, cat_mask=None,
+                missing_bin: bool = False):
     """Regularized best split per node.
 
     hist_*: [n_nodes, F, B]. Returns (feat [n_nodes], bin [n_nodes],
-    gain [n_nodes]) — the split "bin <= b goes left". ``feat_mask``
+    gain [n_nodes], dir [n_nodes]) — numeric features split "bin <= b
+    goes left"; features flagged in ``cat_mask`` ([F] bool, optional)
+    split "bin == b goes right". ``dir`` is the learned default
+    direction for the missing bucket (1 = right; all zeros unless
+    ``missing_bin``): with ``missing_bin`` every numeric candidate is
+    scored with bin 0's G/H on the left AND on the right, and the
+    better variant wins — ytk-learn's sparsity-aware split. ``feat_mask``
     ([F] bool, optional) disqualifies masked-out features (column
     sampling): their gain is -inf so they can never win; candidates
     whose left or right hessian sum < ``min_child_hessian`` are
@@ -326,23 +388,58 @@ def best_splits(hist_g, hist_h, reg_lambda: float, feat_mask=None,
     Gt = cg[..., -1:]
     Ht = ch[..., -1:]
     lam = reg_lambda
+    mch = min_child_hessian
 
     def score(G, H):
         return (G * G) / (H + lam)
 
-    gain = score(cg, ch) + score(Gt - cg, Ht - ch) - score(Gt, Ht)
-    # splitting at the last bin sends everything left — not a split
+    def variant_gain(GL, HL):
+        """Gain of a (left, right) partition given the left sums.
+
+        A 0/0 score (empty child at reg_lambda == 0) is NaN; it must be
+        disqualified HERE, per variant — NaN would propagate through the
+        jnp.maximum combining missing-left/right variants (killing a
+        valid sibling variant) and would win jnp.argmax (freezing a node
+        with good splits elsewhere). The numpy oracle's ``gain > best``
+        ignores NaN the same way; an all-degenerate node still freezes
+        via gain = -inf."""
+        g = score(GL, HL) + score(Gt - GL, Ht - HL) - score(Gt, Ht)
+        if mch > 0.0:
+            ok = (HL >= mch) & (Ht - HL >= mch)
+            g = jnp.where(ok, g, -jnp.inf)
+        return jnp.where(jnp.isnan(g), -jnp.inf, g)
+
+    gain = variant_gain(cg, ch)             # missing (bin 0) left
+    direction = jnp.zeros(gain.shape, bool)
+    if missing_bin:
+        # move bin 0 (the reserved missing bucket) to the right child
+        gain_r = variant_gain(cg - hist_g[..., :1], ch - hist_h[..., :1])
+        # at b=0 the right-variant's left child is empty BY CONSTRUCTION
+        # (bin 0 moved right leaves nothing <= 0): never a split, and at
+        # reg_lambda=0 its 0/0 NaN would otherwise win argmax in EVERY
+        # node and freeze the whole tree
+        gain_r = gain_r.at[..., 0].set(-jnp.inf)
+        direction = gain_r > gain
+        gain = jnp.maximum(gain, gain_r)
+    if cat_mask is not None:
+        # equality split: category b alone goes right
+        cat_gain = variant_gain(Gt - hist_g, Ht - hist_h)
+        cat = jnp.asarray(cat_mask)[None, :, None]
+        gain = jnp.where(cat, cat_gain, gain)
+        direction = jnp.where(cat, False, direction)
+    # splitting at the last bin sends everything left (numeric) /
+    # doubles as the freeze sentinel (categorical) — never a candidate
     gain = gain.at[..., -1].set(-jnp.inf)
     if feat_mask is not None:
         gain = jnp.where(feat_mask[None, :, None], gain, -jnp.inf)
-    if min_child_hessian > 0.0:
-        ok = (ch >= min_child_hessian) & (Ht - ch >= min_child_hessian)
-        gain = jnp.where(ok, gain, -jnp.inf)
     flat = gain.reshape(gain.shape[0], -1)
     best = jnp.argmax(flat, axis=-1)
     B = hist_g.shape[-1]
+    dir_flat = direction.reshape(direction.shape[0], -1)
+    best_dir = jnp.take_along_axis(dir_flat, best[:, None], axis=-1)[:, 0]
     return ((best // B).astype(jnp.int32), (best % B).astype(jnp.int32),
-            jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0])
+            jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0],
+            best_dir.astype(jnp.int32))
 
 
 # ----------------------------------------------------------------------
@@ -359,6 +456,8 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
     n_internal = 2 ** cfg.depth - 1
     tree_feat = jnp.zeros((n_internal,), dtype=jnp.int32)
     tree_bin = jnp.zeros((n_internal,), dtype=jnp.int32)
+    tree_dir = jnp.zeros((n_internal,), dtype=jnp.int32)
+    cat_mask = cfg._cat_mask()
 
     def reduced_histograms(ids, n):
         """Local histogram build + the distributed allreduce (psum)."""
@@ -399,10 +498,12 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
             hh = jnp.stack([hl_h, jnp.maximum(prev_hh - hl_h, 0.0)],
                            axis=1).reshape(n_nodes, *hl_h.shape[1:])
         prev_hg, prev_hh = hg, hh
-        feat, bin_, gain = best_splits(hg, hh, cfg.reg_lambda, feat_mask,
-                                       cfg.min_child_hessian)
+        feat, bin_, gain, dir_ = best_splits(
+            hg, hh, cfg.reg_lambda, feat_mask, cfg.min_child_hessian,
+            cat_mask, cfg.missing_bin)
         # freeze any node whose best gain does not clear the threshold:
-        # bin B-1 routes every sample left (v > B-1 is never true),
+        # bin B-1 routes every sample left (v > B-1 is never true for
+        # numeric, and categorical routing never goes right at B-1),
         # keeping the node whole. The ~(gain > thr) form also freezes
         # gain == 0 (empty/pure nodes would otherwise record a phantom
         # feat-0 "split", poisoning feature_importance), gain == -inf
@@ -410,11 +511,15 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
         # everything), and NaN gains (0/0 at reg_lambda == 0).
         freeze = ~(gain > cfg.min_split_gain)
         bin_ = jnp.where(freeze, cfg.n_bins - 1, bin_)
+        dir_ = jnp.where(freeze, 0, dir_)   # frozen: missing stays left
         tree_feat = lax.dynamic_update_slice(tree_feat, feat, (level_start,))
         tree_bin = lax.dynamic_update_slice(tree_bin, bin_, (level_start,))
+        tree_dir = lax.dynamic_update_slice(tree_dir, dir_, (level_start,))
         # route samples: go right if bin value > split bin (gather-free,
         # see the routing performance note above)
-        node_ids = _route_samples(bins, node_ids, feat, bin_, n_nodes)
+        node_ids = _route_samples(bins, node_ids, feat, bin_, n_nodes,
+                                  dir_, cat_mask, cfg.missing_bin,
+                                  cfg.n_bins)
         level_start += n_nodes
 
     # leaf values from (all-reduced) leaf G/H
@@ -426,7 +531,7 @@ def _build_tree(bins, g, h, cfg: GBDTConfig, axis_name, interpret,
     leaf_val = -leaf_g / (leaf_h + cfg.reg_lambda)
     delta = cfg.learning_rate * _onehot_select(leaf_val, node_ids,
                                                n_leaves)
-    return delta, (tree_feat, tree_bin, leaf_val)
+    return delta, (tree_feat, tree_bin, tree_dir, leaf_val)
 
 
 def _sampling_masks(rng_key, cfg: GBDTConfig, N: int, axis_name):
@@ -521,7 +626,8 @@ def train_tree_shard(bins, y, preds, cfg: GBDTConfig, axis_name=None,
 
 def predict_tree(bins, tree, cfg: GBDTConfig):
     """Route samples through one tree (level-order heap layout)."""
-    tree_feat, tree_bin, leaf_val = tree
+    tree_feat, tree_bin, tree_dir, leaf_val = tree
+    cat_mask = cfg._cat_mask()
     N = bins.shape[0]
     node = jnp.zeros((N,), dtype=jnp.int32)   # level-local node index
     level_start = 0
@@ -530,7 +636,10 @@ def predict_tree(bins, tree, cfg: GBDTConfig):
         level_feat = lax.dynamic_slice(tree_feat, (level_start,),
                                        (n_nodes,))
         level_bin = lax.dynamic_slice(tree_bin, (level_start,), (n_nodes,))
-        node = _route_samples(bins, node, level_feat, level_bin, n_nodes)
+        level_dir = lax.dynamic_slice(tree_dir, (level_start,), (n_nodes,))
+        node = _route_samples(bins, node, level_feat, level_bin, n_nodes,
+                              level_dir, cat_mask, cfg.missing_bin,
+                              cfg.n_bins)
         level_start += n_nodes
     return _onehot_select(leaf_val, node, 2 ** cfg.depth)
 
@@ -771,7 +880,7 @@ class GBDTTrainer(DataParallelTrainer):
         for round_trees in trees:
             per_class = (round_trees if self.cfg.loss == "softmax"
                          else (round_trees,))
-            for tf, tb, _ in per_class:
+            for tf, tb, _td, _lv in per_class:
                 real = np.asarray(tb) != self.cfg.n_bins - 1
                 np.add.at(counts, np.asarray(tf)[real], 1)
         total = counts.sum()
@@ -788,12 +897,14 @@ class GBDTTrainer(DataParallelTrainer):
         for i, round_trees in enumerate(trees):
             per_class = (round_trees if self.cfg.loss == "softmax"
                          else (round_trees,))
-            for c, (tf, tb, lv) in enumerate(per_class):
+            for c, (tf, tb, td, lv) in enumerate(per_class):
                 arrays[f"feat_{i}_{c}"] = np.asarray(tf)
                 arrays[f"bin_{i}_{c}"] = np.asarray(tb)
+                arrays[f"dir_{i}_{c}"] = np.asarray(td)
                 arrays[f"leaf_{i}_{c}"] = np.asarray(lv)
         if binner is not None and binner.edges is not None:
             arrays["bin_edges"] = binner.edges
+            arrays["bin_missing"] = np.bool_(binner.missing_bucket)
         save_npz(path, self.cfg, arrays)
 
     @staticmethod
@@ -806,8 +917,13 @@ class GBDTTrainer(DataParallelTrainer):
         C = cfg.n_classes if cfg.loss == "softmax" else 1
 
         def tree(i, c):
-            return (z[f"feat_{i}_{c}"], z[f"bin_{i}_{c}"],
-                    z[f"leaf_{i}_{c}"])
+            tf = z[f"feat_{i}_{c}"]
+            # models saved before default-direction support have no dir
+            # arrays; all-left (0) IS their training-time behavior
+            td = z.get(f"dir_{i}_{c}")
+            if td is None:
+                td = np.zeros_like(tf)
+            return (tf, z[f"bin_{i}_{c}"], td, z[f"leaf_{i}_{c}"])
 
         if cfg.loss == "softmax":
             trees = [tuple(tree(i, c) for c in range(C))
@@ -818,8 +934,10 @@ class GBDTTrainer(DataParallelTrainer):
         if "bin_edges" in z:
             # binning granularity may differ from cfg.n_bins (a
             # coarser binner feeding a finer histogram is legal);
-            # derive it from the saved edges
+            # derive it from the saved edges + missing-bucket flag
             edges = z["bin_edges"]
-            binner = QuantileBinner(edges.shape[1] + 1)
+            mb = bool(z.get("bin_missing", False))
+            binner = QuantileBinner(edges.shape[1] + (2 if mb else 1),
+                                    missing_bucket=mb)
             binner.edges = edges
         return cfg, trees, binner
