@@ -160,18 +160,29 @@ def check_gbdt(results: dict, devices, n: int, per: int = 8192):
     flat mesh and on the hierarchical inter x intra mesh."""
     from ytk_mp4j_tpu.models.gbdt import GBDTConfig, GBDTTrainer
 
-    cfg = GBDTConfig(n_features=28, n_bins=256, depth=6)
     kd = jax.eval_shape(lambda: jax.random.key_data(jax.random.key(0)))
     meshes = {"flat": Mesh(np.asarray(devices[:n]), (AXIS,))}
     if n % 2 == 0:
         meshes["hier"] = Mesh(
             np.asarray(devices[:n]).reshape(n // 2, 2), ("inter", "intra"))
+    cfgs = {
+        "": GBDTConfig(n_features=28, n_bins=256, depth=6),
+        # the data-handling graph: learned missing direction +
+        # categorical equality splits
+        "_missing_cat": GBDTConfig(n_features=28, n_bins=256, depth=6,
+                                   missing_bin=True,
+                                   categorical_features=(3, 17)),
+    }
     for label, mesh in meshes.items():
-        tr = GBDTTrainer(cfg, mesh=mesh)
-        _compile(f"gbdt/train_step_{label}", results, tr._build_step(),
-                 _i32(n, per, cfg.n_features), _f32(n, per), _f32(n, per),
-                 _f32(n, per),
-                 jax.ShapeDtypeStruct(kd.shape, kd.dtype))
+        for suffix, cfg in cfgs.items():
+            if suffix and label != "flat":
+                continue            # one topology proof is enough
+            tr = GBDTTrainer(cfg, mesh=mesh)
+            _compile(f"gbdt/train_step_{label}{suffix}", results,
+                     tr._build_step(),
+                     _i32(n, per, cfg.n_features), _f32(n, per),
+                     _f32(n, per), _f32(n, per),
+                     jax.ShapeDtypeStruct(kd.shape, kd.dtype))
 
 
 def check_ffm(results: dict, devices, n: int, per: int = 1024):

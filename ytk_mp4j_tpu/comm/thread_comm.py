@@ -153,11 +153,59 @@ class ThreadCommSlave(CommSlave):
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
-    def _fan_in_out(self, deposit, leader, collect):
+    @staticmethod
+    def _detach(buf):
+        """Copy a deposited slot out of the caller's buffer (slots hold
+        VIEWS of caller arrays until first write; merging in place
+        would corrupt a sibling thread's input)."""
+        return buf.copy() if isinstance(buf, np.ndarray) else list(buf)
+
+    def _tree_reduce_slots(self, operator: Operator) -> None:
+        """Pairwise-parallel intra-process reduction of the deposited
+        slots into thread 0's slot: round k merges ``slot[t + k]`` into
+        ``slot[t]`` for ``t % 2k == 0``, every eligible thread merging
+        CONCURRENTLY (numpy's reduce loops release the GIL), so the
+        intra-process reduce runs O(log T) rounds instead of the old
+        leader-serial O(T) loop — the reference's simple pattern, but
+        scalable past a handful of threads. Must be called by EVERY
+        thread between deposit and the leader phase (each round ends on
+        the shared barrier; all threads run the same barrier count).
+        Thread 0's slot ends DETACHED from its input view, like the
+        leader's copy did.
+
+        Memory: round 1 detaches up to ceil(T/2) slots concurrently
+        (the old serial leader held ONE working copy), so transient RSS
+        for a [L] collective is ~T/2 x L elements — the price of the
+        parallel merge; size thread groups accordingly on memory-tight
+        hosts."""
+        slots = self._g.slots
+        T = self._g.thread_num
+        tr = self._tr
+        detached = False
+        if tr == 0:
+            slots[0] = self._detach(slots[0])
+            detached = True
+        k = 1
+        while k < T:
+            if tr % (2 * k) == 0 and tr + k < T:
+                acc = slots[tr]
+                if not detached:
+                    acc = self._detach(acc)
+                    detached = True
+                self._merge_into(operator, acc, slots[tr + k])
+                slots[tr] = acc
+            self.thread_barrier()
+            k *= 2
+
+    def _fan_in_out(self, deposit, leader, collect, tree_operator=None):
         """The hybrid pattern: all threads deposit, thread 0 runs
-        ``leader`` (merging + process collective), all threads collect."""
+        ``leader`` (merging + process collective), all threads collect.
+        With ``tree_operator`` the deposits are pre-reduced into slot 0
+        by the pairwise tree above and ``leader`` gets merged slots."""
         self._g.slots[self._tr] = deposit()
         self.thread_barrier()
+        if tree_operator is not None:
+            self._tree_reduce_slots(tree_operator)
         if self._tr == 0:
             self._g.result = leader(self._g.slots)
         self.thread_barrier()
@@ -214,12 +262,7 @@ class ThreadCommSlave(CommSlave):
             return arr[lo:hi]
 
         def leader(slots):
-            if isinstance(slots[0], np.ndarray):
-                acc = slots[0].copy()
-            else:
-                acc = list(slots[0])
-            for s in slots[1:]:
-                self._merge_into(operator, acc, s)
+            acc = slots[0]          # tree-merged, detached
             if self._g.proc is not None:
                 self._g.proc.allreduce_array(acc, operand, operator,
                                              algo=algo)
@@ -229,7 +272,8 @@ class ThreadCommSlave(CommSlave):
             arr[lo:hi] = result
             return arr
 
-        return self._fan_in_out(deposit, leader, collect)
+        return self._fan_in_out(deposit, leader, collect,
+                                tree_operator=operator)
 
     def reduce_array(self, arr, operand: Operand = Operands.FLOAT,
                      operator: Operator = Operators.SUM, root: int = 0,
@@ -242,12 +286,7 @@ class ThreadCommSlave(CommSlave):
             return arr[lo:hi]
 
         def leader(slots):
-            if isinstance(slots[0], np.ndarray):
-                acc = slots[0].copy()
-            else:
-                acc = list(slots[0])
-            for s in slots[1:]:
-                self._merge_into(operator, acc, s)
+            acc = slots[0]          # tree-merged, detached
             if self._g.proc is not None:
                 self._g.proc.reduce_array(acc, operand, operator,
                                           root=root_proc)
@@ -259,7 +298,8 @@ class ThreadCommSlave(CommSlave):
                 arr[lo:hi] = result
             return arr
 
-        return self._fan_in_out(deposit, leader, collect)
+        return self._fan_in_out(deposit, leader, collect,
+                                tree_operator=operator)
 
     def broadcast_array(self, arr, operand: Operand = Operands.FLOAT,
                         root: int = 0, from_: int = 0,
@@ -273,18 +313,9 @@ class ThreadCommSlave(CommSlave):
             return arr[lo:hi]
 
         def leader(slots):
-            if self._g.proc_rank == root_proc:
-                buf = slots[root_thread]
-                if isinstance(buf, np.ndarray):
-                    buf = buf.copy()
-                else:
-                    buf = list(buf)
-            else:
-                buf = slots[0]
-                if isinstance(buf, np.ndarray):
-                    buf = buf.copy()
-                else:
-                    buf = list(buf)
+            buf = self._detach(slots[root_thread]
+                               if self._g.proc_rank == root_proc
+                               else slots[0])
             if self._g.proc is not None:
                 self._g.proc.broadcast_array(buf, operand, root=root_proc)
             return buf
@@ -407,12 +438,7 @@ class ThreadCommSlave(CommSlave):
             return arr
 
         def leader(slots):
-            if isinstance(slots[0], np.ndarray):
-                acc = slots[0].copy()
-            else:
-                acc = list(slots[0])
-            for s in slots[1:]:
-                self._merge_into(operator, acc, s)
+            acc = slots[0]          # tree-merged, detached
             if self._g.proc is not None:
                 self._g.proc.reduce_scatter_array(
                     acc, operand, operator,
@@ -424,7 +450,8 @@ class ThreadCommSlave(CommSlave):
             arr[s:e] = result[s:e]
             return arr
 
-        return self._fan_in_out(deposit, leader, collect)
+        return self._fan_in_out(deposit, leader, collect,
+                                tree_operator=operator)
 
     # ------------------------------------------------------------------
     # map collectives

@@ -31,8 +31,11 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 class QuantileBinner:
     """Per-feature quantile binning into ``n_bins`` buckets.
 
-    fit: edges[f, j] = the (j+1)/B quantile of feature f (B-1 internal
-    edges). transform: bin = number of edges <= x, in [0, B).
+    fit: edges[f, j] = the (j+1)/Q quantile of feature f over Q-1
+    internal edges, where Q = n_bins normally and Q = n_bins - 1 under
+    ``missing_bucket`` (one bucket is reserved, see below).
+    transform: bin = number of edges <= x — in [0, n_bins) normally,
+    shifted to [1, n_bins) under ``missing_bucket``.
 
     ``missing_bucket=True`` RESERVES bin 0 for missing values: finite
     values bin into [1, B) over B-2 internal edges and NaN maps to

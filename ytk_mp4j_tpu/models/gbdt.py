@@ -158,6 +158,15 @@ class GBDTConfig:
 # mantissa bit-masking: written as a - f32(bf16(a)), XLA's algebraic
 # simplifier folds the convert pair and the low part silently becomes
 # zero (measured: identical error to plain bf16).
+#
+# The per-level full-N scan is the measured optimum, not an oversight
+# (round-2 pricing on v5e at N=1M, see BASELINE.md): active-sample
+# compaction (scan only the ~N/2 left-child rows below the root) costs
+# argsort 25 ms + row/vector gathers 62/46 ms per level on the serial
+# unit against ~21 ms of histogram saved; leaf-wise growth needs the
+# same gathers; int8 one-hot/accumulation and narrower A operands are
+# within noise of bf16 because the one-hot GENERATION (a VPU compare
+# per (sample, feature, bin)) — not the matmul — is the floor.
 # ----------------------------------------------------------------------
 _MATMUL_TILE = 1024  # contraction tile; OH tile = tile*F*B*2 bytes in VMEM
 

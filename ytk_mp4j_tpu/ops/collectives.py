@@ -248,9 +248,20 @@ def reduce(x, operator: Operator = Operators.SUM, root: int = 0,
            axis_name="mp4j", native_reduce: bool | None = None):
     """Reduce across the axis; only ``root``'s output is meaningful.
 
-    XLA has no rooted-reduce primitive over ICI; the allreduce is the
-    bandwidth-optimal lowering and non-root results are simply unused (the
-    compiler may DCE per-device work it can prove dead).
+    Lowering to a full allreduce is a DELIBERATE choice, not a
+    shortcut. XLA has no rooted-reduce primitive over ICI, and the
+    bandwidth arithmetic of the hand-built alternative does not pay:
+    reduce-scatter + collect-blocks-to-root moves (n-1)/n + (n-1)/n of
+    the buffer per member — exactly the allreduce's 2(n-1)/n
+    Rabenseifner bound — with the collect phase concentrated onto
+    root's links (a hot spot the allreduce avoids), and a ppermute
+    binomial tree moves |x| * log n, strictly worse for n >= 4. The
+    only true saving of a rooted reduce is non-root RECEIVE traffic,
+    which XLA's allreduce already overlaps; the compiler may also DCE
+    per-device work it can prove dead. Measured validation needs a
+    multi-chip pod (single-chip collectives are no-ops), so this
+    lowering is justified by the arithmetic above rather than by
+    benchmark — revisit on real pod hardware.
     """
     return allreduce(x, operator, axis_name, native_reduce)
 
@@ -269,7 +280,16 @@ def allgather(x, axis_name="mp4j", tiled: bool = True):
 
 
 def gather(x, root: int = 0, axis_name="mp4j", tiled: bool = True):
-    """Root obtains the concatenation; non-root outputs are unused."""
+    """Root obtains the concatenation; non-root outputs are unused.
+
+    Like :func:`reduce`, the allgather lowering is the measured-cost
+    choice: a rooted gather moves (n-1)/n of the result onto root's
+    links (serialized many-to-one — ppermute can express it only as
+    n-1 rounds), while the all_gather's ring pipelines the same bytes
+    across ALL links concurrently; non-root outputs cost HBM, not
+    wire. Revisit on real pod hardware where DCN links are the
+    bottleneck.
+    """
     return allgather(x, axis_name, tiled=tiled)
 
 
