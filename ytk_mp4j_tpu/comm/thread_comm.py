@@ -402,18 +402,9 @@ class ThreadCommSlave(CommSlave):
             return arr
 
         def leader(slots):
-            if self._g.proc_rank == root_proc:
-                full = slots[root_thread]
-                if isinstance(full, np.ndarray):
-                    full = full.copy()
-                else:
-                    full = list(full)
-            else:
-                full = slots[0]
-                if isinstance(full, np.ndarray):
-                    full = full.copy()
-                else:
-                    full = list(full)
+            full = self._detach(slots[root_thread]
+                                if self._g.proc_rank == root_proc
+                                else slots[0])
             if self._g.proc is not None:
                 self._g.proc.scatter_array(
                     full, operand, root=root_proc,
