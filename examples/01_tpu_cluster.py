@@ -38,9 +38,13 @@ with trace_collectives():
     maps = [{f"w:{r % 3}": np.ones(4, np.float32) * r} for r in range(n)]
     cluster.allreduce_map(maps, Operands.FLOAT, Operators.SUM)
 
-    # user-defined operator
+    # user-defined operator: on the DEVICE path the reduction runs
+    # inside jit, so write it with jnp (jnp also works on host numpy
+    # inputs; an np-only fn would fail to trace on multi-device meshes)
+    import jax.numpy as jnp
     absmax = Operator.custom(
-        "ABSMAX", lambda x, y: np.where(np.abs(x) >= np.abs(y), x, y), 0.0)
+        "ABSMAX",
+        lambda x, y: jnp.where(jnp.abs(x) >= jnp.abs(y), x, y), 0.0)
     # (64-bit operands need jax_enable_x64 on the device path)
     arrs = [np.full(8, float(r - 1), np.float32) for r in range(n)]
     cluster.allreduce_array(arrs, Operands.FLOAT, absmax)

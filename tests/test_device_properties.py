@@ -1,0 +1,128 @@
+"""Hypothesis fuzz of the DEVICE cluster path — the analogue of
+tests/test_socket_properties.py for TpuCommCluster: random lengths,
+values, operators, dtypes, sub-ranges and algorithms against the numpy
+oracle on the virtual 8-device mesh.
+
+Lengths draw from a small fixed pool so the jit cache amortizes
+compiles across examples (a fresh shape per example would make every
+case a full XLA compile)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+from helpers import expected_reduce
+
+LENGTHS = (1, 7, 16, 33)
+OPS = ("SUM", "MAX", "MIN", "PROD")
+ALGOS = ("xla", "ring", "rdma")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return TpuCommCluster()
+
+
+def _inputs(n, length, operand, seed):
+    rng = np.random.default_rng(seed)
+    if operand.dtype.kind == "f":
+        return [rng.standard_normal(length).astype(operand.dtype)
+                for _ in range(n)]
+    return [rng.integers(1, 4, length).astype(operand.dtype)
+            for _ in range(n)]
+
+
+def _tol(operand):
+    # ring/rdma merge sequentially; float association differs
+    return dict(rtol=2e-5, atol=1e-5) if operand.dtype.kind == "f" \
+        else dict(rtol=0, atol=0)
+
+
+@settings(max_examples=40, deadline=None)
+@given(length=st.sampled_from(LENGTHS),
+       op_name=st.sampled_from(OPS),
+       algo=st.sampled_from(ALGOS),
+       operand=st.sampled_from((Operands.FLOAT, Operands.DOUBLE,
+                                Operands.INT)),
+       seed=st.integers(0, 2 ** 16))
+def test_allreduce_fuzz(cluster, length, op_name, algo, operand, seed):
+    arrs = _inputs(cluster.n, length, operand, seed)
+    want = expected_reduce(arrs, op_name)
+    cluster.allreduce_array(arrs, operand, Operators.by_name(op_name),
+                            algo=algo)
+    for a in arrs:
+        np.testing.assert_allclose(a, want, **_tol(operand))
+
+
+@settings(max_examples=25, deadline=None)
+@given(length=st.sampled_from(LENGTHS),
+       op_name=st.sampled_from(OPS),
+       algo=st.sampled_from(ALGOS),
+       seed=st.integers(0, 2 ** 16))
+def test_reduce_scatter_fuzz(cluster, length, op_name, algo, seed):
+    operand = Operands.DOUBLE
+    arrs = _inputs(cluster.n, length, operand, seed)
+    want = expected_reduce(arrs, op_name)
+    orig = [a.copy() for a in arrs]
+    cluster.reduce_scatter_array(arrs, operand,
+                                 Operators.by_name(op_name), algo=algo)
+    for r, (s, e) in enumerate(meta.partition_range(0, length,
+                                                    cluster.n)):
+        np.testing.assert_allclose(arrs[r][s:e], want[s:e],
+                                   rtol=1e-9, atol=1e-12)
+        mask = np.ones(length, bool)
+        mask[s:e] = False
+        np.testing.assert_array_equal(arrs[r][mask], orig[r][mask])
+
+
+@settings(max_examples=20, deadline=None)
+@given(length=st.sampled_from(LENGTHS),
+       sub=st.booleans(),
+       seed=st.integers(0, 2 ** 16))
+def test_subrange_fuzz(cluster, length, sub, seed):
+    """Sub-ranges leave the outside untouched for every algo."""
+    operand = Operands.DOUBLE
+    rng = np.random.default_rng(seed)
+    lo, hi = (0, length)
+    if sub and length > 2:
+        lo = int(rng.integers(0, length - 1))
+        hi = int(rng.integers(lo + 1, length + 1))
+    base = _inputs(cluster.n, length, operand, seed)
+    want = expected_reduce([a[lo:hi] for a in base], "SUM")
+    for algo in ALGOS:
+        arrs = [a.copy() for a in base]
+        cluster.allreduce_array(arrs, operand, Operators.SUM,
+                                from_=lo, to=hi, algo=algo)
+        for a, o in zip(arrs, base):
+            np.testing.assert_allclose(a[lo:hi], want, rtol=1e-9)
+            np.testing.assert_array_equal(a[:lo], o[:lo])
+            np.testing.assert_array_equal(a[hi:], o[hi:])
+
+
+@settings(max_examples=15, deadline=None)
+@given(n_keys=st.integers(0, 30),
+       overlap=st.floats(0.0, 1.0),
+       op_name=st.sampled_from(("SUM", "MAX")),
+       seed=st.integers(0, 2 ** 16))
+def test_map_allreduce_fuzz(cluster, n_keys, overlap, op_name, seed):
+    rng = np.random.default_rng(seed)
+    pool = max(1, int(n_keys / max(overlap, 1e-3)))
+    maps = []
+    for _ in range(cluster.n):
+        ks = rng.choice(pool, size=min(n_keys, pool), replace=False)
+        maps.append({f"k{k}": float(rng.standard_normal()) for k in ks})
+    op = Operators.by_name(op_name)
+    want: dict = {}
+    for m in maps:
+        for k, v in m.items():
+            want[k] = op.np_fn(want[k], v) if k in want else v
+    cluster.allreduce_map(maps, Operands.DOUBLE, op)
+    for m in maps:
+        assert set(m) == set(want)
+        for k in want:
+            np.testing.assert_allclose(m[k], want[k], rtol=1e-12)

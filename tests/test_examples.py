@@ -1,0 +1,30 @@
+"""Every shipped example must actually run on the virtual 8-device pod.
+
+Examples are documentation that executes; letting them rot is worse
+than not having them (this file exists because example 01's custom
+operator used host-only np functions, which only ever worked on
+single-device runs where the device tree-reduce is a no-op)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = sorted((Path(__file__).parents[1] / "examples").glob("*.py"))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(path):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    prog = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "jax.config.update('jax_enable_x64', True); "
+        f"exec(open({str(path)!r}).read())"
+    )
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=280,
+                       cwd=str(path.parents[1]))
+    assert r.returncode == 0, (path.name, r.stderr[-2000:])

@@ -64,13 +64,16 @@ def test_distributed_matches_single_device(model, rng):
 
 
 @pytest.mark.parametrize("model", ["fm", "ffm"])
-def test_sparse_grads_match_dense(model, rng):
+@pytest.mark.parametrize("l2", [0.0, 1e-3])
+def test_sparse_grads_match_dense(model, l2, rng):
     """The sparse (row, grad) allreduce must produce the same updates as
     the dense psum — the TPU translation of the reference's sparse map
-    path vs its dense array path."""
+    path vs its dense array path. l2 != 0 exercises the sparse path's
+    multiplicative-decay-plus-scatter form of the regularized update
+    against the dense path's V - lr*(gV/denom + l2*V)."""
     feats, fields, vals, y = make_sparse_classification(rng, n=96)
     cfg = FMConfig(n_features=64, n_fields=4, k=4, max_nnz=4, model=model,
-                   learning_rate=0.3, init_scale=0.1)
+                   learning_rate=0.3, init_scale=0.1, l2=l2)
     dense = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=False)
     pdense, _ = dense.fit(feats, fields, vals, y, n_steps=10, seed=3)
     sparse = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=True)
