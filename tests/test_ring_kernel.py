@@ -326,3 +326,25 @@ def test_without_credits_adversary_overwrites_slot():
         m.run(data)
         hits += m.violations
     assert hits > 0
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("L", [7, 32])
+@pytest.mark.parametrize("op_name", ["SUM", "MAX"])
+def test_bidirectional_allreduce(rng, n, L, op_name):
+    """The bidirectional ring (both halves in opposite directions)
+    must agree with the unidirectional one and the oracle."""
+    data = rng.standard_normal((n, L)).astype(np.float32)
+    mesh = make_mesh(n)
+    op = Operators.by_name(op_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P("mp4j"), check_vma=False)
+    def f(x):
+        return ring_allreduce_kernel(x[0], op, "mp4j", interpret=True,
+                                     bidirectional=True)[None]
+
+    out = np.asarray(jax.jit(f)(jnp.asarray(data)))
+    want = OPS[op_name](data, axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-6)

@@ -123,6 +123,12 @@ def check_rings(results: dict, mesh: Mesh, n: int, L: int = 8192):
                          x[0], _op, AXIS)[None],
                      P(AXIS), P(AXIS)),
                  _f32(n, L))
+    _compile("ring/rdma_allreduce_bidir", results,
+             _shard_mapped(
+                 mesh, lambda x: ring_kernel.ring_allreduce_kernel(
+                     x[0], Operators.SUM, AXIS, bidirectional=True)[None],
+                 P(AXIS), P(AXIS)),
+             _f32(n, L))
     # unpadded length: exercises the internal identity padding
     _compile("ring/rdma_allreduce_unaligned", results,
              _shard_mapped(

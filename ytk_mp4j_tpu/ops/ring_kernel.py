@@ -91,6 +91,69 @@ def round_up_chunk(n_elems: int, dtype, interpret: bool = False) -> int:
     return -(-max(n_elems, 1) // g) * g
 
 
+def _neighbor_barrier(left, right):
+    """Block until both ring neighbors entered the kernel: remote DMA
+    may not target a device still outside its pallas_call (Mosaic
+    requires the collective_id barrier semaphore for this)."""
+    bar = pltpu.get_barrier_semaphore()
+    for nb in (left, right):
+        pltpu.semaphore_signal(
+            bar, inc=1, device_id=nb,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bar, 2)
+
+
+def _direction(sbuf, rbuf, send_sem, recv_sem, credit_sem, dst,
+               credit_to, use_credits):
+    """ONE direction's slot/DMA/credit protocol (the unit the host-side
+    property model verifies). Returns (begin, finish, drain):
+
+    - ``begin(g, value)`` waits the slot-free credit (reuse only — the
+      buffer starts free), stages the send, starts the DMA toward
+      ``dst``, and returns the in-flight descriptor;
+    - ``finish(g, rdma)`` waits it, reads the receive slot, and signals
+      the slot-free credit to ``credit_to`` (the upstream sender whose
+      copy we just consumed);
+    - ``drain(steps)`` absorbs the final credit per used slot so every
+      semaphore exits at zero.
+
+    The begin/finish split lets the bidirectional kernel start both
+    directions' DMAs before waiting on either."""
+    def begin(g, value):
+        slot = g % 2
+        if use_credits and g >= 2:
+            # slot reuse: the downstream must have consumed its copy
+            pltpu.semaphore_wait(credit_sem.at[slot], 1)
+        sbuf[slot] = value
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=sbuf.at[slot],
+            dst_ref=rbuf.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        return rdma
+
+    def finish(g, rdma):
+        rdma.wait()
+        slot = g % 2
+        got = rbuf[slot]
+        if use_credits:
+            pltpu.semaphore_signal(
+                credit_sem.at[slot], inc=1, device_id=credit_to,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return got
+
+    def drain(steps):
+        if use_credits:
+            for slot in range(min(2, steps)):
+                pltpu.semaphore_wait(credit_sem.at[slot], 1)
+
+    return begin, finish, drain
+
+
 def _ring_kernel(x_ref, out_ref, sbuf, rbuf, send_sem, recv_sem,
                  credit_sem, *, n, rows, axis_name, mode, op_fn,
                  use_credits, use_barrier):
@@ -99,42 +162,16 @@ def _ring_kernel(x_ref, out_ref, sbuf, rbuf, send_sem, recv_sem,
     left = jnp.mod(me - 1, n)
 
     if use_barrier:
-        # remote DMA may not target a device still outside its
-        # pallas_call: handshake with both ring neighbors first (Mosaic
-        # requires the collective_id barrier semaphore for this)
-        bar = pltpu.get_barrier_semaphore()
-        for nb in (left, right):
-            pltpu.semaphore_signal(
-                bar, inc=1, device_id=nb,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_wait(bar, 2)
+        _neighbor_barrier(left, right)
+
+    # clockwise: send right, consume what the LEFT neighbor sent, so
+    # the slot-free credit goes back to the left
+    begin, finish, drain = _direction(sbuf, rbuf, send_sem, recv_sem,
+                                      credit_sem, right, left,
+                                      use_credits)
 
     def exchange(g, value):
-        """Global step g: send ``value`` right, return what arrived from
-        the left. Credit flow: wait for the right neighbor's
-        slot-free credit before reusing a slot (first use exempt);
-        after consuming our own receive slot, credit the left."""
-        slot = g % 2
-        if use_credits and g >= 2:
-            # slot reuse: right must have consumed its copy
-            pltpu.semaphore_wait(credit_sem.at[slot], 1)
-        sbuf[slot] = value
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=sbuf.at[slot],
-            dst_ref=rbuf.at[slot],
-            send_sem=send_sem.at[slot],
-            recv_sem=recv_sem.at[slot],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        rdma.start()
-        rdma.wait()
-        got = rbuf[slot]
-        if use_credits:
-            pltpu.semaphore_signal(
-                credit_sem.at[slot], inc=1, device_id=left,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-        return got
+        return finish(g, begin(g, value))
 
     # chunk index shift: 0 makes member r finish the reduce-scatter
     # holding chunk (r+1)%n (the classic ring layout); -1 shifts every
@@ -177,12 +214,9 @@ def _ring_kernel(x_ref, out_ref, sbuf, rbuf, send_sem, recv_sem,
             out_ref[rds(me - s - 1), :] = cur
             steps += 1
 
-    # drain the final credits (one per slot that was used, granted by
-    # the right neighbor's last consumptions) so every semaphore exits
-    # at zero
-    if use_credits:
-        for slot in range(min(2, steps)):
-            pltpu.semaphore_wait(credit_sem.at[slot], 1)
+    # final credits: one per used slot, granted by the right neighbor's
+    # last consumptions
+    drain(steps)
 
 
 def _pallas_ring(x2d, out_rows, mode, op_fn, n, rows, axis_name,
@@ -216,6 +250,104 @@ def _pallas_ring(x2d, out_rows, mode, op_fn, n, rows, axis_name,
     )(x2d)
 
 
+def _ring_kernel_bidir(x_ref, out_ref, sbufR, rbufR, sbufL, rbufL,
+                       send_semR, recv_semR, send_semL, recv_semL,
+                       credit_semR, credit_semL, *, n, rows2, axis_name,
+                       op_fn, use_credits, use_barrier):
+    """Bidirectional ring allreduce: the buffer's two halves ride two
+    independent rings at once — half 0 clockwise (send right), half 1
+    counter-clockwise (send left) — so BOTH directions of each
+    full-duplex ICI link carry payload and each link direction moves
+    (n-1)/n of HALF the buffer: ~half the unidirectional ring's wall
+    clock (~2x throughput) on hardware where the reverse direction
+    would otherwise idle. Each direction runs exactly the
+    :func:`_direction` protocol the host-side property model verifies
+    (slots, DMA semaphores, credits — mirrored)."""
+    me = lax.axis_index(axis_name)
+    right = jnp.mod(me + 1, n)
+    left = jnp.mod(me - 1, n)
+
+    if use_barrier:
+        _neighbor_barrier(left, right)
+
+    # clockwise: send right, credit the left (our upstream); counter-
+    # clockwise: mirrored
+    beginR, finishR, drainR = _direction(
+        sbufR, rbufR, send_semR, recv_semR, credit_semR, right, left,
+        use_credits)
+    beginL, finishL, drainL = _direction(
+        sbufL, rbufL, send_semL, recv_semL, credit_semL, left, right,
+        use_credits)
+
+    def exchange2(g, valR, valL):
+        """Send valR right and valL left concurrently (both DMAs start
+        before either wait); return what arrived (from the left and the
+        right respectively)."""
+        dmaR = beginR(g, valR)
+        dmaL = beginL(g, valL)
+        return finishR(g, dmaR), finishL(g, dmaL)
+
+    def blkR(i):                      # half-0 chunk i (clockwise ring)
+        return pl.ds(jnp.mod(i, n) * rows2, rows2)
+
+    def blkL(i):                      # half-1 chunk i (counter-clockwise)
+        return pl.ds((n + jnp.mod(i, n)) * rows2, rows2)
+
+    # ---- reduce-scatter, both directions ----------------------------
+    accR = x_ref[blkR(me), :]
+    accL = x_ref[blkL(me), :]
+    steps = 0
+    for s in range(n - 1):
+        gotR, gotL = exchange2(steps, accR, accL)
+        accR = op_fn(gotR, x_ref[blkR(me - s - 1), :])
+        accL = op_fn(gotL, x_ref[blkL(me + s + 1), :])
+        steps += 1
+    out_ref[blkR(me + 1), :] = accR   # mirrored finishing chunks
+    out_ref[blkL(me - 1), :] = accL
+
+    # ---- allgather, both directions ---------------------------------
+    curR, curL = accR, accL
+    for s in range(n - 1):
+        curR, curL = exchange2(steps, curR, curL)
+        out_ref[blkR(me - s), :] = curR
+        out_ref[blkL(me + s), :] = curL
+        steps += 1
+
+    drainR(steps)
+    drainL(steps)
+
+
+def _pallas_ring_bidir(x2d, op_fn, n, rows2, axis_name, interpret):
+    lanes = x2d.shape[1]
+    vma = getattr(jax.typeof(x2d), "vma", None)
+    shape = (2 * n * rows2, lanes)
+    out_shape = (jax.ShapeDtypeStruct(shape, x2d.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(shape, x2d.dtype))
+    buf = lambda: pltpu.VMEM((2, rows2, lanes), x2d.dtype)  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_ring_kernel_bidir, n=n, rows2=rows2,
+                          axis_name=axis_name, op_fn=op_fn,
+                          use_credits=not interpret,
+                          use_barrier=not interpret),
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            buf(), buf(),                       # CW send/recv slots
+            buf(), buf(),                       # CCW send/recv slots
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),  # CW slot-free credits
+            pltpu.SemaphoreType.REGULAR((2,)),  # CCW slot-free credits
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                             collective_id=0),
+        interpret=interpret,
+    )(x2d)
+
+
 def _check_1d(x, what: str):
     if x.ndim != 1:
         raise Mp4jError(f"{what} needs a 1-D array, got shape {x.shape}")
@@ -236,28 +368,43 @@ def _tile(c: int, dtype, interpret: bool, what: str):
 
 
 def ring_allreduce_kernel(x, operator: Operator = Operators.SUM,
-                          axis_name="mp4j", interpret: bool = False):
+                          axis_name="mp4j", interpret: bool = False,
+                          bidirectional: bool = False):
     """Allreduce of a per-member [L] array via explicit ICI RDMA.
 
     Any element-wise associative+commutative ``operator`` (the merge
     runs on the VPU inside the ring step); ANY length L — the buffer is
-    padded with the operator identity to n equal tile-aligned chunks
-    and sliced back, so padding never perturbs the result.
+    padded with the operator identity to equal tile-aligned chunks and
+    sliced back, so padding never perturbs the result.
+
+    ``bidirectional=True`` splits the buffer in half and rings the
+    halves in opposite directions simultaneously (see
+    ``_ring_kernel_bidir``): each full-duplex ICI link direction
+    carries (n-1)/n of HALF the buffer — ~half the unidirectional
+    wall clock (~2x throughput) on real hardware. Same results either
+    way.
     """
     n = lax.axis_size(axis_name)
     _check_1d(x, "ring allreduce kernel")
     if n == 1:
         return x
     L = x.shape[0]
-    c = round_up_chunk(-(-L // n), x.dtype, interpret)
-    pad = n * c - L
+    parts = 2 * n if bidirectional else n
+    c = round_up_chunk(-(-L // parts), x.dtype, interpret)
+    pad = parts * c - L
     if pad:
         ident = jnp.asarray(operator.identity(x.dtype), dtype=x.dtype)
         x = jnp.concatenate([x, jnp.full((pad,), ident, x.dtype)])
     rows, lanes = _tile(c, x.dtype, interpret, "ring allreduce kernel")
-    out = _pallas_ring(x.reshape(n * rows, lanes), n * rows, "allreduce",
-                       operator.jnp_fn, n, rows, axis_name, interpret)
-    out = out.reshape(n * c)
+    if bidirectional:
+        out = _pallas_ring_bidir(x.reshape(parts * rows, lanes),
+                                 operator.jnp_fn, n, rows, axis_name,
+                                 interpret)
+    else:
+        out = _pallas_ring(x.reshape(parts * rows, lanes), parts * rows,
+                           "allreduce", operator.jnp_fn, n, rows,
+                           axis_name, interpret)
+    out = out.reshape(parts * c)
     return out[:L] if pad else out
 
 
