@@ -1,10 +1,12 @@
-"""The three transport schedules, side by side.
+"""The transport schedules, side by side.
 
 The same allreduce runs as (1) one fused XLA collective, (2) a
-hand-scheduled ppermute ring, and (3) the Pallas RDMA ring kernel that
+hand-scheduled ppermute ring, (3) the Pallas RDMA ring kernel that
 owns the transport itself (remote DMA + entry barrier + credit
-backpressure; interpreted off-TPU) — selectable per call on the driver
-API and composable inside your own jitted shard_map code.
+backpressure; interpreted off-TPU), and (4) the bidirectional RDMA
+variant that rings the buffer's halves in opposite directions so both
+full-duplex ICI link directions carry payload — selectable per call on
+the driver API and composable inside your own jitted shard_map code.
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 python 08_ring_transports.py
 """
@@ -36,26 +38,31 @@ for algo in ("xla", "ring", "rdma"):
     assert np.allclose(arrs[0], want, rtol=1e-5)
     print(f"algo={algo:4s}: ok (first elems {arrs[0][:3]})")
 
-# -- functional layer: the same three schedules inside YOUR jit -------
+# -- functional layer: the same schedules inside YOUR jit -------------
 mesh = make_mesh(n)
 on_tpu = mesh.devices.flat[0].platform == "tpu"
 data = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, 16 * n))
 
 
 @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
-         out_specs=(P("mp4j"),) * 3, check_vma=False)
-def three_ways(x):
+         out_specs=(P("mp4j"),) * 4, check_vma=False)
+def four_ways(x):
     v = x[0]
     a = coll.allreduce(v, Operators.SUM, "mp4j")
     b = ring.ring_allreduce(v, Operators.SUM, "mp4j")
     c = ring_kernel.ring_allreduce_kernel(v, Operators.SUM, "mp4j",
                                           interpret=not on_tpu)
-    return a[None], b[None], c[None]
+    # both full-duplex ICI link directions busy at once
+    d = ring_kernel.ring_allreduce_kernel(v, Operators.SUM, "mp4j",
+                                          interpret=not on_tpu,
+                                          bidirectional=True)
+    return a[None], b[None], c[None], d[None]
 
 
-a, b, c = jax.jit(three_ways)(data)
+a, b, c, d = jax.jit(four_ways)(data)
 want = data.sum(0)
-for name, out in (("psum", a), ("ppermute ring", b), ("rdma kernel", c)):
+for name, out in (("psum", a), ("ppermute ring", b),
+                  ("rdma kernel", c), ("rdma bidirectional", d)):
     assert np.allclose(np.asarray(out)[0], want, rtol=1e-5)
     print(f"in-jit {name}: ok")
-print("all three transports agree")
+print("all transports agree")
