@@ -348,3 +348,49 @@ def test_bidirectional_allreduce(rng, n, L, op_name):
     want = OPS[op_name](data, axis=0)
     for r in range(n):
         np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_bidirectional_reduce_scatter_and_allgather(rng, n):
+    """Chunk-halved bidirectional RS/AG match the unidirectional chunk
+    layouts exactly."""
+    mesh = make_mesh(n)
+    L = 6 * n
+    data = rng.standard_normal((n, L)).astype(np.float32)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P("mp4j"), check_vma=False)
+    def frs(x):
+        return ring_reduce_scatter_kernel(
+            x[0], Operators.SUM, "mp4j", interpret=True,
+            bidirectional=True)[None]
+
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(frs)(jnp.asarray(data))),
+        data.sum(0).reshape(n, -1), rtol=1e-5, atol=1e-6)
+
+    shards = rng.standard_normal((n, 6)).astype(np.float32)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P(None, None), check_vma=False)
+    def fag(x):
+        return ring_allgather_kernel(
+            x[0], "mp4j", interpret=True,
+            bidirectional=True).reshape(n, 6)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(fag)(jnp.asarray(shards))), shards)
+
+
+def test_bidirectional_odd_chunk_rejected():
+    mesh = make_mesh(4)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("mp4j"),
+             out_specs=P("mp4j"), check_vma=False)
+    def f(x):
+        return ring_reduce_scatter_kernel(
+            x[0], Operators.SUM, "mp4j", interpret=True,
+            bidirectional=True)[None]
+
+    with pytest.raises(Mp4jError):
+        jax.jit(f)(np.ones((4, 20), np.float32))   # chunks of 5: odd

@@ -136,18 +136,23 @@ def check_rings(results: dict, mesh: Mesh, n: int, L: int = 8192):
                      x[0], Operators.SUM, AXIS)[None],
                  P(AXIS), P(AXIS)),
              _f32(n, L + 7))
-    _compile("ring/rdma_reduce_scatter", results,
-             _shard_mapped(
-                 mesh, lambda x: ring_kernel.ring_reduce_scatter_kernel(
-                     x[0], Operators.SUM, AXIS)[None],
-                 P(AXIS), P(AXIS)),
-             _f32(n, L))
-    _compile("ring/rdma_allgather", results,
-             _shard_mapped(
-                 mesh, lambda x: ring_kernel.ring_allgather_kernel(
-                     x[0], AXIS)[None],
-                 P(AXIS), P(AXIS)),
-             _f32(n, L))
+    for bidir in (False, True):
+        tag = "_bidir" if bidir else ""
+        _compile(f"ring/rdma_reduce_scatter{tag}", results,
+                 _shard_mapped(
+                     mesh, lambda x, b=bidir:
+                     ring_kernel.ring_reduce_scatter_kernel(
+                         x[0], Operators.SUM, AXIS,
+                         bidirectional=b)[None],
+                     P(AXIS), P(AXIS)),
+                 _f32(n, L))
+        _compile(f"ring/rdma_allgather{tag}", results,
+                 _shard_mapped(
+                     mesh, lambda x, b=bidir:
+                     ring_kernel.ring_allgather_kernel(
+                         x[0], AXIS, bidirectional=b)[None],
+                     P(AXIS), P(AXIS)),
+                 _f32(n, L))
 
 
 def check_sparse(results: dict, mesh: Mesh, n: int, cap: int = 1024):

@@ -192,6 +192,47 @@ def check_gbdt_global_mesh(comm) -> int:
     return fails
 
 
+def check_ffm_global_mesh(comm) -> int:
+    """The sparse-gradient consumer at DCN scale: FFM with the
+    gathered-row sparse allreduce (check_vma=False collective over a
+    multi-process mesh) must train to the same loss as a local dense
+    run on identical seeded data."""
+    import jax
+
+    from ytk_mp4j_tpu.comm.distributed import global_mesh
+    from ytk_mp4j_tpu.models.fm import FMConfig, FMTrainer
+    from ytk_mp4j_tpu.parallel import make_mesh
+
+    fails = 0
+    rng = np.random.default_rng(77)             # same data everywhere
+    N, K, nf, k, F = 256, 3, 3, 3, 500
+    feats = rng.integers(0, F, (N, K)).astype(np.int32)
+    fields = rng.integers(0, nf, (N, K)).astype(np.int32)
+    vals = rng.random((N, K)).astype(np.float32)
+    y = (rng.random(N) > 0.5).astype(np.float32)
+    cfg = FMConfig(model="ffm", n_features=F, n_fields=nf, k=k,
+                   max_nnz=K, learning_rate=0.2, l2=1e-4,
+                   init_scale=0.1)
+
+    sparse = FMTrainer(cfg, mesh=global_mesh(), sparse_grads=True)
+    _, losses_d = sparse.fit(feats, fields, vals, y, n_steps=6, seed=5)
+    dense = FMTrainer(
+        cfg, mesh=make_mesh(1, devices=jax.local_devices()[:1]),
+        sparse_grads=False)
+    _, losses_s = dense.fit(feats, fields, vals, y, n_steps=6, seed=5)
+    # NaN-proof form (like check_gbdt_global_mesh): any non-finite loss
+    # on EITHER side, or a divergence, must count as failure —
+    # `abs(x - nan) > tol` is False and would otherwise pass silently
+    ok = (all(np.isfinite(m) for m in losses_d)
+          and np.isfinite(losses_s[-1])
+          and abs(losses_d[-1] - losses_s[-1]) <= 1e-3)
+    if not ok:
+        comm.error(f"ffm global-mesh MISMATCH: sparse {losses_d}"
+                   f" vs dense-local {losses_s[-1]}")
+        fails += 1
+    return fails
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True, help="host:port")
@@ -222,6 +263,7 @@ def main(argv=None) -> int:
         fails = check(comm, args.length)
         fails += check_global_mesh(comm)
         fails += check_gbdt_global_mesh(comm)
+        fails += check_ffm_global_mesh(comm)
         comm.info(f"checkdist done: {fails} failures")
         comm.close(0 if fails == 0 else 1)
         # job-wide verdict: root-only checks fail on rank 0 alone, so

@@ -253,16 +253,23 @@ def _pallas_ring(x2d, out_rows, mode, op_fn, n, rows, axis_name,
 def _ring_kernel_bidir(x_ref, out_ref, sbufR, rbufR, sbufL, rbufL,
                        send_semR, recv_semR, send_semL, recv_semL,
                        credit_semR, credit_semL, *, n, rows2, axis_name,
-                       op_fn, use_credits, use_barrier):
-    """Bidirectional ring allreduce: the buffer's two halves ride two
-    independent rings at once — half 0 clockwise (send right), half 1
+                       op_fn, use_credits, use_barrier, mode):
+    """Bidirectional ring collectives: two independent rings at once —
+    one half of the payload clockwise (send right), the other half
     counter-clockwise (send left) — so BOTH directions of each
     full-duplex ICI link carry payload and each link direction moves
     (n-1)/n of HALF the buffer: ~half the unidirectional ring's wall
     clock (~2x throughput) on hardware where the reverse direction
     would otherwise idle. Each direction runs exactly the
     :func:`_direction` protocol the host-side property model verifies
-    (slots, DMA semaphores, credits — mirrored)."""
+    (slots, DMA semaphores, credits — mirrored).
+
+    Payload split by mode: "allreduce" rings the BUFFER's halves (n
+    chunks each, halves laid out [n*rows2 | n*rows2]); "reduce_scatter"
+    and "allgather" ring each CHUNK's halves (chunk i occupies rows
+    [i*2*rows2, (i+1)*2*rows2), its half A clockwise and half B
+    counter-clockwise), matching the unidirectional chunk layout so
+    the finished output is identical."""
     me = lax.axis_index(axis_name)
     right = jnp.mod(me + 1, n)
     left = jnp.mod(me - 1, n)
@@ -287,40 +294,73 @@ def _ring_kernel_bidir(x_ref, out_ref, sbufR, rbufR, sbufL, rbufL,
         dmaL = beginL(g, valL)
         return finishR(g, dmaR), finishL(g, dmaL)
 
-    def blkR(i):                      # half-0 chunk i (clockwise ring)
-        return pl.ds(jnp.mod(i, n) * rows2, rows2)
+    if mode == "allreduce":
+        def blkR(i):                  # half-0 chunk i (clockwise ring)
+            return pl.ds(jnp.mod(i, n) * rows2, rows2)
 
-    def blkL(i):                      # half-1 chunk i (counter-clockwise)
-        return pl.ds((n + jnp.mod(i, n)) * rows2, rows2)
+        def blkL(i):                  # half-1 chunk i (counter-clockwise)
+            return pl.ds((n + jnp.mod(i, n)) * rows2, rows2)
+    else:
+        def blkR(i):                  # chunk i's half A
+            return pl.ds(jnp.mod(i, n) * 2 * rows2, rows2)
 
-    # ---- reduce-scatter, both directions ----------------------------
-    accR = x_ref[blkR(me), :]
-    accL = x_ref[blkL(me), :]
+        def blkL(i):                  # chunk i's half B
+            return pl.ds(jnp.mod(i, n) * 2 * rows2 + rows2, rows2)
+
     steps = 0
+    if mode == "allgather":
+        # forward this member's chunk halves in opposite directions
+        out_ref[blkR(me), :] = x_ref[pl.ds(0, rows2), :]
+        out_ref[blkL(me), :] = x_ref[pl.ds(rows2, rows2), :]
+        curR = x_ref[pl.ds(0, rows2), :]
+        curL = x_ref[pl.ds(rows2, rows2), :]
+        for s in range(n - 1):
+            curR, curL = exchange2(steps, curR, curL)
+            out_ref[blkR(me - s - 1), :] = curR
+            out_ref[blkL(me + s + 1), :] = curL
+            steps += 1
+        drainR(steps)
+        drainL(steps)
+        return
+
+    # ---- reduce-scatter phase, both directions ----------------------
+    # chunk-index shifts make member r finish holding: allreduce —
+    # chunk (r+1) CW / (r-1) CCW (any layout works, the allgather phase
+    # restores order); reduce_scatter — chunk r in BOTH directions (the
+    # coll.reduce_scatter contract): CW shift -1, CCW shift +1
+    shR = -1 if mode == "reduce_scatter" else 0
+    shL = +1 if mode == "reduce_scatter" else 0
+    accR = x_ref[blkR(me + shR), :]
+    accL = x_ref[blkL(me + shL), :]
     for s in range(n - 1):
         gotR, gotL = exchange2(steps, accR, accL)
-        accR = op_fn(gotR, x_ref[blkR(me - s - 1), :])
-        accL = op_fn(gotL, x_ref[blkL(me + s + 1), :])
+        accR = op_fn(gotR, x_ref[blkR(me - s - 1 + shR), :])
+        accL = op_fn(gotL, x_ref[blkL(me + s + 1 + shL), :])
         steps += 1
-    out_ref[blkR(me + 1), :] = accR   # mirrored finishing chunks
-    out_ref[blkL(me - 1), :] = accL
+    if mode == "reduce_scatter":
+        out_ref[pl.ds(0, rows2), :] = accR      # chunk me, half A
+        out_ref[pl.ds(rows2, rows2), :] = accL  # chunk me, half B
+    else:
+        out_ref[blkR(me + 1), :] = accR   # mirrored finishing chunks
+        out_ref[blkL(me - 1), :] = accL
 
-    # ---- allgather, both directions ---------------------------------
-    curR, curL = accR, accL
-    for s in range(n - 1):
-        curR, curL = exchange2(steps, curR, curL)
-        out_ref[blkR(me - s), :] = curR
-        out_ref[blkL(me + s), :] = curL
-        steps += 1
+        # ---- allgather phase, both directions -----------------------
+        curR, curL = accR, accL
+        for s in range(n - 1):
+            curR, curL = exchange2(steps, curR, curL)
+            out_ref[blkR(me - s), :] = curR
+            out_ref[blkL(me + s), :] = curL
+            steps += 1
 
     drainR(steps)
     drainL(steps)
 
 
-def _pallas_ring_bidir(x2d, op_fn, n, rows2, axis_name, interpret):
+def _pallas_ring_bidir(x2d, out_rows, mode, op_fn, n, rows2, axis_name,
+                       interpret):
     lanes = x2d.shape[1]
     vma = getattr(jax.typeof(x2d), "vma", None)
-    shape = (2 * n * rows2, lanes)
+    shape = (out_rows, lanes)
     out_shape = (jax.ShapeDtypeStruct(shape, x2d.dtype, vma=vma) if vma
                  else jax.ShapeDtypeStruct(shape, x2d.dtype))
     buf = lambda: pltpu.VMEM((2, rows2, lanes), x2d.dtype)  # noqa: E731
@@ -328,7 +368,7 @@ def _pallas_ring_bidir(x2d, op_fn, n, rows2, axis_name, interpret):
         functools.partial(_ring_kernel_bidir, n=n, rows2=rows2,
                           axis_name=axis_name, op_fn=op_fn,
                           use_credits=not interpret,
-                          use_barrier=not interpret),
+                          use_barrier=not interpret, mode=mode),
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -398,6 +438,7 @@ def ring_allreduce_kernel(x, operator: Operator = Operators.SUM,
     rows, lanes = _tile(c, x.dtype, interpret, "ring allreduce kernel")
     if bidirectional:
         out = _pallas_ring_bidir(x.reshape(parts * rows, lanes),
+                                 parts * rows, "allreduce",
                                  operator.jnp_fn, n, rows, axis_name,
                                  interpret)
     else:
@@ -408,12 +449,26 @@ def ring_allreduce_kernel(x, operator: Operator = Operators.SUM,
     return out[:L] if pad else out
 
 
+def _bidir_rows2(rows: int, what: str) -> int:
+    """Per-direction row count when a chunk's halves ride opposite
+    directions; the chunk must split into two tile-aligned halves."""
+    if rows % 2:
+        raise Mp4jError(
+            f"{what}: bidirectional chunks must split into two "
+            f"tile-aligned halves; got {rows} rows (double the chunk "
+            "granule, see min_chunk_elems)")
+    return rows // 2
+
+
 def ring_reduce_scatter_kernel(x, operator: Operator = Operators.SUM,
-                               axis_name="mp4j", interpret: bool = False):
+                               axis_name="mp4j", interpret: bool = False,
+                               bidirectional: bool = False):
     """Member r ends with chunk r ([L/n]) of the element-wise reduction
     (the ``coll.reduce_scatter`` layout). L must be divisible by the
     axis size, and compiled chunks by ``min_chunk_elems`` (pad outside
-    — the chunk boundaries are the caller's contract)."""
+    — the chunk boundaries are the caller's contract).
+    ``bidirectional`` rings each chunk's halves in opposite directions
+    (chunks must split into two tile-aligned halves)."""
     n = lax.axis_size(axis_name)
     _check_1d(x, "ring reduce-scatter kernel")
     if x.shape[0] % n:
@@ -425,22 +480,37 @@ def ring_reduce_scatter_kernel(x, operator: Operator = Operators.SUM,
     c = x.shape[0] // n
     rows, lanes = _tile(c, x.dtype, interpret,
                         "ring reduce-scatter kernel")
-    out = _pallas_ring(x.reshape(n * rows, lanes), rows,
-                       "reduce_scatter", operator.jnp_fn, n, rows,
-                       axis_name, interpret)
+    if bidirectional:
+        rows2 = _bidir_rows2(rows, "ring reduce-scatter kernel")
+        out = _pallas_ring_bidir(x.reshape(n * rows, lanes), rows,
+                                 "reduce_scatter", operator.jnp_fn, n,
+                                 rows2, axis_name, interpret)
+    else:
+        out = _pallas_ring(x.reshape(n * rows, lanes), rows,
+                           "reduce_scatter", operator.jnp_fn, n, rows,
+                           axis_name, interpret)
     return out.reshape(c)
 
 
-def ring_allgather_kernel(x, axis_name="mp4j", interpret: bool = False):
+def ring_allgather_kernel(x, axis_name="mp4j", interpret: bool = False,
+                          bidirectional: bool = False):
     """Every member ends with [n * c]: member q's [c] shard at block q
     (the ``ring.ring_allgather`` layout). Compiled shards must be
-    multiples of ``min_chunk_elems``."""
+    multiples of ``min_chunk_elems``. ``bidirectional`` forwards each
+    shard's halves in opposite directions (shards must split into two
+    tile-aligned halves)."""
     n = lax.axis_size(axis_name)
     _check_1d(x, "ring allgather kernel")
     if n == 1:
         return x
     c = x.shape[0]
     rows, lanes = _tile(c, x.dtype, interpret, "ring allgather kernel")
-    out = _pallas_ring(x.reshape(rows, lanes), n * rows, "allgather",
-                       None, n, rows, axis_name, interpret)
+    if bidirectional:
+        rows2 = _bidir_rows2(rows, "ring allgather kernel")
+        out = _pallas_ring_bidir(x.reshape(rows, lanes), n * rows,
+                                 "allgather", None, n, rows2,
+                                 axis_name, interpret)
+    else:
+        out = _pallas_ring(x.reshape(rows, lanes), n * rows, "allgather",
+                           None, n, rows, axis_name, interpret)
     return out.reshape(n * c)
