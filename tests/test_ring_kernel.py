@@ -112,221 +112,6 @@ def test_reduce_scatter_rejects_indivisible(rng):
         jax.jit(f)(np.ones((4, 7), np.float32))
 
 
-# ----------------------------------------------------------------------
-# Host-side model of the compiled-path credit protocol.
-#
-# The slot-reuse race the credits guard is exactly what interpret mode
-# cannot surface (members run serially there), so the protocol is
-# verified against this discrete-event model instead: every member runs
-# the same exchange() sequence as the kernel, a scheduler interleaves
-# members and DMA deliveries ADVERSARIALLY (including stalling one
-# victim member as long as possible), and the model checks
-#   (a) no DMA delivery ever overwrites an unconsumed receive slot,
-#   (b) every semaphore drains to zero at exit,
-#   (c) the allreduce result is correct on every member.
-# Without credits the same adversarial scheduler DOES produce the
-# overwrite (the final test) — proof the guard is load-bearing, not
-# decorative.
-# ----------------------------------------------------------------------
-class _RingModel:
-    def __init__(self, n, use_credits, seed=0, victim=None,
-                 mode="allreduce"):
-        self.n = n
-        self.use_credits = use_credits
-        self.mode = mode
-        self.rng = np.random.default_rng(seed)
-        self.victim = victim          # member to stall when possible
-        self.credit = [[0, 0] for _ in range(n)]
-        self.send_sem = [[0, 0] for _ in range(n)]
-        self.recv_sem = [[0, 0] for _ in range(n)]
-        # rbuf[r][slot] = (value, unconsumed)
-        self.rbuf = [[(None, False), (None, False)] for _ in range(n)]
-        self.pending = []             # in-flight DMAs: (src, slot, value)
-        self.violations = 0
-        self.out = [None] * n
-
-    # --- the member program: mirrors _ring_kernel's three modes ---
-    def _member(self, me, chunks):
-        n = self.n
-
-        def exchange(g, value):
-            slot = g % 2
-            if self.use_credits and g >= 2:
-                yield ("wait_credit", slot)
-            yield ("send", slot, value)
-            yield ("wait_send", slot)
-            yield ("wait_recv", slot)
-            got = yield ("consume", slot)
-            if self.use_credits:
-                yield ("signal_credit", slot)
-            return got
-
-        shift = -1 if self.mode == "reduce_scatter" else 0
-
-        def sel(j):
-            return chunks[(j + shift) % n]
-
-        steps = 0
-        if self.mode in ("allreduce", "reduce_scatter"):
-            out = [None] * n
-            acc = sel(me)
-            for s in range(n - 1):
-                acc = (yield from exchange(steps, acc)) + sel(me - s - 1)
-                steps += 1
-            if self.mode == "reduce_scatter":
-                result = acc                     # chunk me, reduced
-            else:
-                out[(me + 1) % n] = acc
-                cur = acc
-                for s in range(n - 1):
-                    cur = yield from exchange(steps, cur)
-                    out[(me - s) % n] = cur
-                    steps += 1
-                result = out
-        else:                                    # allgather
-            out = [None] * n
-            out[me] = chunks[0]
-            cur = chunks[0]
-            for s in range(n - 1):
-                cur = yield from exchange(steps, cur)
-                out[(me - s - 1) % n] = cur
-                steps += 1
-            result = out
-        if self.use_credits:
-            for slot in range(min(2, steps)):
-                yield ("wait_credit", slot)
-        self.out[me] = result
-
-    def _runnable(self, r, action):
-        kind = action[0]
-        slot = action[1]
-        if kind == "wait_credit":
-            return self.credit[r][slot] >= 1
-        if kind == "wait_send":
-            return self.send_sem[r][slot] >= 1
-        if kind == "wait_recv":
-            return self.recv_sem[r][slot] >= 1
-        return True                   # send / consume / signal_credit
-
-    def _apply(self, r, gen, action):
-        """Execute one runnable action; returns the value to send into
-        the generator (consume) or None."""
-        kind, slot = action[0], action[1]
-        if kind == "wait_credit":
-            self.credit[r][slot] -= 1
-        elif kind == "wait_send":
-            self.send_sem[r][slot] -= 1
-        elif kind == "wait_recv":
-            self.recv_sem[r][slot] -= 1
-        elif kind == "send":
-            # sbuf integrity: the previous outbound on this slot must
-            # have drained (send_sem wait at its step) — model-checked
-            assert not any(s == r and sl == slot
-                           for s, sl, _ in self.pending), \
-                "sbuf overwritten with DMA in flight"
-            self.pending.append((r, slot, action[2]))
-        elif kind == "consume":
-            value, unconsumed = self.rbuf[r][slot]
-            if not unconsumed:
-                # stale re-read: the slot's fresh value was consumed
-                # already — the paired overwrite was counted when the
-                # extra delivery landed; the broken run reads garbage
-                self.violations += 1
-            self.rbuf[r][slot] = (value, False)
-            return value
-        elif kind == "signal_credit":
-            self.credit[(r - 1) % self.n][slot] += 1
-        return None
-
-    def _deliver(self, i):
-        src, slot, value = self.pending.pop(i)
-        dst = (src + 1) % self.n
-        if self.rbuf[dst][slot][1]:   # unconsumed data overwritten!
-            self.violations += 1
-        self.rbuf[dst][slot] = (value, True)
-        self.recv_sem[dst][slot] += 1
-        self.send_sem[src][slot] += 1
-
-    def run(self, data):
-        """data: [n, n] — member r's chunk j at data[r, j]."""
-        n = self.n
-        gens = [self._member(r, list(data[r])) for r in range(n)]
-        actions = [g.send(None) for g in gens]
-        done = [False] * n
-        while not all(done):
-            # candidate moves: deliveries (any in-flight DMA) and
-            # runnable member actions
-            moves = [("dma", i) for i in range(len(self.pending))]
-            moves += [("mem", r) for r in range(n)
-                      if not done[r] and self._runnable(r, actions[r])]
-            assert moves, "deadlock: no runnable member, no DMA in flight"
-            # adversarial preference: stall the victim while anything
-            # else can move
-            if self.victim is not None:
-                non_victim = [m for m in moves
-                              if m != ("mem", self.victim)]
-                if non_victim:
-                    moves = non_victim
-            kind, i = moves[self.rng.integers(len(moves))]
-            if kind == "dma":
-                self._deliver(i)
-                continue
-            r = i
-            ret = self._apply(r, gens[r], actions[r])
-            try:
-                actions[r] = gens[r].send(ret)
-            except StopIteration:
-                done[r] = True
-        return self
-
-
-@pytest.mark.parametrize("n", [2, 3, 4, 8])
-@pytest.mark.parametrize("seed", range(5))
-@pytest.mark.parametrize("mode",
-                         ["allreduce", "reduce_scatter", "allgather"])
-def test_credit_protocol_safe_under_any_schedule(n, seed, mode):
-    """With credits: no receive-slot overwrite, semaphores drain to
-    zero, results correct — for random and victim-stalling schedules,
-    in every kernel mode (each has its own step count and drain)."""
-    rng = np.random.default_rng(seed)
-    data = rng.standard_normal((n, n)).astype(np.float64)
-    for victim in [None, 0, n - 1]:
-        m = _RingModel(n, use_credits=True, seed=seed, victim=victim,
-                       mode=mode)
-        m.run(data)
-        assert m.violations == 0
-        assert not m.pending
-        assert all(c == [0, 0] for c in m.credit), m.credit
-        assert all(s == [0, 0] for s in m.send_sem)
-        assert all(s == [0, 0] for s in m.recv_sem)
-        if mode == "allreduce":
-            want = data.sum(0)
-            for r in range(n):
-                np.testing.assert_allclose(m.out[r], want, rtol=1e-12)
-        elif mode == "reduce_scatter":
-            for r in range(n):       # member r ends with chunk r
-                np.testing.assert_allclose(m.out[r], data[:, r].sum(),
-                                           rtol=1e-12)
-        else:                        # member q's shard at slot q
-            for r in range(n):
-                np.testing.assert_allclose(m.out[r], data[:, 0],
-                                           rtol=1e-12)
-
-
-def test_without_credits_adversary_overwrites_slot():
-    """The race is REAL: stalling one member while its upstream runs
-    free overwrites an unconsumed receive slot once the double buffer
-    wraps — the credits exist to prevent exactly this."""
-    n = 4
-    rng = np.random.default_rng(0)
-    data = rng.standard_normal((n, n)).astype(np.float64)
-    hits = 0
-    for victim in range(n):
-        m = _RingModel(n, use_credits=False, seed=1, victim=victim)
-        m.run(data)
-        hits += m.violations
-    assert hits > 0
-
 
 @pytest.mark.parametrize("n", [2, 4, 8])
 @pytest.mark.parametrize("L", [7, 32])
@@ -394,3 +179,275 @@ def test_bidirectional_odd_chunk_rejected():
 
     with pytest.raises(Mp4jError):
         jax.jit(f)(np.ones((4, 20), np.float32))   # chunks of 5: odd
+
+
+# ----------------------------------------------------------------------
+# Host-side model of the compiled-path credit protocol.
+#
+# The slot-reuse race the credits guard is exactly what interpret mode
+# cannot surface (members run serially there), so the protocol is
+# verified against this discrete-event model instead: every member runs
+# the same begin/finish sequence as the kernels' shared _direction
+# protocol — over ONE direction (the unidirectional kernels) or BOTH
+# interleaved (begin R, begin L, finish R, finish L — the bidirectional
+# kernels) — while a scheduler interleaves members and DMA deliveries
+# ADVERSARIALLY (including stalling one victim member as long as
+# possible). The model checks
+#   (a) no DMA delivery ever overwrites an unconsumed receive slot,
+#   (b) every semaphore drains to zero at exit,
+#   (c) the collective's result is correct on every member.
+# Without credits the same adversarial scheduler DOES produce the
+# overwrite (the final test) — proof the guard is load-bearing, not
+# decorative.
+# ----------------------------------------------------------------------
+class _RingModel:
+    """Direction-parameterized model: ``dirs=("R",)`` is the
+    unidirectional kernel, ``dirs=("R", "L")`` the bidirectional one.
+    Direction sign: R sends right/walks chunks downward, L mirrored."""
+
+    SGN = {"R": -1, "L": +1}
+
+    def __init__(self, n, use_credits, seed=0, victim=None,
+                 mode="allreduce", dirs=("R",)):
+        self.n = n
+        self.use_credits = use_credits
+        self.mode = mode
+        self.dirs = dirs
+        self.rng = np.random.default_rng(seed)
+        self.victim = victim          # member to stall when possible
+        z = lambda: [[0, 0] for _ in range(n)]        # noqa: E731
+        self.credit = {d: z() for d in dirs}
+        self.send_sem = {d: z() for d in dirs}
+        self.recv_sem = {d: z() for d in dirs}
+        # rbuf[d][r][slot] = (value, unconsumed)
+        self.rbuf = {d: [[(None, False), (None, False)]
+                         for _ in range(n)] for d in dirs}
+        self.pending = []    # in-flight DMAs: (dir, src, slot, value)
+        self.violations = 0
+        self.out = [None] * n
+
+    # --- the member program: mirrors the kernels' mode logic ---------
+    def _member(self, me, chunks):
+        """``chunks``: {dir: list of n per-chunk values}."""
+        n = self.n
+
+        def begin(d, g, value):
+            slot = g % 2
+            if self.use_credits and g >= 2:
+                yield ("wait_credit", d, slot)
+            yield ("send", d, slot, value)
+
+        def finish(d, g):
+            slot = g % 2
+            yield ("wait_send", d, slot)
+            yield ("wait_recv", d, slot)
+            got = yield ("consume", d, slot)
+            if self.use_credits:
+                yield ("signal_credit", d, slot)
+            return got
+
+        def exchange(g, vals):
+            """All directions' begins, then all finishes — the
+            kernels' interleaving order."""
+            for d in self.dirs:
+                yield from begin(d, g, vals[d])
+            got = {}
+            for d in self.dirs:
+                got[d] = yield from finish(d, g)
+            return got
+
+        # reduce-scatter lands chunk me in every direction via
+        # direction-mirrored shifts; other modes use the natural layout
+        shift = {d: self.SGN[d] if self.mode == "reduce_scatter" else 0
+                 for d in self.dirs}
+
+        def sel(d, j):
+            return chunks[d][(j + shift[d]) % n]
+
+        out = {d: [None] * n for d in self.dirs}
+        steps = 0
+        if self.mode in ("allreduce", "reduce_scatter"):
+            acc = {d: sel(d, me) for d in self.dirs}
+            for s in range(n - 1):
+                got = yield from exchange(steps, acc)
+                acc = {d: got[d] + sel(d, me + self.SGN[d] * (s + 1))
+                       for d in self.dirs}
+                steps += 1
+            if self.mode == "reduce_scatter":
+                result = {d: acc[d] for d in self.dirs}
+            else:
+                cur = dict(acc)
+                for d in self.dirs:   # finishing chunk, mirrored
+                    out[d][(me - self.SGN[d]) % n] = acc[d]
+                for s in range(n - 1):
+                    cur = yield from exchange(steps, cur)
+                    for d in self.dirs:
+                        out[d][(me + self.SGN[d] * s) % n] = cur[d]
+                    steps += 1
+                result = out
+        else:                                    # allgather
+            for d in self.dirs:
+                out[d][me] = chunks[d][0]
+            cur = {d: chunks[d][0] for d in self.dirs}
+            for s in range(n - 1):
+                cur = yield from exchange(steps, cur)
+                for d in self.dirs:
+                    out[d][(me + self.SGN[d] * (s + 1)) % n] = cur[d]
+                steps += 1
+            result = out
+        if self.use_credits:
+            for slot in range(min(2, steps)):
+                for d in self.dirs:
+                    yield ("wait_credit", d, slot)
+        self.out[me] = result
+
+    # --- the scheduler -----------------------------------------------
+    def _runnable(self, r, a):
+        kind, d, slot = a[0], a[1], a[2]
+        if kind == "wait_credit":
+            return self.credit[d][r][slot] >= 1
+        if kind == "wait_send":
+            return self.send_sem[d][r][slot] >= 1
+        if kind == "wait_recv":
+            return self.recv_sem[d][r][slot] >= 1
+        return True                   # send / consume / signal_credit
+
+    def _apply(self, r, a):
+        """Execute one runnable action; returns the value to send into
+        the generator (consume) or None."""
+        kind, d, slot = a[0], a[1], a[2]
+        if kind == "wait_credit":
+            self.credit[d][r][slot] -= 1
+        elif kind == "wait_send":
+            self.send_sem[d][r][slot] -= 1
+        elif kind == "wait_recv":
+            self.recv_sem[d][r][slot] -= 1
+        elif kind == "send":
+            # sbuf integrity: the previous outbound on this slot must
+            # have drained (send_sem wait at its step) — model-checked
+            assert not any(dd == d and s == r and sl == slot
+                           for dd, s, sl, _ in self.pending), \
+                "sbuf overwritten with DMA in flight"
+            self.pending.append((d, r, slot, a[3]))
+        elif kind == "consume":
+            value, unconsumed = self.rbuf[d][r][slot]
+            if not unconsumed:
+                # stale re-read: the slot's fresh value was consumed
+                # already — the paired overwrite was counted when the
+                # extra delivery landed; the broken run reads garbage
+                self.violations += 1
+            self.rbuf[d][r][slot] = (value, False)
+            return value
+        elif kind == "signal_credit":
+            # credit the upstream sender whose copy we just consumed
+            up = (r + self.SGN[d]) % self.n
+            self.credit[d][up][slot] += 1
+        return None
+
+    def _deliver(self, i):
+        d, src, slot, value = self.pending.pop(i)
+        dst = (src - self.SGN[d]) % self.n
+        if self.rbuf[d][dst][slot][1]:   # unconsumed data overwritten!
+            self.violations += 1
+        self.rbuf[d][dst][slot] = (value, True)
+        self.recv_sem[d][dst][slot] += 1
+        self.send_sem[d][src][slot] += 1
+
+    def run(self, data):
+        """``data``: {dir: [n, n] array} — member r's chunk j of
+        direction d at data[d][r, j]."""
+        n = self.n
+        gens = [self._member(r, {d: list(data[d][r]) for d in self.dirs})
+                for r in range(n)]
+        actions = [g.send(None) for g in gens]
+        done = [False] * n
+        while not all(done):
+            # candidate moves: deliveries (any in-flight DMA) and
+            # runnable member actions
+            moves = [("dma", i) for i in range(len(self.pending))]
+            moves += [("mem", r) for r in range(n)
+                      if not done[r] and self._runnable(r, actions[r])]
+            assert moves, "deadlock: no runnable member, no DMA in flight"
+            # adversarial preference: stall the victim while anything
+            # else can move
+            if self.victim is not None:
+                non_victim = [m for m in moves
+                              if m != ("mem", self.victim)]
+                if non_victim:
+                    moves = non_victim
+            kind, i = moves[self.rng.integers(len(moves))]
+            if kind == "dma":
+                self._deliver(i)
+                continue
+            ret = self._apply(i, actions[i])
+            try:
+                actions[i] = gens[i].send(ret)
+            except StopIteration:
+                done[i] = True
+        return self
+
+    def assert_clean(self):
+        assert self.violations == 0
+        assert not self.pending
+        for d in self.dirs:
+            assert all(c == [0, 0] for c in self.credit[d])
+            assert all(s == [0, 0] for s in self.send_sem[d])
+            assert all(s == [0, 0] for s in self.recv_sem[d])
+
+
+def _model_wants(mode, data, dirs):
+    """Expected per-member result of the modeled collective."""
+    def one(d):
+        sums = data[d].sum(0)
+        if mode == "reduce_scatter":
+            return {r: sums[r] for r in range(data[d].shape[0])}
+        if mode == "allgather":
+            return list(data[d][:, 0])
+        return list(sums)
+    return {d: one(d) for d in dirs}
+
+
+@pytest.mark.parametrize("dirs", [("R",), ("R", "L")],
+                         ids=["unidir", "bidir"])
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("mode",
+                         ["allreduce", "reduce_scatter", "allgather"])
+def test_credit_protocol_safe_under_any_schedule(n, seed, mode, dirs):
+    """With credits: no receive-slot overwrite, semaphores drain to
+    zero, results correct — for random and victim-stalling schedules,
+    in every kernel mode and both directionalities (each has its own
+    step count, drain, and — bidirectionally — interleaving seams)."""
+    rng = np.random.default_rng(seed)
+    data = {d: rng.standard_normal((n, n)).astype(np.float64)
+            for d in dirs}
+    for victim in [None, 0, n - 1]:
+        m = _RingModel(n, use_credits=True, seed=seed, victim=victim,
+                       mode=mode, dirs=dirs)
+        m.run(data)
+        m.assert_clean()
+        want = _model_wants(mode, data, dirs)
+        for r in range(n):
+            res = m.out[r]
+            for d in dirs:
+                if mode == "reduce_scatter":
+                    np.testing.assert_allclose(res[d], want[d][r],
+                                               rtol=1e-12)
+                else:
+                    np.testing.assert_allclose(res[d], want[d],
+                                               rtol=1e-12)
+
+
+def test_without_credits_adversary_overwrites_slot():
+    """The race is REAL: stalling one member while its upstream runs
+    free overwrites an unconsumed receive slot once the double buffer
+    wraps — the credits exist to prevent exactly this."""
+    n = 4
+    rng = np.random.default_rng(0)
+    data = {"R": rng.standard_normal((n, n)).astype(np.float64)}
+    hits = 0
+    for victim in range(n):
+        m = _RingModel(n, use_credits=False, seed=1, victim=victim)
+        m.run(data)
+        hits += m.violations
+    assert hits > 0
