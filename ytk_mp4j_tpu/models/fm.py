@@ -220,9 +220,9 @@ def train_step_sparse(params, batch, cfg: FMConfig, capacity: int,
     flat_rows = rows.reshape(-1)
     flat_g = gE.reshape(S, k)
     if capacity < S:
-        order = jnp.argsort(flat_rows)
+        si, sv = sparse_ops.sort_by_key(flat_rows, flat_g)
         li, lv = sparse_ops.segment_reduce_sorted(
-            flat_rows[order], flat_g[order], capacity, Operators.SUM)
+            si, sv, capacity, Operators.SUM)
     else:
         li, lv = flat_rows.astype(jnp.int32), flat_g
     if axis_name is not None:

@@ -22,6 +22,8 @@ step — the FFM workload of BASELINE.json configs[4]).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -38,6 +40,47 @@ _SEGMENT_REDUCERS = {
     "MAX": jax.ops.segment_max,
     "MIN": jax.ops.segment_min,
 }
+
+
+def sort_by_key(idx, val):
+    """Jointly sort ``(idx, val)`` ascending by ``idx`` with ONE
+    multi-operand ``lax.sort`` — key and payload ride the same sort
+    network, so there is no post-sort gather.
+
+    The previous formulation (``order = argsort(idx); idx[order],
+    val[order]``) routed the payload through a fancy-index row gather;
+    the v5e-8 AOT compile of the FFM sparse step costed that program at
+    180.5 GB bytes-accessed (AOT_r02) for 16 MB of live data — the
+    gather's multi-chip lowering is pathological. The multi-operand
+    sort carries each payload column through the sort comparators
+    instead (see BASELINE.md round-3 A/B for the measured delta).
+
+    ``val`` may be [L] or [L, ...]; trailing dims ride as extra static
+    payload columns. Beyond ``_MAX_SORT_PAYLOAD_COLS`` columns the
+    comparator payload would dominate the sort network, so wide rows
+    fall back to sorting (key, iota) pairs and gathering rows once.
+    """
+    if val.ndim == 1:
+        si, sv = lax.sort((idx, val), dimension=0, num_keys=1)
+        return si, sv
+    L = idx.shape[0]
+    cols = math.prod(val.shape[1:])
+    if cols == 0:
+        # zero-width payload carries no data; only the keys need sorting
+        return lax.sort(idx, dimension=0), val
+    flat = val.reshape(L, cols)
+    if cols > _MAX_SORT_PAYLOAD_COLS:
+        order = jnp.argsort(idx)
+        return idx[order], val[order]
+    out = lax.sort((idx,) + tuple(flat[:, j] for j in range(cols)),
+                   dimension=0, num_keys=1)
+    return out[0], jnp.stack(out[1:], axis=1).reshape(val.shape)
+
+
+# Widest value row that still rides the sort network as payload; wider
+# rows fall back to argsort + one row gather (the comparator cost grows
+# linearly with payload width while the gather cost is width-invariant).
+_MAX_SORT_PAYLOAD_COLS = 128
 
 
 def pad_to(idx, val, capacity: int, operator: Operator = Operators.SUM):
@@ -123,8 +166,8 @@ def sparse_allreduce(idx, val, capacity: int,
     """
     gi = lax.all_gather(idx, axis_name, axis=0, tiled=True)
     gv = lax.all_gather(val, axis_name, axis=0, tiled=True)
-    order = jnp.argsort(gi)
-    return segment_reduce_sorted(gi[order], gv[order], capacity, operator)
+    si, sv = sort_by_key(gi, gv)
+    return segment_reduce_sorted(si, sv, capacity, operator)
 
 
 def sparse_to_dense(idx, val, size: int,
