@@ -264,3 +264,27 @@ def test_socket_allreduce_map_int_keys(op, rng):
         assert set(got) == set(want)
         for k in want:
             np.testing.assert_allclose(got[k], want[k], rtol=1e-12)
+
+
+def test_drifting_key_counts_bound_recompiles(rng):
+    """Real sparse-gradient streams drift in key count every step; the
+    pow2 bucketing of Lmax and union capacity must bound the number of
+    distinct compiled programs at O(log max-keys), not O(steps)."""
+    cl = TpuCommCluster(4)
+    n_sizes = set()
+    for step in range(24):
+        n_keys = 30 + 7 * step            # drifts 30..191
+        maps = make_maps(4, rng, n_keys=n_keys, fill=0.7)
+        want = expected_map_reduce(maps, "SUM")
+        work = [dict(m) for m in maps]
+        cl.allreduce_map(work, Operands.DOUBLE, Operators.SUM)
+        for m in work:
+            assert_map_close(m, want)
+        n_sizes.add(n_keys)
+    n_programs = sum(1 for k in cl._jits if k[0] == "sparse_allreduce")
+    assert len(n_sizes) == 24
+    # 24 distinct key counts spanning 30..191 must land in a handful of
+    # (pow2 Lmax, pow2 capacity) pairs — the pairs cross-combine, so the
+    # bound is O(log^2) worst case, not O(steps); without bucketing this
+    # run compiles 24 programs, with it 7
+    assert n_programs <= 8, cl._jits.keys()

@@ -52,6 +52,11 @@ def _x64_enabled() -> bool:
     return bool(jax.config.jax_enable_x64)
 
 
+def _pow2_bucket(x: int) -> int:
+    """Smallest power of 2 >= x (x >= 1)."""
+    return 1 << (x - 1).bit_length()
+
+
 class TpuCommCluster:
     """SPMD collectives over ``n`` devices of a mesh.
 
@@ -513,7 +518,12 @@ class TpuCommCluster:
                         f"map values must share a shape; {vs} vs {vshape}")
         if vshape is None:
             vshape = ()
-        Lmax = max(1, max((len(m) for m in maps), default=0))
+        # round the per-rank slot count up to a power of 2: real sparse
+        # gradient streams drift in key count every step, and an exact
+        # Lmax would join the jit key and recompile per step; padding is
+        # SENTINEL/identity so the bucket rounding is semantically free
+        # and bounds the compile count at O(log max-keys) programs
+        Lmax = _pow2_bucket(max(1, max((len(m) for m in maps), default=0)))
         ident = operator.identity(operand.dtype)
         idx = np.full((self.n, Lmax), sparse_ops.SENTINEL, dtype=np.int32)
         val = np.full((self.n, Lmax) + vshape, ident, dtype=operand.dtype)
@@ -524,6 +534,10 @@ class TpuCommCluster:
         return keys, idx, val, vshape
 
     def _device_sparse_allreduce(self, idx, val, capacity, operator):
+        # same bucket rounding as _encode_maps, for the union capacity:
+        # the output is SENTINEL-padded past the true union, so callers
+        # (which skip SENTINEL slots) see no semantic difference
+        capacity = _pow2_bucket(capacity)
         Lmax = idx.shape[1]
         vshape = val.shape[2:]
 
