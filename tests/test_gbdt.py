@@ -693,3 +693,69 @@ def test_binner_missing_bucket_needs_three_bins():
         QuantileBinner(2, missing_bucket=True)
     QuantileBinner(3, missing_bucket=True)    # fine
     QuantileBinner(2)                         # fine without the bucket
+
+
+def test_scanned_predict_matches_unrolled(rng):
+    """predict scans over the stacked ensemble (one-tree program size);
+    it must match the unrolled-loop formulation to 1 ulp (FMA fusion
+    differs between program shapes, so exact bit-identity across XLA
+    programs is not attainable — BASELINE.md round-3 note)."""
+    import jax
+
+    cfg = GBDTConfig(n_features=7, n_bins=16, depth=4)
+    T, N = 12, 500
+    n_nodes, n_leaves = 2 ** cfg.depth - 1, 2 ** cfg.depth
+    trees = [
+        (jnp.asarray(rng.integers(0, cfg.n_features, n_nodes),
+                     dtype=jnp.int32),
+         jnp.asarray(rng.integers(0, cfg.n_bins, n_nodes),
+                     dtype=jnp.int32),
+         jnp.asarray(rng.integers(0, 2, n_nodes), dtype=jnp.int32),
+         jnp.asarray(rng.standard_normal(n_leaves), dtype=jnp.float32))
+        for _ in range(T)]
+    bins = rng.integers(0, cfg.n_bins, (N, cfg.n_features)).astype(np.int32)
+    tr = GBDTTrainer(cfg, n_devices=1)
+    got = tr.predict(bins, trees)
+
+    @jax.jit
+    def unrolled(b, ts):
+        out = jnp.zeros((b.shape[0],), jnp.float32)
+        for t in ts:
+            out = out + cfg.learning_rate * predict_tree(b, t, cfg)
+        return out
+
+    want = np.asarray(unrolled(jnp.asarray(bins), trees))
+    np.testing.assert_allclose(got, want, atol=2e-7)
+
+
+def test_scanned_predict_softmax_matches_unrolled(rng):
+    import jax
+
+    cfg = GBDTConfig(n_features=5, n_bins=8, depth=3, loss="softmax",
+                     n_classes=3)
+    T, N = 6, 300
+    n_nodes, n_leaves = 2 ** cfg.depth - 1, 2 ** cfg.depth
+    trees = [
+        tuple(
+            (jnp.asarray(rng.integers(0, cfg.n_features, n_nodes),
+                         dtype=jnp.int32),
+             jnp.asarray(rng.integers(0, cfg.n_bins, n_nodes),
+                         dtype=jnp.int32),
+             jnp.asarray(rng.integers(0, 2, n_nodes), dtype=jnp.int32),
+             jnp.asarray(rng.standard_normal(n_leaves), dtype=jnp.float32))
+            for _ in range(cfg.n_classes))
+        for _ in range(T)]
+    bins = rng.integers(0, cfg.n_bins, (N, cfg.n_features)).astype(np.int32)
+    tr = GBDTTrainer(cfg, n_devices=1)
+    got = tr.predict(bins, trees)
+
+    @jax.jit
+    def unrolled(b, ts):
+        out = jnp.zeros((b.shape[0], cfg.n_classes), jnp.float32)
+        for per_class in ts:
+            out = out + cfg.learning_rate * jnp.stack(
+                [predict_tree(b, t, cfg) for t in per_class], axis=1)
+        return out
+
+    want = np.asarray(unrolled(jnp.asarray(bins), trees))
+    np.testing.assert_allclose(got, want, atol=2e-7)

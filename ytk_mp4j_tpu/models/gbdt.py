@@ -836,7 +836,8 @@ class GBDTTrainer(DataParallelTrainer):
     def predict(self, bins: np.ndarray, trees,
                 proba: bool = False) -> np.ndarray:
         """Ensemble prediction: sum of learning-rate-scaled tree outputs
-        over any binned matrix (one jit; the per-tree loop is unrolled).
+        over any binned matrix (one jit; ``lax.scan`` over the stacked
+        ensemble, so program size is one tree regardless of T).
         Returns raw margins ([N], or [N, n_classes] for softmax);
         ``proba=True`` applies the sigmoid (logistic) or softmax. The
         jitted runner is cached on the trainer — repeated predict()
@@ -846,25 +847,32 @@ class GBDTTrainer(DataParallelTrainer):
             softmax = cfg.loss == "softmax"
 
             @jax.jit
-            def run(bins, trees):
-                if softmax:
-                    out = jnp.zeros((bins.shape[0], cfg.n_classes),
-                                    jnp.float32)
-                    for per_class in trees:
-                        out = out + cfg.learning_rate * jnp.stack(
-                            [predict_tree(bins, t, cfg)
-                             for t in per_class], axis=1)
-                    return out
-                out = jnp.zeros((bins.shape[0],), jnp.float32)
-                for tree in trees:
-                    out = out + cfg.learning_rate * predict_tree(
-                        bins, tree, cfg)
+            def run(bins, stacked):
+                # lax.scan over the stacked ensemble: program size is
+                # one tree regardless of T (the unrolled loop compiled
+                # O(T) programs — a compile-time cliff at ytk-learn-
+                # scale ensembles; round-3 measurement in BASELINE.md)
+                def body(out, tree):
+                    if softmax:
+                        delta = jnp.stack(
+                            [predict_tree(bins,
+                                          tuple(a[c] for a in tree), cfg)
+                             for c in range(cfg.n_classes)], axis=1)
+                    else:
+                        delta = predict_tree(bins, tree, cfg)
+                    return out + cfg.learning_rate * delta, None
+
+                shape = ((bins.shape[0], cfg.n_classes) if softmax
+                         else (bins.shape[0],))
+                out, _ = lax.scan(body, jnp.zeros(shape, jnp.float32),
+                                  stacked)
                 return out
 
             self._predict = run
         bins = np.asarray(bins, np.int32)
         self._check_bins_width(bins)
-        out = np.asarray(self._predict(jnp.asarray(bins), list(trees)))
+        out = np.asarray(self._predict(jnp.asarray(bins),
+                                       self._stack_trees(trees)))
         if not proba:
             return out
         if self.cfg.loss == "softmax":
@@ -879,6 +887,32 @@ class GBDTTrainer(DataParallelTrainer):
         e = np.exp(out[~pos])
         p[~pos] = e / (1.0 + e)
         return p
+
+    def _stack_trees(self, trees):
+        """Stack the per-round tree tuples into [T(, n_classes), ...]
+        component arrays so predict can ``lax.scan`` over the ensemble
+        (trees are fixed-shape tuples — SURVEY.md section 2 GBDT row).
+        Host-side fetch doubles as the non-addressable-device hop for
+        multi-process meshes."""
+        trees = list(trees)
+        if not trees:
+            # length-0 scan: margins stay at the zero init, matching the
+            # pre-scan contract for an untrained/zero-round ensemble
+            C = 2 ** self.cfg.depth
+            lead = ((0, self.cfg.n_classes)
+                    if self.cfg.loss == "softmax" else (0,))
+            return (jnp.zeros(lead + (C - 1,), jnp.int32),
+                    jnp.zeros(lead + (C - 1,), jnp.int32),
+                    jnp.zeros(lead + (C - 1,), jnp.int32),
+                    jnp.zeros(lead + (C,), jnp.float32))
+        if self.cfg.loss == "softmax":
+            return tuple(
+                jnp.asarray(np.stack(
+                    [[np.asarray(cls[j]) for cls in rnd] for rnd in trees]))
+                for j in range(4))
+        return tuple(
+            jnp.asarray(np.stack([np.asarray(t[j]) for t in trees]))
+            for j in range(4))
 
     def feature_importance(self, trees) -> np.ndarray:
         """Split-count feature importance over the ensemble (ytk-learn's
