@@ -13,7 +13,16 @@ X = rng.standard_normal((N, F)).astype(np.float32)
 y = ((X[:, 0] > 0).astype(np.float32)
      + 0.1 * rng.standard_normal(N).astype(np.float32))
 
-bins = QuantileBinner(B).fit_transform(X)       # continuous -> bin ids
+# continuous -> bin ids. Binning is fit DISTRIBUTED-style: each data
+# shard is sketched independently (per-feature quantile CDF + count)
+# and the sketches merge into one set of edges — on a real multi-host
+# job the same two calls run per rank with the sketches riding one
+# allgather (QuantileBinner.fit_distributed; check/checkdist.py).
+binner = QuantileBinner(B)
+sketches = [binner.local_sketch(s) for s in np.array_split(X, 4)]
+binner.merge_sketches(np.stack([e for e, _ in sketches]),
+                      np.stack([c for _, c in sketches]))
+bins = binner.transform(X)
 
 cfg = GBDTConfig(n_features=F, n_bins=B, depth=4, n_trees=5,
                  learning_rate=0.3)

@@ -144,3 +144,143 @@ def test_continuous_end_to_end(rng):
     preds = tr.predict(bins, trees)
     np.testing.assert_allclose(preds, train_preds[:N], rtol=1e-4,
                                atol=1e-5)
+
+
+# ------------------------------------------------------- distributed fit
+def _quantile_positions(X, edges):
+    """Empirical CDF position of each edge: |F_hat(edge) - target q|
+    is the natural error metric for a quantile sketch."""
+    F = X.shape[1]
+    pos = np.empty_like(edges)
+    for f in range(F):
+        col = np.sort(X[:, f][np.isfinite(X[:, f])])
+        pos[f] = np.searchsorted(col, edges[f], side="right") / len(col)
+    return pos
+
+
+def test_merge_sketches_matches_single_host(rng):
+    """Weighted quantile-of-quantiles: merged edges must land within
+    2/Q of the target quantile positions (documented tolerance; the
+    approximation error is O(1/Q) in quantile space)."""
+    N, F, B, R = 40_000, 5, 32, 4
+    X = np.stack([
+        rng.standard_normal(N),
+        rng.lognormal(0.0, 1.0, N),
+        rng.uniform(-5, 5, N),
+        rng.standard_normal(N) * 100 + 7,
+        np.where(rng.random(N) < 0.3, np.nan, rng.standard_normal(N)),
+    ], axis=1).astype(np.float32)
+    # unequal shard sizes
+    cuts = [0, 4_000, 14_000, 27_000, N]
+    shards = [X[cuts[i]:cuts[i + 1]] for i in range(R)]
+
+    b = QuantileBinner(B)
+    sk = [b.local_sketch(s, sample=None) for s in shards]
+    b.merge_sketches(np.stack([e for e, _ in sk]),
+                     np.stack([c for _, c in sk]))
+    qs = np.arange(1, B) / B
+    pos = _quantile_positions(X, b.edges)
+    err = np.abs(pos - qs[None, :]).max()
+    assert err < 2.0 / B, err
+    # and the exact fit passes the same bar much more tightly
+    exact = QuantileBinner(B).fit(X, sample=None)
+    pos_e = _quantile_positions(X, exact.edges)
+    assert np.abs(pos_e - qs[None, :]).max() < err
+
+
+def test_merge_sketch_feature_missing_on_some_ranks(rng):
+    """A feature with data on only one rank must still bin correctly:
+    NaN sketches carry zero weight in the merge."""
+    B, R = 8, 3
+    col = rng.standard_normal(9_000).astype(np.float32)
+    shards = []
+    for r in range(R):
+        s = np.empty((3_000, 2), np.float32)
+        s[:, 0] = rng.standard_normal(3_000)
+        s[:, 1] = np.nan if r != 1 else col[:3_000]
+        shards.append(s)
+    b = QuantileBinner(B)
+    sk = [b.local_sketch(s, sample=None) for s in shards]
+    b.merge_sketches(np.stack([e for e, _ in sk]),
+                     np.stack([c for _, c in sk]))
+    # feature 1's edges come purely from rank 1's data
+    want = QuantileBinner(B).fit(
+        shards[1][:, 1:2], sample=None).edges[0]
+    np.testing.assert_allclose(b.edges[1], want, rtol=1e-5, atol=1e-5)
+
+
+def test_merge_sketch_no_data_anywhere_raises():
+    b = QuantileBinner(4)            # Q+1 = 5 sketch points
+    edges = np.full((2, 1, 5), np.nan, np.float32)
+    counts = np.zeros((2, 1), np.float32)
+    with pytest.raises(Mp4jError, match="no non-missing"):
+        b.merge_sketches(edges, counts)
+
+
+def test_merge_sketch_edge_count_mismatch_raises():
+    b = QuantileBinner(8)            # needs Q+1 = 9 points per feature
+    with pytest.raises(Mp4jError):
+        b.merge_sketches(np.zeros((2, 1, 3), np.float32),
+                         np.ones((2, 1), np.float32))
+
+
+def test_fit_distributed_over_socket_backend(rng):
+    """fit_distributed on the real socket backend: every rank ends with
+    identical edges matching the host-side merge of the same shards."""
+    from helpers import run_slaves
+
+    N, F, B, R = 8_000, 3, 16, 4
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    shards = np.array_split(X, R)
+
+    def job(slave, rank):
+        binner = QuantileBinner(B).fit_distributed(
+            shards[rank], slave, sample=None)
+        return binner.edges
+
+    results = run_slaves(R, job)
+    for e in results[1:]:
+        np.testing.assert_array_equal(e, results[0])
+    b = QuantileBinner(B)
+    sk = [b.local_sketch(s, sample=None) for s in shards]
+    b.merge_sketches(np.stack([e for e, _ in sk]),
+                     np.stack([c for _, c in sk]))
+    np.testing.assert_allclose(results[0], b.edges, rtol=1e-6, atol=1e-6)
+
+
+def test_local_sketch_weight_is_full_shard_count(rng):
+    """Merge weights must reflect the FULL shard size even when the
+    sketch itself is computed on a row sample — otherwise a large
+    sampled shard weighs the same as a small unsampled one."""
+    X_big = rng.standard_normal((10_000, 2)).astype(np.float32) + 5.0
+    X_small = rng.standard_normal((1_000, 2)).astype(np.float32) - 5.0
+    b = QuantileBinner(8)
+    sk_big, c_big = b.local_sketch(X_big, sample=500, seed=0)
+    sk_small, c_small = b.local_sketch(X_small, sample=500, seed=0)
+    np.testing.assert_array_equal(c_big, [10_000, 10_000])
+    np.testing.assert_array_equal(c_small, [1_000, 1_000])
+    b.merge_sketches(np.stack([sk_big, sk_small]),
+                     np.stack([c_big, c_small]))
+    # 10:1 mass -> the median edge must sit in the big shard's mode
+    mid = b.edges[0][len(b.edges[0]) // 2]
+    assert mid > 3.0, mid
+
+
+def test_local_sketch_inf_sentinels(rng):
+    """inf sentinels are data (as in fit): the sketch stays monotone
+    and a single-rank merge keeps the inf top edges."""
+    col = np.concatenate([rng.standard_normal(1000).astype(np.float32),
+                          np.full(300, np.inf, np.float32)])
+    X = col[:, None]
+    b = QuantileBinner(8)
+    sk, c = b.local_sketch(X, sample=None)
+    assert c[0] == 1300
+    assert not np.isnan(sk).any()
+    assert (sk[0][1:] >= sk[0][:-1]).all(), sk   # inf-safe monotonicity
+    b.merge_sketches(sk[None], c[None])
+    want = QuantileBinner(8).fit(X, sample=None).edges[0]
+    # both must agree on which edges are inf, and on the finite ones
+    np.testing.assert_array_equal(np.isinf(b.edges[0]), np.isinf(want))
+    f = np.isfinite(want)
+    np.testing.assert_allclose(b.edges[0][f], want[f], rtol=1e-5,
+                               atol=1e-5)

@@ -233,6 +233,90 @@ def check_ffm_global_mesh(comm) -> int:
     return fails
 
 
+def check_binning_dist(comm) -> int:
+    """Distributed quantile binning at DCN scale: each process sketches
+    its own shard, ONE allgather merges the sketches, and every rank
+    must end with (a) identical edges and (b) edges within 2/Q of the
+    exact quantile positions of the pooled data (the merge's documented
+    tolerance, tests/test_binning.py)."""
+    from ytk_mp4j_tpu.models.binning import QuantileBinner
+    from ytk_mp4j_tpu.operands import Operands
+
+    fails = 0
+    rng = np.random.default_rng(99)             # same data everywhere
+    N, F, B = 6_000, 3, 16
+    X = np.stack([rng.standard_normal(N),
+                  rng.lognormal(0.0, 1.0, N),
+                  rng.uniform(-2, 9, N)], axis=1).astype(np.float32)
+    shards = np.array_split(X, comm.slave_num)
+    binner = QuantileBinner(B).fit_distributed(
+        shards[comm.rank], comm, sample=None)
+
+    flat = binner.edges.ravel().astype(np.float32)
+    buf = np.zeros(comm.slave_num * flat.size, np.float32)
+    buf[comm.rank * flat.size: (comm.rank + 1) * flat.size] = flat
+    comm.allgather_array(buf, Operands.FLOAT)
+    rows = buf.reshape(comm.slave_num, flat.size)
+    if not all(np.array_equal(rows[0], r) for r in rows[1:]):
+        comm.error("binning edges DIFFER across ranks")
+        fails += 1
+
+    qs = np.arange(1, B) / B
+    err = 0.0
+    for f in range(F):
+        col = np.sort(X[:, f])
+        pos = np.searchsorted(col, binner.edges[f], side="right") / N
+        err = max(err, float(np.abs(pos - qs).max()))
+    if err > 2.0 / B:
+        comm.error(f"binning quantile error {err:.4f} > {2.0 / B:.4f}")
+        fails += 1
+    return fails
+
+
+def check_dense_plane_timing(comm, elems: int = 1 << 20) -> int:
+    """A/B the dense data plane: device psum vs the host
+    allgather+loop formulation on the same buffer. Correctness is
+    asserted; the timing is logged (loopback CPU timings are noisy —
+    the recorded numbers live in BASELINE.md)."""
+    import time
+
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    rng = np.random.default_rng(7 + comm.rank)
+    base = rng.standard_normal(elems).astype(np.float32)
+    reps = 3
+
+    # warm both paths first: the device path jit-compiles on first use
+    comm.allreduce_array(base.copy(), Operands.FLOAT, Operators.SUM)
+    comm._reduce_rows(comm._allgather_rows(base.copy()), Operators.SUM)
+
+    dev = None
+    comm.barrier()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        dev = base.copy()
+        comm.allreduce_array(dev, Operands.FLOAT, Operators.SUM)
+    t_dev = (time.perf_counter() - t0) / reps
+
+    host = None
+    comm.barrier()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        rows = comm._allgather_rows(base.copy())
+        host = comm._reduce_rows(rows, Operators.SUM)
+    t_host = (time.perf_counter() - t0) / reps
+
+    fails = 0
+    if not np.allclose(dev, host, rtol=1e-5, atol=1e-5):
+        comm.error("dense-plane device vs host MISMATCH")
+        fails += 1
+    comm.info(f"dense plane {elems} f32 x {comm.slave_num} ranks: "
+              f"device {t_dev * 1e3:.1f} ms, host-allgather "
+              f"{t_host * 1e3:.1f} ms ({t_host / max(t_dev, 1e-9):.2f}x)")
+    return fails
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True, help="host:port")
@@ -264,6 +348,8 @@ def main(argv=None) -> int:
         fails += check_global_mesh(comm)
         fails += check_gbdt_global_mesh(comm)
         fails += check_ffm_global_mesh(comm)
+        fails += check_binning_dist(comm)
+        fails += check_dense_plane_timing(comm)
         comm.info(f"checkdist done: {fails} failures")
         comm.close(0 if fails == 0 else 1)
         # job-wide verdict: root-only checks fail on rank 0 alone, so

@@ -8,11 +8,17 @@ device as a one-hot-free comparison count — ``bin(x) = #edges <= x`` —
 which is N*F*B VPU lane-ops, the same shape as one histogram level, and
 avoids the serial gather unit a searchsorted would use.
 
-Distributed fitting: each rank can fit edges on its shard and
-``allreduce`` the per-feature quantile sketches by simple averaging
-(quantile-of-quantiles approximation), or fit on rank 0 and broadcast —
-`QuantileBinner.fit` takes the whole matrix and is cheap enough for the
-ytk-learn-scale datasets (one numpy quantile pass).
+Distributed fitting (``fit_distributed``): each rank sketches its own
+shard — per-feature quantile edges plus finite-value counts — and the
+fixed-size sketches ride ONE ``allgather_array`` on any SPMD backend
+(``ProcessCommSlave`` / ``ThreadCommSlave`` / ``DistributedComm``);
+every rank then merges the pooled sketches identically (weighted
+quantile-of-quantiles), so all ranks end with the same edges without
+ever centralizing raw features. The merge is a documented
+approximation: each rank's j-th edge is treated as a point mass of
+weight ``count_r / (Q-1)`` and the merged edges are weighted quantiles
+of the pooled points — error is O(1/Q) in quantile space (tested
+against the single-host fit in ``tests/test_binning.py``).
 """
 
 from __future__ import annotations
@@ -90,6 +96,108 @@ class QuantileBinner:
         # only by x = +inf (x >= inf), which belongs in the top bins
         self.edges = np.where(np.isnan(edges), np.float32(np.inf), edges)
         return self
+
+    def local_sketch(self, X_shard, sample: int | None = 1_000_000,
+                     seed: int = 0):
+        """Per-rank half of the distributed fit: this shard's quantile
+        sketch ``[min, q_{1/Q}, ..., q_{(Q-1)/Q}, max]`` ([F, Q+1] —
+        the known CDF grid [0, 1/Q, ..., 1] makes the sketch a
+        piecewise-linear CDF) plus per-feature finite-value counts [F]
+        (f32 — exact to 2**24 rows; beyond that the merge WEIGHT is
+        approximate, which is harmless). A feature with no finite
+        values on THIS shard yields NaN rows and count 0 — legal
+        locally, resolved at merge (another rank may hold its data)."""
+        X = np.asarray(X_shard, np.float32)
+        if X.ndim != 2:
+            raise Mp4jError(f"X must be [N, F], got {X.shape}")
+        # merge weight = the FULL shard's data count (NaN = missing is
+        # excluded; inf sentinels are data, exactly as in fit) — it must
+        # be taken before sampling, or a 10M-row shard sampled to 1M
+        # would weigh the same as a true 1M-row shard in the merge
+        counts = (~np.isnan(X)).sum(axis=0).astype(np.float32)
+        if sample is not None and X.shape[0] > sample:
+            idx = np.random.default_rng(seed).choice(
+                X.shape[0], sample, replace=False)
+            X = X[idx]
+        nb = self.n_bins - 1 if self.missing_bucket else self.n_bins
+        qs = np.arange(1, nb) / nb
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            inner = np.nanquantile(X, qs, axis=0).T
+            lo = np.nanmin(X, axis=0)
+            hi = np.nanmax(X, axis=0)
+        # same inf rule as fit(): quantiles straddling inf sentinels
+        # interpolate to NaN; +inf keeps the sketch monotone (hi
+        # includes the inf itself, so [.., inf, .., inf] stays ordered)
+        inner = np.where(np.isnan(inner), np.inf, inner)
+        sketch = np.concatenate(
+            [lo[:, None], inner, hi[:, None]], axis=1).astype(np.float32)
+        # a shard whose feature is all-NaN contributes a NaN sketch row
+        # with count 0 — merge_sketches skips it by the count
+        return sketch, counts
+
+    def merge_sketches(self, sketch_stack, counts_stack):
+        """Merge per-rank sketches into fitted edges (identical on
+        every caller). Each rank's sketch is a piecewise-linear CDF
+        (grid [0, 1/Q, ..., 1] over its Q+1 points); the pooled CDF is
+        their count-weighted average, evaluated at the union of all
+        sketch points and inverted at the target quantiles. Exact when
+        one rank holds all of a feature's data; O(1/Q)-in-quantile-
+        space otherwise (tested in tests/test_binning.py).
+        [R, F, Q+1] sketches + [R, F] counts -> self fitted."""
+        sketch_stack = np.asarray(sketch_stack, np.float32)
+        counts_stack = np.asarray(counts_stack, np.float32)
+        R, F, E = sketch_stack.shape
+        nb = self.n_bins - 1 if self.missing_bucket else self.n_bins
+        if E != nb + 1:
+            raise Mp4jError(
+                f"sketch has {E} points per feature; this binner needs "
+                f"{nb + 1} (n_bins mismatch?)")
+        no_data = (counts_stack <= 0).all(axis=0)
+        if no_data.any():
+            raise Mp4jError(
+                f"features {np.flatnonzero(no_data).tolist()} have no "
+                "non-missing values on any rank")
+        grid = np.arange(E) / nb                     # [0, 1/Q, ..., 1]
+        qs = grid[1:-1]
+        merged = np.empty((F, nb - 1), np.float32)
+        for f in range(F):
+            live = counts_stack[:, f] > 0
+            w = counts_stack[live, f]
+            w = w / w.sum()
+            pts = np.sort(sketch_stack[live, f].ravel())
+            # pooled CDF at every sketch point: count-weighted average
+            # of the per-rank piecewise-linear CDFs (0 left, 1 right)
+            cdf = np.zeros(pts.shape)
+            for r_w, r_sk in zip(w, sketch_stack[live, f]):
+                cdf += r_w * np.interp(pts, r_sk, grid, left=0.0,
+                                       right=1.0)
+            merged[f] = np.interp(qs, cdf, pts)
+        self.edges = np.where(np.isnan(merged), np.float32(np.inf),
+                              merged)
+        return self
+
+    def fit_distributed(self, X_shard, comm,
+                        sample: int | None = 1_000_000, seed: int = 0):
+        """SPMD distributed fit: every rank calls this with ITS OWN
+        shard and an mp4j comm exposing ``rank`` / ``slave_num`` /
+        ``allgather_array`` (socket, thread, and jax.distributed
+        backends all do). One fixed-size allgather moves the sketches;
+        raw features never leave their rank. All ranks return fitted
+        with identical edges."""
+        from ytk_mp4j_tpu.operands import Operands
+
+        edges, counts = self.local_sketch(X_shard, sample, seed)
+        F, E = edges.shape
+        n, r = comm.slave_num, comm.rank
+        seg = F * E + F
+        buf = np.zeros(n * seg, np.float32)
+        buf[r * seg: r * seg + F * E] = edges.ravel()
+        buf[r * seg + F * E: (r + 1) * seg] = counts
+        comm.allgather_array(buf, Operands.FLOAT)
+        rows = buf.reshape(n, seg)
+        return self.merge_sketches(
+            rows[:, : F * E].reshape(n, F, E), rows[:, F * E:])
 
     def transform(self, X) -> np.ndarray:
         """Continuous [N, F] -> int32 bin ids in [0, n_bins).
