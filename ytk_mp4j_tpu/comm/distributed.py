@@ -12,10 +12,14 @@ Two layers are exposed here:
 - :func:`init_distributed` + :class:`DistributedComm` — a host-level
   slave mirroring the ``ProcessCommSlave`` API (rank / slave_num /
   barrier / info / close + the 7 collectives x {array, map}) where each
-  RANK IS A PROCESS (host). Array payloads ride device collectives via
-  ``multihost_utils``; map operands are pickled and exchanged as padded
-  byte buffers (the Kryo analogue at DCN scale). This is the
-  control-plane / host-data path — convenient, not the perf path.
+  RANK IS A PROCESS (host). Dense reduce/allreduce/reduce-scatter with
+  the built-in SUM/MAX/MIN ride ONE device collective (psum / pmax /
+  pmin / psum_scatter over a one-device-per-process mesh — 2L(n-1)/n
+  wire bytes); PROD, custom operators, and the gather family use
+  ``multihost_utils`` allgather; map operands are pickled and exchanged
+  as padded byte buffers (the Kryo analogue at DCN scale). This is the
+  host-data path — device-resident perf work belongs on the meshes
+  below.
 - :func:`global_mesh` / :func:`hier_global_mesh` — mesh builders over
   ALL processes' devices for the perf path: user jit code with
   ``shard_map`` + ``ops.collectives`` (and the model families) runs
@@ -96,6 +100,8 @@ class DistributedComm(CommSlave):
         self._n = jax.process_count()
         self._closed = False
         self.final_code: int | None = None  # set by close()
+        self._pmesh: Mesh | None = None
+        self._djits: dict = {}
 
     # -- identity / control plane --------------------------------------
     @property
@@ -193,6 +199,60 @@ class DistributedComm(CommSlave):
             acc = operator.np_fn(acc, rows[p])
         return acc
 
+    # -- device data plane ---------------------------------------------
+    # One device collective (psum / pmax / pmin / psum_scatter) over a
+    # one-device-per-process mesh replaces allgather + host loop for the
+    # built-in operators: n*L wire bytes become the collective's
+    # 2L(n-1)/n. PROD and custom operators keep the allgather path —
+    # XLA has no pprod/custom all-reduce primitive, and a log/exp
+    # rewrite would change float semantics.
+    _DEVICE_REDUCERS = {"SUM": "psum", "MAX": "pmax", "MIN": "pmin"}
+
+    def _proc_mesh(self) -> Mesh:
+        if self._pmesh is None:
+            per_proc: dict[int, object] = {}
+            for d in sorted(jax.devices(),
+                            key=lambda d: (d.process_index, d.id)):
+                per_proc.setdefault(d.process_index, d)
+            self._pmesh = Mesh(
+                np.asarray([per_proc[p] for p in range(self._n)]),
+                ("proc",))
+        return self._pmesh
+
+    def _device_rows_collective(self, kind: str, block: np.ndarray,
+                                op_name: str) -> np.ndarray:
+        """Run ONE device collective over per-process [L] blocks.
+        kind="allreduce" returns the reduced [L]; kind="reduce_scatter"
+        expects [n*B] (n equal blocks) and returns this rank's [B]."""
+        from functools import partial
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._proc_mesh()
+        sharding = NamedSharding(mesh, P("proc"))
+        key = (kind, op_name, block.dtype.str, block.size)
+        fn = self._djits.get(key)
+        if fn is None:
+            if kind == "allreduce":
+                red = getattr(lax, self._DEVICE_REDUCERS[op_name])
+
+                def body(x):
+                    return red(x[0], "proc")[None]
+            else:
+                def body(x):
+                    return lax.psum_scatter(
+                        x[0].reshape(self._n, -1), "proc")[None]
+            # the psum output is replicated but rides back under the
+            # row sharding (each rank reads its own copy) — same
+            # check_vma waiver as the driver backend
+            fn = jax.jit(partial(
+                jax.shard_map, mesh=mesh, check_vma=False,
+                in_specs=P("proc"), out_specs=P("proc"))(body))
+            self._djits[key] = fn
+        garr = jax.make_array_from_process_local_data(
+            sharding, block[None, :], (self._n, block.size))
+        return np.asarray(fn(garr).addressable_data(0))[0]
+
     # -- dense-array collectives ---------------------------------------
     def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
                         operator: Operator = Operators.SUM,
@@ -200,6 +260,11 @@ class DistributedComm(CommSlave):
         self._assert_open()
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
+            return arr
+        if operator.name in self._DEVICE_REDUCERS:
+            arr[lo:hi] = self._device_rows_collective(
+                "allreduce", np.ascontiguousarray(arr[lo:hi]),
+                operator.name)
             return arr
         rows = self._allgather_rows(np.ascontiguousarray(arr[lo:hi]))
         arr[lo:hi] = self._reduce_rows(rows, operator)
@@ -212,6 +277,13 @@ class DistributedComm(CommSlave):
         self._check_root(root)
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
+            return arr
+        if operator.name in self._DEVICE_REDUCERS:
+            merged = self._device_rows_collective(
+                "allreduce", np.ascontiguousarray(arr[lo:hi]),
+                operator.name)
+            if self._rank == root:
+                arr[lo:hi] = merged
             return arr
         rows = self._allgather_rows(np.ascontiguousarray(arr[lo:hi]))
         if self._rank == root:
@@ -292,10 +364,31 @@ class DistributedComm(CommSlave):
         ranges = self._norm_ranges(arr, ranges)
         if self._n == 1:
             return arr
+        s, e = ranges[self._rank]
+        if operator.name == "SUM":
+            # device psum_scatter over the (possibly uneven) ranges:
+            # pack each range into an identity-padded equal block so
+            # shard r's scattered segment IS range r
+            B = max(1, max(re - rs for rs, re in ranges))
+            blocks = np.full(self._n * B, operator.identity(arr.dtype),
+                             dtype=arr.dtype)
+            for r, (rs, re) in enumerate(ranges):
+                blocks[r * B: r * B + (re - rs)] = arr[rs:re]
+            mine = self._device_rows_collective("reduce_scatter", blocks,
+                                                operator.name)
+            arr[s:e] = mine[: e - s]
+            return arr
+        if operator.name in self._DEVICE_REDUCERS:
+            # no pmax/pmin-scatter primitive: device allreduce + slice
+            lo, hi = ranges[0][0], ranges[-1][1]
+            merged = self._device_rows_collective(
+                "allreduce", np.ascontiguousarray(arr[lo:hi]),
+                operator.name)
+            arr[s:e] = merged[s - lo: e - lo]
+            return arr
         lo, hi = ranges[0][0], ranges[-1][1]
         rows = self._allgather_rows(np.ascontiguousarray(arr[lo:hi]))
         merged = self._reduce_rows(rows, operator)
-        s, e = ranges[self._rank]
         arr[s:e] = merged[s - lo: e - lo]
         return arr
 

@@ -665,6 +665,7 @@ class GBDTTrainer(DataParallelTrainer):
         self._step = None
         self._predict = None
         self._margin_step = None
+        self._stacked_trees = None
         self.eval_history_: list[float] = []
 
     def _build_step(self):
@@ -893,8 +894,14 @@ class GBDTTrainer(DataParallelTrainer):
         component arrays so predict can ``lax.scan`` over the ensemble
         (trees are fixed-shape tuples — SURVEY.md section 2 GBDT row).
         Host-side fetch doubles as the non-addressable-device hop for
-        multi-process meshes."""
+        multi-process meshes. The stacked tuple is cached by tree
+        identity (holding the list keeps ids stable), so repeated
+        predict() on the same ensemble pays the O(T) fetch once."""
         trees = list(trees)
+        cached = self._stacked_trees
+        if (cached is not None and len(cached[0]) == len(trees)
+                and all(a is b for a, b in zip(cached[0], trees))):
+            return cached[1]
         if not trees:
             # length-0 scan: margins stay at the zero init, matching the
             # pre-scan contract for an untrained/zero-round ensemble
@@ -906,13 +913,16 @@ class GBDTTrainer(DataParallelTrainer):
                     jnp.zeros(lead + (C - 1,), jnp.int32),
                     jnp.zeros(lead + (C,), jnp.float32))
         if self.cfg.loss == "softmax":
-            return tuple(
+            stacked = tuple(
                 jnp.asarray(np.stack(
                     [[np.asarray(cls[j]) for cls in rnd] for rnd in trees]))
                 for j in range(4))
-        return tuple(
-            jnp.asarray(np.stack([np.asarray(t[j]) for t in trees]))
-            for j in range(4))
+        else:
+            stacked = tuple(
+                jnp.asarray(np.stack([np.asarray(t[j]) for t in trees]))
+                for j in range(4))
+        self._stacked_trees = (trees, stacked)
+        return stacked
 
     def feature_importance(self, trees) -> np.ndarray:
         """Split-count feature importance over the ensemble (ytk-learn's
