@@ -208,6 +208,19 @@ class DistributedComm(CommSlave):
     # rewrite would change float semantics.
     _DEVICE_REDUCERS = {"SUM": "psum", "MAX": "pmax", "MIN": "pmin"}
 
+    def _device_reduce_ok(self, operator: Operator) -> bool:
+        """SUM always lowers natively; MAX/MIN only where the probe (or
+        the MP4J_NATIVE_REDUCE / set_native_reduce overrides) says the
+        backend accepts non-SUM all-reduce HLO — the same gate every
+        other collective honors (axon rejected pmax/pmin in round 1).
+        False falls back to the allgather + host-reduce path."""
+        if operator.name not in self._DEVICE_REDUCERS:
+            return False
+        from ytk_mp4j_tpu.ops import collectives as coll
+        ok = coll.resolve_native_reduce(
+            operator, devices=self._proc_mesh().devices.flat)
+        return ok is None or ok
+
     def _proc_mesh(self) -> Mesh:
         if self._pmesh is None:
             per_proc: dict[int, object] = {}
@@ -261,7 +274,7 @@ class DistributedComm(CommSlave):
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
             return arr
-        if operator.name in self._DEVICE_REDUCERS:
+        if self._device_reduce_ok(operator):
             arr[lo:hi] = self._device_rows_collective(
                 "allreduce", np.ascontiguousarray(arr[lo:hi]),
                 operator.name)
@@ -278,7 +291,7 @@ class DistributedComm(CommSlave):
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
             return arr
-        if operator.name in self._DEVICE_REDUCERS:
+        if self._device_reduce_ok(operator):
             merged = self._device_rows_collective(
                 "allreduce", np.ascontiguousarray(arr[lo:hi]),
                 operator.name)
@@ -378,7 +391,7 @@ class DistributedComm(CommSlave):
                                                 operator.name)
             arr[s:e] = mine[: e - s]
             return arr
-        if operator.name in self._DEVICE_REDUCERS:
+        if self._device_reduce_ok(operator):
             # no pmax/pmin-scatter primitive: device allreduce + slice
             lo, hi = ranges[0][0], ranges[-1][1]
             merged = self._device_rows_collective(

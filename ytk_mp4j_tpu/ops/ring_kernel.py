@@ -73,12 +73,34 @@ _LANES = 128
 # minimum sublane count per dtype byte-width (Mosaic tiling table)
 _SUBLANE = {8: 8, 4: 8, 2: 16, 1: 32}
 
+# Every barrier-using kernel variant gets its OWN Mosaic barrier
+# semaphore: two variants in one program (examples/08 runs the uni- and
+# bidirectional kernels in one jit) would otherwise share collective_id
+# 0's semaphore, which is safe only because SPMD sequences side-
+# effecting calls identically — distinct ids remove the reliance on
+# that (ADVICE round-2).
+_COLLECTIVE_IDS = {
+    ("uni", "allreduce"): 0,
+    ("uni", "reduce_scatter"): 1,
+    ("uni", "allgather"): 2,
+    ("bidir", "allreduce"): 3,
+    ("bidir", "reduce_scatter"): 4,
+    ("bidir", "allgather"): 5,
+}
+
 
 def min_chunk_elems(dtype) -> int:
     """Compiled-path chunk-size granule: one full (sublane x lane)
     tile of ``dtype``. Callers padding for ``algo='rdma'`` align to
-    this."""
-    return _LANES * _SUBLANE.get(jnp.dtype(dtype).itemsize, 8)
+    this. Byte widths outside the Mosaic tiling table are rejected
+    here rather than failing later with an opaque Mosaic error."""
+    itemsize = jnp.dtype(dtype).itemsize
+    if itemsize not in _SUBLANE:
+        raise Mp4jError(
+            f"dtype {jnp.dtype(dtype).name} (itemsize {itemsize}) has no "
+            "entry in the Mosaic sublane tiling table; the RDMA ring "
+            f"kernels support itemsizes {sorted(_SUBLANE)}")
+    return _LANES * _SUBLANE[itemsize]
 
 
 def round_up_chunk(n_elems: int, dtype, interpret: bool = False) -> int:
@@ -244,8 +266,9 @@ def _pallas_ring(x2d, out_rows, mode, op_fn, n, rows, axis_name,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),  # slot-free credits
         ],
-        compiler_params=pltpu.CompilerParams(has_side_effects=True,
-                                             collective_id=0),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=_COLLECTIVE_IDS[("uni", mode)]),
         interpret=interpret,
     )(x2d)
 
@@ -382,8 +405,9 @@ def _pallas_ring_bidir(x2d, out_rows, mode, op_fn, n, rows2, axis_name,
             pltpu.SemaphoreType.REGULAR((2,)),  # CW slot-free credits
             pltpu.SemaphoreType.REGULAR((2,)),  # CCW slot-free credits
         ],
-        compiler_params=pltpu.CompilerParams(has_side_effects=True,
-                                             collective_id=0),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=_COLLECTIVE_IDS[("bidir", mode)]),
         interpret=interpret,
     )(x2d)
 
