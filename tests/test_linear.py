@@ -128,3 +128,75 @@ def test_eval_set_and_early_stopping(rng):
 
     with pytest.raises(Mp4jError):
         tr2.fit(x, y, n_steps=3, early_stopping_rounds=2)
+
+
+def test_softmax_multiclass_separates(rng):
+    """ytk-learn multiclass_linear analogue: 3 linearly separable
+    classes; loss decreases, accuracy is high, probabilities are rows
+    of a stochastic matrix."""
+    N, F, C = 1200, 4, 3
+    centers = np.array([[3, 0, 0, 0], [0, 3, 0, 0], [0, 0, 3, 0]],
+                       np.float32)
+    y = rng.integers(0, C, N).astype(np.int32)
+    x = centers[y] + rng.standard_normal((N, F)).astype(np.float32)
+    cfg = LinearConfig(n_features=F, loss="softmax", n_classes=C,
+                       learning_rate=0.5)
+    tr = LinearTrainer(cfg, n_devices=4)
+    params, losses = tr.fit(x, y, n_steps=60)
+    assert losses[-1] < losses[0] * 0.5
+    p = tr.predict(params, x)
+    assert p.shape == (N, C)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    assert (p.argmax(1) == y).mean() > 0.9
+
+
+def test_softmax_distributed_matches_single_device(rng):
+    N, F, C = 203, 3, 4                       # uneven N exercises padding
+    x = rng.standard_normal((N, F)).astype(np.float32)
+    y = rng.integers(0, C, N).astype(np.int32)
+    cfg = LinearConfig(n_features=F, loss="softmax", n_classes=C,
+                       learning_rate=0.3, l2=1e-3, momentum=0.5)
+    p1, l1 = LinearTrainer(cfg, n_devices=1).fit(x, y, n_steps=10)
+    p8, l8 = LinearTrainer(cfg, n_devices=8).fit(x, y, n_steps=10)
+    for a, b in zip(p1, p8):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(l1, l8, rtol=2e-5, atol=2e-6)
+
+
+def test_softmax_label_validation(rng):
+    cfg = LinearConfig(n_features=2, loss="softmax", n_classes=3)
+    tr = LinearTrainer(cfg, n_devices=1)
+    x = rng.standard_normal((10, 2)).astype(np.float32)
+    with pytest.raises(Mp4jError, match="softmax labels"):
+        tr.fit(x, np.full(10, 3, np.int32), n_steps=1)
+    with pytest.raises(Mp4jError):
+        LinearConfig(n_features=2, loss="softmax", n_classes=1)
+
+
+def test_softmax_loss_matches_numpy(rng):
+    """per_example_loss('softmax') against a plain numpy cross entropy."""
+    from ytk_mp4j_tpu.models._base import per_example_loss
+    import jax.numpy as jnp
+
+    N, C = 64, 5
+    z = rng.standard_normal((N, C)).astype(np.float32) * 10
+    y = rng.integers(0, C, N)
+    got = np.asarray(per_example_loss(jnp.asarray(z), jnp.asarray(y),
+                                      "softmax"))
+    m = z.max(axis=1, keepdims=True)
+    lse = (m[:, 0] + np.log(np.exp(z - m).sum(axis=1)))
+    want = lse - z[np.arange(N), y]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_column_vector_labels_rejected(rng):
+    """[N, 1] labels would broadcast through the loss to an [N, N]
+    matrix and train silently on garbage — must raise, for every loss."""
+    x = rng.standard_normal((10, 2)).astype(np.float32)
+    for loss, kw in (("squared", {}), ("logistic", {}),
+                     ("softmax", {"n_classes": 2})):
+        tr = LinearTrainer(LinearConfig(n_features=2, loss=loss, **kw),
+                           n_devices=1)
+        with pytest.raises(Mp4jError, match="1-D"):
+            tr.fit(x, np.zeros((10, 1)), n_steps=1)

@@ -23,11 +23,35 @@ def per_example_loss(z, y, loss: str):
 
     ``logistic``: softplus-form logloss on {0, 1} labels, written as
     ``max(z, 0) - z y + log1p(exp(-|z|))`` for overflow-free evaluation
-    at large |z|. ``squared``: 0.5 (z - y)^2.
+    at large |z|. ``squared``: 0.5 (z - y)^2. ``softmax``: cross
+    entropy over ``z`` [N, C] with integer labels — the true-class
+    logit is selected by a one-hot dot, not a per-row gather (the
+    serial gather unit; same choice as the GBDT routing).
     """
     if loss == "logistic":
         return jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    if loss == "softmax":
+        lse = jax.nn.logsumexp(z, axis=-1)
+        zy = jnp.sum(
+            z * jax.nn.one_hot(y, z.shape[-1], dtype=z.dtype), axis=-1)
+        return lse - zy
     return 0.5 * (z - y) ** 2
+
+
+def stage_softmax_labels(y, n_classes: int) -> "np.ndarray":
+    """Validate + cast integer class labels, shared by every softmax
+    trainer (linear, GBDT): out-of-range ids would one-hot to silent
+    garbage, so they must be an error."""
+    import numpy as np
+
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+
+    y = np.asarray(y, np.int32)
+    if y.size and (y.min() < 0 or y.max() >= n_classes):
+        raise Mp4jError(
+            f"softmax labels must lie in [0, {n_classes}), got range "
+            f"[{y.min()}, {y.max()}]")
+    return y
 
 
 def save_npz(path: str, cfg, arrays: dict) -> None:

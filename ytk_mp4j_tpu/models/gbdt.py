@@ -34,8 +34,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ytk_mp4j_tpu.models._base import (DataParallelTrainer,
-                                       EarlyStopper, per_example_loss)
+from ytk_mp4j_tpu.models._base import (DataParallelTrainer, EarlyStopper,
+                                       per_example_loss,
+                                       stage_softmax_labels)
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.ops.hist_kernel import split_bf16
 
@@ -741,12 +742,7 @@ class GBDTTrainer(DataParallelTrainer):
         if self._step is None:
             self._step = self._build_step()
         if self.cfg.loss == "softmax":
-            y = np.asarray(y, np.int32)
-            if y.size and (y.min() < 0 or y.max() >= self.cfg.n_classes):
-                raise Mp4jError(
-                    f"softmax labels must lie in [0, "
-                    f"{self.cfg.n_classes}), got range "
-                    f"[{y.min()}, {y.max()}]")
+            y = stage_softmax_labels(y, self.cfg.n_classes)
         else:
             y = np.asarray(y, np.float32)
         dbins, dy, dpreds, dw = self.shard_data(

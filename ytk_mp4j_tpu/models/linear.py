@@ -12,11 +12,13 @@ TPU-first rebuild: the whole optimization step — forward, loss, grad,
 with Kryo-socket recursive halving (SURVEY.md section 3b) is a single XLA
 ICI collective; parameters stay replicated, data stays sharded.
 
-Losses: ``squared`` (regression) and ``logistic`` (binary classification,
-labels in {0, 1}); L2 as a penalty gradient added before the momentum
-update (coupled, classic SGD-with-weight-penalty; the reported loss is
-the data term only), L1 via a proximal shrink after the step (so
-momentum still sees a smooth objective).
+Losses: ``squared`` (regression), ``logistic`` (binary classification,
+labels in {0, 1}), and ``softmax`` (ytk-learn's multiclass_linear
+family: w becomes [F, C], labels are int class ids); L2 as a penalty
+gradient added before the momentum update (coupled, classic
+SGD-with-weight-penalty; the reported loss is the data term only), L1
+via a proximal shrink after the step (so momentum still sees a smooth
+objective).
 """
 
 from __future__ import annotations
@@ -32,16 +34,18 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
-from ytk_mp4j_tpu.models._base import (DataParallelTrainer,
-                                       EarlyStopper, per_example_loss)
+from ytk_mp4j_tpu.models._base import (DataParallelTrainer, EarlyStopper,
+                                       per_example_loss,
+                                       stage_softmax_labels)
 
-LOSSES = ("squared", "logistic")
+LOSSES = ("squared", "logistic", "softmax")
 
 
 @dataclass(frozen=True)
 class LinearConfig:
     n_features: int
     loss: str = "squared"
+    n_classes: int = 2          # used by loss="softmax" only
     learning_rate: float = 0.1
     l1: float = 0.0
     l2: float = 0.0
@@ -50,6 +54,8 @@ class LinearConfig:
     def __post_init__(self):
         if self.loss not in LOSSES:
             raise Mp4jError(f"loss must be one of {LOSSES}, got {self.loss!r}")
+        if self.loss == "softmax" and self.n_classes < 2:
+            raise Mp4jError("softmax needs n_classes >= 2")
 
 
 def _mean_loss_grad(params, x, y, sample_w, cfg: LinearConfig, axis_name):
@@ -112,6 +118,8 @@ def predict(params, x, cfg: LinearConfig):
     z = x @ w + b
     if cfg.loss == "logistic":
         return jax.nn.sigmoid(z)
+    if cfg.loss == "softmax":
+        return jax.nn.softmax(z, axis=-1)
     return z
 
 
@@ -131,6 +139,11 @@ class LinearTrainer(DataParallelTrainer):
         self.eval_history_: list[float] = []
 
     def init_params(self):
+        if self.cfg.loss == "softmax":
+            # w [F, C], b [C]: ytk-learn's multiclass_linear family
+            return (jnp.zeros((self.cfg.n_features, self.cfg.n_classes),
+                              jnp.float32),
+                    jnp.zeros((self.cfg.n_classes,), jnp.float32))
         return (jnp.zeros((self.cfg.n_features,), jnp.float32),
                 jnp.zeros((), jnp.float32))
 
@@ -151,7 +164,7 @@ class LinearTrainer(DataParallelTrainer):
         """Pad + reshape to [n_shards, N/shard, ...]; padding rows carry
         sample weight 0 so results match unsharded runs for any N."""
         x = np.asarray(x, np.float32)
-        y = np.asarray(y, np.float32)
+        y = self._stage_labels(y)
         if x.ndim != 2 or x.shape[1] != self.cfg.n_features:
             raise Mp4jError(
                 f"x must be [N, {self.cfg.n_features}], got {x.shape}")
@@ -179,7 +192,7 @@ class LinearTrainer(DataParallelTrainer):
         va = None
         if eval_set is not None:
             x_va = np.asarray(eval_set[0], np.float32)
-            y_va = np.asarray(eval_set[1], np.float32)
+            y_va = self._stage_labels(eval_set[1])
             if x_va.ndim != 2 or x_va.shape[1] != self.cfg.n_features:
                 raise Mp4jError(
                     f"eval x must be [N, {self.cfg.n_features}], "
@@ -208,6 +221,19 @@ class LinearTrainer(DataParallelTrainer):
                     losses = losses[:stopper.best_round + 1]
                 break
         return params, np.asarray(jax.device_get(losses))
+
+    def _stage_labels(self, y) -> np.ndarray:
+        """Labels must be a flat [N] vector — a column-vector y would
+        broadcast through the loss to an [N, N] matrix and train
+        silently on garbage. softmax labels are additionally int32
+        class ids validated in range (stage_softmax_labels, shared
+        with the GBDT softmax path)."""
+        y = np.asarray(y)
+        if y.ndim != 1:
+            raise Mp4jError(f"y must be 1-D [N], got shape {y.shape}")
+        if self.cfg.loss != "softmax":
+            return y.astype(np.float32)
+        return stage_softmax_labels(y, self.cfg.n_classes)
 
     def _eval_loss(self, params, va) -> float:
         if self._eval_fn is None:
