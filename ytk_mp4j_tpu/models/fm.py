@@ -14,13 +14,14 @@ TPU-first rebuild. Instances are padded to ``max_nnz`` static slots
 - **dense mode** (default): the full embedding-table gradient rides one
   ``lax.psum`` — bandwidth ~|V| but maximally MXU/HBM friendly; right
   whenever the vocabulary fits comfortably on-chip.
-- **sparse mode** (``sparse_grads=True``): per-slot gradient rows are
-  packed as static-shape ``(row_index, grad_row)`` buffers and merged
-  with :func:`ytk_mp4j_tpu.ops.sparse.sparse_allreduce` (all_gather +
-  sort + segment-sum — the device-native analogue of the reference's
-  key-wise map merge), then scattered back into the table. Bandwidth
-  ~nnz instead of ~|V|: the TPU translation of the reference's sparse
-  map path.
+- **sparse mode** (``sparse_grads=True``): per-slot gradient rows ride
+  as static-shape ``(row_index, grad_row)`` buffers — ONE all_gather
+  each, then a single identity-dropping scatter-add into the table,
+  which merges duplicate rows natively (the device-native analogue of
+  the reference's key-wise map merge; the map API's sort + segment
+  pack would be pure overhead here — round-3 A/B in BASELINE.md,
+  64.2 -> 38.1 ms/step). Bandwidth ~nnz instead of ~|V|: the TPU
+  translation of the reference's sparse map path.
 
 Model scores (order-2, sigmoid/logloss for classification):
 
@@ -183,10 +184,13 @@ def train_step_sparse(params, batch, cfg: FMConfig, capacity: int,
     """One step; embedding gradients ride the SPARSE path.
 
     Instead of psum'ing the dense [rows, k] gradient table, each shard
-    packs its touched (row, grad_row) slots and the mesh merges them
-    with ``sparse_allreduce`` (bandwidth ~unique-touched, not ~|V|).
-    ``capacity`` is the static bound on global unique touched rows per
-    step.
+    ships its touched (row, grad_row) slots over ONE all_gather each
+    and the merged update is a single identity-dropping scatter-add
+    into V, which sums duplicate rows natively (bandwidth
+    ~touched-slots, not ~|V|). ``capacity`` is the static slot bound
+    the optional local dedupe packs into (it shrinks the all_gather
+    payload when capacity < S; nothing is ever dropped by the
+    scatter).
 
     The embedding table enters autodiff only through the GATHERED
     per-slot rows (``_score_from_slots``), so the backward yields the
@@ -211,10 +215,10 @@ def train_step_sparse(params, batch, cfg: FMConfig, capacity: int,
         gw = lax.psum(gw, axis_name)     # linear part stays dense (small)
 
     # Local duplicate-row merge (sort + segmented reduction) runs ONLY
-    # when it shrinks the collective payload (capacity < S): the
-    # collective's own sort/segment pass already merges duplicates, so
-    # an unconditional local merge would just sort everything twice
-    # (measured ~35 ms of pure overhead at S = 512k single-chip).
+    # when it shrinks the all_gather payload (capacity < S): the final
+    # scatter-add merges duplicates natively, so with capacity >= S
+    # the local sort would buy nothing (its round-2 incarnation
+    # measured ~35 ms of pure overhead at S = 512k single-chip).
     S = rows.size
     k = V.shape[1]
     flat_rows = rows.reshape(-1)
@@ -226,8 +230,15 @@ def train_step_sparse(params, batch, cfg: FMConfig, capacity: int,
     else:
         li, lv = flat_rows.astype(jnp.int32), flat_g
     if axis_name is not None:
-        oi, ov = sparse_ops.sparse_allreduce(
-            li, lv, capacity, Operators.SUM, axis_name)
+        # NOT sparse_allreduce: its post-gather sort + segment reduce
+        # packs unique keys for the map API, but the table update below
+        # is a scatter-add, which merges duplicate rows natively — the
+        # pack would be pure overhead (measured ~17 ms at the 524288-
+        # row union shape: sort ~2 ms + segment reduce ~15 ms; the
+        # scatter costs the same either way, round-3 A/B in
+        # BASELINE.md). Gather every shard's slots and scatter them all.
+        oi = lax.all_gather(li, axis_name, axis=0, tiled=True)
+        ov = lax.all_gather(lv, axis_name, axis=0, tiled=True)
     else:
         # no collective: the identity-dropping scatter-add below sums
         # duplicate rows natively, no dedupe needed
@@ -299,10 +310,12 @@ class FMTrainer(DataParallelTrainer):
                 cap = min(self.n_rows, bound)
             step_fn = partial(train_step_sparse, cfg=cfg, capacity=cap,
                               axis_name=axes)
-            # the sort/segment pipeline after all_gather defeats static
-            # replication inference — same waiver as the sparse path in
-            # comm.tpu_comm (correctness is covered by the dense-vs-
-            # sparse differential test)
+            # params are pcast to varying but returned under replicated
+            # P() out_specs (every shard computes the identical update
+            # from the all-gathered slots + psum'd scalars), which VMA
+            # checking cannot prove — same waiver class as the sparse
+            # path in comm.tpu_comm (correctness is covered by the
+            # dense-vs-sparse differential test)
             check_vma = False
         else:
             step_fn = partial(train_step_dense, cfg=cfg, axis_name=axes)
