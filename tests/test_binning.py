@@ -3,6 +3,7 @@ the continuous-features -> bins -> train -> predict consumer flow."""
 
 import numpy as np
 import pytest
+from hypothesis import given, settings, strategies as st
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.models.binning import QuantileBinner
@@ -284,3 +285,106 @@ def test_local_sketch_inf_sentinels(rng):
     f = np.isfinite(want)
     np.testing.assert_allclose(b.edges[0][f], want[f], rtol=1e-5,
                                atol=1e-5)
+
+
+# ------------------------------------------- sketch-merge property tests
+@st.composite
+def _shard_sets(draw):
+    """Random shard lists: 1-5 shards, 1-3 features, varied sizes and
+    scales, optional NaN contamination."""
+    R = draw(st.integers(1, 5))
+    F = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    shards = []
+    for _ in range(R):
+        n = draw(st.integers(5, 400))
+        s = (rng.standard_normal((n, F)) *
+             draw(st.floats(0.1, 100.0)) +
+             draw(st.floats(-50.0, 50.0))).astype(np.float32)
+        if draw(st.booleans()):
+            s[rng.random((n, F)) < 0.2] = np.nan
+        shards.append(s)
+    # every feature must have data somewhere
+    data = np.concatenate(shards)
+    for f in range(F):
+        if np.isnan(data[:, f]).all():
+            shards[0][:, f] = rng.standard_normal(len(shards[0]))
+    return shards
+
+
+@settings(max_examples=30, deadline=None)
+@given(_shard_sets(), st.integers(3, 32))
+def test_merge_edges_monotone_and_bounded(shards, B):
+    """Merged edges are nondecreasing per feature and lie within the
+    pooled data's [min, max]."""
+    b = QuantileBinner(B)
+    sk = [b.local_sketch(s, sample=None) for s in shards]
+    b.merge_sketches(np.stack([e for e, _ in sk]),
+                     np.stack([c for _, c in sk]))
+    data = np.concatenate(shards)
+    for f in range(b.edges.shape[0]):
+        e = b.edges[f]
+        assert (e[1:] >= e[:-1]).all()
+        col = data[:, f]
+        col = col[~np.isnan(col)]
+        assert e[0] >= col.min() - 1e-4
+        assert e[-1] <= col.max() + 1e-4
+
+
+@settings(max_examples=30, deadline=None)
+@given(_shard_sets(), st.integers(3, 16), st.integers(0, 2**31 - 1))
+def test_merge_is_shard_order_invariant(shards, B, seed):
+    """Rank order must not affect the merged edges (the distributed fit
+    must give every rank the same answer regardless of rank ids)."""
+    b1, b2 = QuantileBinner(B), QuantileBinner(B)
+    sk = [b1.local_sketch(s, sample=None) for s in shards]
+    edges = np.stack([e for e, _ in sk])
+    counts = np.stack([c for _, c in sk])
+    perm = np.random.default_rng(seed).permutation(len(shards))
+    b1.merge_sketches(edges, counts)
+    b2.merge_sketches(edges[perm], counts[perm])
+    np.testing.assert_allclose(b1.edges, b2.edges, rtol=1e-6, atol=1e-6)
+
+
+@settings(max_examples=20, deadline=None)
+@given(_shard_sets(), st.integers(3, 16))
+def test_single_concatenated_shard_matches_fit(shards, B):
+    """A one-shard merge must reproduce fit() on the same data — exact
+    for DISTINCT-VALUED data (the _shard_sets strategy draws tie-free
+    float32 normals; ties collapse sketch points into CDF jumps whose
+    inversion legitimately differs from nanquantile's order-statistic
+    interpolation — see test_merge_with_tied_values for what IS
+    guaranteed under ties)."""
+    data = np.concatenate(shards)
+    b = QuantileBinner(B)
+    sk, c = b.local_sketch(data, sample=None)
+    b.merge_sketches(sk[None], c[None])
+    want = QuantileBinner(B).fit(data, sample=None)
+    np.testing.assert_allclose(b.edges, want.edges, rtol=1e-5, atol=1e-5)
+
+
+def test_merge_with_tied_values(rng):
+    """Heavily tied data (integer-coded / clipped features) collapses
+    sketch points into CDF jumps; like any quantile-of-quantiles
+    sketch, the merge is then NOT exact against fit() — but it must
+    stay well-formed: monotone edges inside [min, max], every edge a
+    plausible value, and transform output in range."""
+    B, R = 8, 3
+    col = rng.integers(0, 5, 9_000).astype(np.float32)   # 5 distinct
+    shards = [col[i::R][:, None] for i in range(R)]
+    b = QuantileBinner(B)
+    sk = [b.local_sketch(s, sample=None) for s in shards]
+    b.merge_sketches(np.stack([e for e, _ in sk]),
+                     np.stack([c for _, c in sk]))
+    e = b.edges[0]
+    assert (e[1:] >= e[:-1]).all()
+    assert e[0] >= 0.0 and e[-1] <= 4.0
+    out = b.transform(col[:, None])
+    assert out.min() >= 0 and out.max() < B
+    # a constant feature is the degenerate extreme: single-bin output
+    const = np.full((600, 1), 7.0, np.float32)
+    bc = QuantileBinner(B)
+    skc, cc = bc.local_sketch(const, sample=None)
+    bc.merge_sketches(skc[None], cc[None])
+    assert len(np.unique(bc.transform(const))) == 1

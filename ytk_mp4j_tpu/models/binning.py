@@ -142,8 +142,12 @@ class QuantileBinner:
         (grid [0, 1/Q, ..., 1] over its Q+1 points); the pooled CDF is
         their count-weighted average, evaluated at the union of all
         sketch points and inverted at the target quantiles. Exact when
-        one rank holds all of a feature's data; O(1/Q)-in-quantile-
-        space otherwise (tested in tests/test_binning.py).
+        one rank holds all of a feature's DISTINCT-VALUED data;
+        O(1/Q)-in-quantile-space across ranks. Heavily tied data
+        collapses sketch points into CDF jumps whose inversion can
+        differ from nanquantile's order-statistic interpolation — like
+        any quantile-of-quantiles sketch — but edges stay monotone and
+        inside [min, max] (tested in tests/test_binning.py).
         [R, F, Q+1] sketches + [R, F] counts -> self fitted."""
         sketch_stack = np.asarray(sketch_stack, np.float32)
         counts_stack = np.asarray(counts_stack, np.float32)
