@@ -388,3 +388,27 @@ def test_merge_with_tied_values(rng):
     skc, cc = bc.local_sketch(const, sample=None)
     bc.merge_sketches(skc[None], cc[None])
     assert len(np.unique(bc.transform(const))) == 1
+
+
+def test_fit_distributed_over_thread_backend(rng):
+    """fit_distributed on the thread backend: the comm duck-type (rank /
+    slave_num / allgather_array) spans all three SPMD backends."""
+    from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
+
+    from test_thread_comm import run_threads
+
+    N, F, B, R = 6_000, 3, 16, 4
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    shards = np.array_split(X, R)
+    slaves = ThreadCommSlave.spawn_group(R)
+    results = run_threads(
+        slaves,
+        lambda sl, r: QuantileBinner(B).fit_distributed(
+            shards[r], sl, sample=None).edges)
+    for e in results[1:]:
+        np.testing.assert_array_equal(e, results[0])
+    b = QuantileBinner(B)
+    sk = [b.local_sketch(s, sample=None) for s in shards]
+    b.merge_sketches(np.stack([e for e, _ in sk]),
+                     np.stack([c for _, c in sk]))
+    np.testing.assert_allclose(results[0], b.edges, rtol=1e-6, atol=1e-6)
