@@ -24,7 +24,9 @@ def cluster():
 
 
 @pytest.mark.parametrize("op", ["SUM", "PROD", "MAX", "MIN"])
-@pytest.mark.parametrize("operand", [Operands.DOUBLE, Operands.INT],
+@pytest.mark.parametrize("operand",
+                         [Operands.DOUBLE, Operands.INT, Operands.SHORT,
+                          Operands.BYTE],
                          ids=lambda o: o.name)
 def test_allreduce_differential(cluster, operand, op, rng):
     n = 4

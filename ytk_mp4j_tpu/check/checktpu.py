@@ -66,8 +66,11 @@ class Tally:
 
 
 def _operands():
-    """Device-eligible operands for this backend (64-bit needs x64)."""
-    ops = [Operands.FLOAT, Operands.INT]
+    """Device-eligible operands for this backend (64-bit needs x64).
+    SHORT/BYTE ride the device path too — int16/int8 collectives
+    compile and execute on the real chip and AOT-compile for v5e-8
+    (probed round 3), with numpy/Java wraparound semantics."""
+    ops = [Operands.FLOAT, Operands.INT, Operands.SHORT, Operands.BYTE]
     if jax.config.jax_enable_x64:
         ops += [Operands.DOUBLE, Operands.LONG]
     return ops
