@@ -50,6 +50,27 @@ def test_allreduce_differential(cluster, operand, op, rng):
             np.testing.assert_array_equal(got_t, got_s)
 
 
+@pytest.mark.parametrize("op", ["SUM", "PROD"])
+@pytest.mark.parametrize("operand", [Operands.SHORT, Operands.BYTE],
+                         ids=lambda o: o.name)
+def test_narrow_int_wraparound_differential(cluster, operand, op, rng):
+    """Socket and device paths must WRAP identically on int8/int16
+    overflow (numpy and Java both wrap; a path that silently upcast to
+    a wider accumulator would diverge here, which the in-range
+    differential above cannot observe)."""
+    n = 4
+    hi = int(np.iinfo(operand.dtype).max)
+    alls = [rng.integers(hi // 2, hi, 29).astype(operand.dtype)
+            for _ in range(n)]                  # SUM and PROD both wrap
+    operator = Operators.by_name(op)
+    sock = socket_run(
+        n, lambda s, r: s.allreduce_array(alls[r].copy(), operand, operator))
+    tpu = [a.copy() for a in alls]
+    cluster.allreduce_array(tpu, operand, operator)
+    for got_s, got_t in zip(sock, tpu):
+        np.testing.assert_array_equal(got_t, got_s)
+
+
 def test_reduce_scatter_differential(cluster, rng):
     n = 4
     operand = Operands.DOUBLE
