@@ -412,3 +412,21 @@ def test_fit_distributed_over_thread_backend(rng):
     b.merge_sketches(np.stack([e for e, _ in sk]),
                      np.stack([c for _, c in sk]))
     np.testing.assert_allclose(results[0], b.edges, rtol=1e-6, atol=1e-6)
+
+
+def test_fit_distributed_config_mismatch_raises(rng):
+    """Ranks disagreeing on n_bins must fail loudly, not merge
+    garbage: the size pre-exchange catches it before the sketch
+    allgather can shear."""
+    from helpers import run_slaves
+
+    X = rng.standard_normal((400, 2)).astype(np.float32)
+    shards = np.array_split(X, 2)
+
+    def job(slave, rank):
+        B = 8 if rank == 0 else 16
+        QuantileBinner(B).fit_distributed(shards[rank], slave,
+                                          sample=None)
+
+    with pytest.raises(Mp4jError, match="mismatch"):
+        run_slaves(2, job)

@@ -188,20 +188,50 @@ class QuantileBinner:
         ``allgather_array`` (socket, thread, and jax.distributed
         backends all do). One fixed-size allgather moves the sketches;
         raw features never leave their rank. All ranks return fitted
-        with identical edges."""
+        with identical edges.
+
+        Each rank's wire segment leads with a (n_bins, missing_bucket,
+        F) header, validated after the allgather: a binner-config or
+        feature-count mismatch across ranks would otherwise garble the
+        merge silently (or shear the flat buffer into misaligned
+        segments)."""
         from ytk_mp4j_tpu.operands import Operands
 
         edges, counts = self.local_sketch(X_shard, sample, seed)
         F, E = edges.shape
         n, r = comm.slave_num, comm.rank
-        seg = F * E + F
+        hdr = np.asarray(
+            [self.n_bins, int(self.missing_bucket), F], np.float32)
+        H = len(hdr)
+        seg = H + F * E + F
+        # segment length is itself config-dependent (F, E); a mismatch
+        # would shear the main allgather into misaligned blocks before
+        # any header could be read, so sizes are exchanged first
+        sizes = np.zeros(n, np.float32)
+        sizes[r] = seg
+        comm.allgather_array(sizes, Operands.FLOAT)
+        if not (sizes == seg).all():
+            raise Mp4jError(
+                f"fit_distributed sketch-size mismatch across ranks: "
+                f"{sizes.astype(int).tolist()} (n_bins / missing_bucket "
+                f"/ feature-count differ)")
         buf = np.zeros(n * seg, np.float32)
-        buf[r * seg: r * seg + F * E] = edges.ravel()
-        buf[r * seg + F * E: (r + 1) * seg] = counts
+        s = r * seg
+        buf[s: s + H] = hdr
+        buf[s + H: s + H + F * E] = edges.ravel()
+        buf[s + H + F * E: s + seg] = counts
         comm.allgather_array(buf, Operands.FLOAT)
         rows = buf.reshape(n, seg)
+        for p in range(n):
+            if not np.array_equal(rows[p, :H], hdr):
+                raise Mp4jError(
+                    f"fit_distributed config mismatch: rank {p} sent "
+                    f"(n_bins, missing_bucket, F) = "
+                    f"{rows[p, :H].astype(int).tolist()}, this rank has "
+                    f"{hdr.astype(int).tolist()}")
         return self.merge_sketches(
-            rows[:, : F * E].reshape(n, F, E), rows[:, F * E:])
+            rows[:, H: H + F * E].reshape(n, F, E),
+            rows[:, H + F * E:])
 
     def transform(self, X) -> np.ndarray:
         """Continuous [N, F] -> int32 bin ids in [0, n_bins).
