@@ -12,13 +12,14 @@ Distributed fitting (``fit_distributed``): each rank sketches its own
 shard — per-feature quantile edges plus finite-value counts — and the
 fixed-size sketches ride ONE ``allgather_array`` on any SPMD backend
 (``ProcessCommSlave`` / ``ThreadCommSlave`` / ``DistributedComm``);
-every rank then merges the pooled sketches identically (weighted
-quantile-of-quantiles), so all ranks end with the same edges without
-ever centralizing raw features. The merge is a documented
-approximation: each rank's j-th edge is treated as a point mass of
-weight ``count_r / (Q-1)`` and the merged edges are weighted quantiles
-of the pooled points — error is O(1/Q) in quantile space (tested
-against the single-host fit in ``tests/test_binning.py``).
+every rank then merges the pooled sketches identically, so all ranks
+end with the same edges without ever centralizing raw features. The
+merge treats each rank's sketch ``[min, q_1/Q, ..., q_(Q-1)/Q, max]``
+as a piecewise-linear CDF, count-weight-averages the per-rank CDFs,
+and inverts the pooled CDF at the target quantiles — exact when one
+rank holds a feature's distinct-valued data, O(1/Q) in quantile space
+across ranks (tested against the single-host fit in
+``tests/test_binning.py``).
 """
 
 from __future__ import annotations
