@@ -100,3 +100,34 @@ def test_pad_to():
     assert float(pv[3]) == 1.0  # PROD identity
     with pytest.raises(ValueError):
         sp.pad_to(idx, val, 1)
+
+
+def test_sort_by_key_wide_payload_fallback(rng):
+    """Payload rows wider than _MAX_SORT_PAYLOAD_COLS take the
+    argsort+gather fallback; results must match the sort-network path's
+    contract exactly (pairs preserved, keys ascending)."""
+    L, W = 64, sp._MAX_SORT_PAYLOAD_COLS + 2
+    idx = rng.integers(0, 30, L).astype(np.int32)
+    val = rng.standard_normal((L, W)).astype(np.float32)
+    si, sv = jax.jit(sp.sort_by_key)(jnp.asarray(idx), jnp.asarray(val))
+    si, sv = np.asarray(si), np.asarray(sv)
+    assert (si[1:] >= si[:-1]).all()
+    order = np.argsort(idx, kind="stable")
+    np.testing.assert_array_equal(si, idx[order])
+    np.testing.assert_array_equal(sv, val[order])
+
+
+def test_sparse_allreduce_wide_vector_values(rng):
+    """Map-of-arrays operands wider than the sort-payload cutoff ride
+    the fallback inside sparse_allreduce; differential vs numpy."""
+    W = sp._MAX_SORT_PAYLOAD_COLS + 5
+    v0 = rng.standard_normal(W)
+    v1 = rng.standard_normal(W)
+    v2 = rng.standard_normal(W)
+    per_rank = [([3], [v0]), ([3, 1], [v1, v2])]
+    oi, ov = run_sparse_allreduce(per_rank, capacity=4,
+                                  operator=Operators.SUM, vshape=(W,))
+    got = {int(i): v for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert set(got) == {1, 3}
+    np.testing.assert_allclose(got[3], v0 + v1, rtol=1e-6)
+    np.testing.assert_allclose(got[1], v2, rtol=1e-6)
