@@ -351,3 +351,29 @@ def test_native_reduce_flip_rebuilds_same_cluster(cluster, rng):
                if k[0] == "allreduce" and k[3] is Operators.MAX
                and k[4] == "xla"}
     assert False in natives and len(natives) == 2, natives
+
+
+def test_transient_probe_verdict_is_rate_limited(monkeypatch):
+    """A transient probe failure must not re-probe on every resolve
+    call (a rejection message containing a transient token would
+    otherwise trigger a fresh compile probe each time); within the TTL
+    the optimistic verdict is reused, after it the probe re-runs."""
+    from ytk_mp4j_tpu.ops import collectives as coll
+
+    coll.set_native_reduce(None)
+    coll._PROBE_CACHE.pop(("cpu", "pmax"), None)
+    coll._TRANSIENT_AT.clear()
+    calls = []
+    monkeypatch.setattr(coll, "_probe",
+                        lambda kind, devs: calls.append(kind) or None)
+    try:
+        assert coll._native_reduce_ok("pmax") is True   # optimistic
+        assert coll._native_reduce_ok("pmax") is True
+        assert len(calls) == 1                          # rate-limited
+        # TTL expiry -> one more probe
+        coll._TRANSIENT_AT[("cpu", "pmax")] -= coll._TRANSIENT_TTL + 1
+        assert coll._native_reduce_ok("pmax") is True
+        assert len(calls) == 2
+    finally:
+        coll._TRANSIENT_AT.clear()
+        coll._PROBE_CACHE.pop(("cpu", "pmax"), None)
