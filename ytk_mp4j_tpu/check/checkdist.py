@@ -107,7 +107,6 @@ def check(comm, length: int = 97) -> int:
 def check_global_mesh(comm) -> int:
     """The perf path: jitted psum over a global (all-process) mesh."""
     import jax
-    import jax.numpy as jnp
     from functools import partial
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -34,7 +34,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
