@@ -273,16 +273,25 @@ def check_gbdt(results: dict, devices, n: int, per: int = 8192):
         "_missing_cat": GBDTConfig(n_features=28, n_bins=256, depth=6,
                                    missing_bin=True,
                                    categorical_features=(3, 17)),
+        # the multiclass consumer: one tree per class per round
+        "_softmax": GBDTConfig(n_features=28, n_bins=256, depth=6,
+                               loss="softmax", n_classes=3),
     }
     for label, mesh in meshes.items():
         for suffix, cfg in cfgs.items():
             if suffix and label != "flat":
                 continue            # one topology proof is enough
             tr = GBDTTrainer(cfg, mesh=mesh)
+            if cfg.loss == "softmax":
+                y_aval = _i32(n, per)                      # class ids
+                preds_aval = _f32(n, per, cfg.n_classes)   # margins
+            else:
+                y_aval = _f32(n, per)
+                preds_aval = _f32(n, per)
             _compile(f"gbdt/train_step_{label}{suffix}", results,
                      tr._build_step(),
-                     _i32(n, per, cfg.n_features), _f32(n, per),
-                     _f32(n, per), _f32(n, per),
+                     _i32(n, per, cfg.n_features), y_aval,
+                     preds_aval, _f32(n, per),
                      jax.ShapeDtypeStruct(kd.shape, kd.dtype))
 
 
