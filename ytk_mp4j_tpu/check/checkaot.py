@@ -245,6 +245,45 @@ def check_rooted_lowerings(results: dict, mesh: Mesh, n: int,
                  _shard_mapped(mesh, body, P(AXIS), P(AXIS)), _f32(n, L))
 
 
+def check_hier_reduce_scatter(results: dict, devices, n: int,
+                              L: int = 1 << 20):
+    """Round-3 measured decision: tuple-axis reduce_scatter stays
+    allreduce+slice because XLA's tuple psum is already hierarchical.
+    These three programs keep the evidence on record (BASELINE.md):
+    the current lowering vs the two hand-staged psum_scatter cascades
+    (outer-first needs no permute; inner-first shrinks the buffer
+    before the DCN stage but pays a block permutation)."""
+    if n % 2:
+        return
+    mesh = Mesh(np.asarray(devices[:n]).reshape(n // 2, 2),
+                ("inter", "intra"))
+    axes = ("inter", "intra")
+
+    def current(x):
+        return coll.reduce_scatter(x[0], Operators.SUM, axes)[None]
+
+    def outer_first(x):
+        out = lax.psum_scatter(x[0], "inter", scatter_dimension=0,
+                               tiled=True)
+        return lax.psum_scatter(out, "intra", scatter_dimension=0,
+                                tiled=True)[None]
+
+    def inner_first(x):
+        v = x[0]
+        grid = v.reshape(n // 2, 2, -1)
+        out = grid.transpose(1, 0, 2).reshape(-1)
+        out = lax.psum_scatter(out, "intra", scatter_dimension=0,
+                               tiled=True)
+        return lax.psum_scatter(out, "inter", scatter_dimension=0,
+                                tiled=True)[None]
+
+    for name, body in (("hier_rs/current_allreduce_slice", current),
+                       ("hier_rs/staged_outer_first", outer_first),
+                       ("hier_rs/staged_inner_first_permuted", inner_first)):
+        _compile(name, results,
+                 _shard_mapped(mesh, body, P(axes), P(axes)), _f32(n, L))
+
+
 def check_sparse(results: dict, mesh: Mesh, n: int, cap: int = 1024):
     def body(i, v):
         return sparse_ops.sparse_allreduce(
@@ -331,6 +370,7 @@ def main(argv=None) -> int:
     results: dict = {}
     check_collectives(results, mesh, n)
     check_rooted_lowerings(results, mesh, n)
+    check_hier_reduce_scatter(results, devices, n)
     check_rings(results, mesh, n)
     check_sparse(results, mesh, n)
     check_gbdt(results, devices, n)

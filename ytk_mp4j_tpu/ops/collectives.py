@@ -336,7 +336,18 @@ def scatter(x, root: int = 0, axis_name="mp4j"):
 def reduce_scatter(x, operator: Operator = Operators.SUM, axis_name="mp4j",
                    native_reduce: bool | None = None):
     """Element-wise reduce then split: member i receives block i of the
-    reduction. ``x.shape[0]`` must be divisible by the axis size."""
+    reduction (i = :func:`flat_index`, row-major over tuple axes).
+    ``x.shape[0]`` must be divisible by the axis size.
+
+    SUM on a TUPLE axis (hierarchical inter x intra mesh) deliberately
+    stays allreduce + local slice: XLA's tuple-axis psum is ALREADY a
+    staged hierarchical all-reduce, and its fused lowering beats both
+    hand-staged psum_scatter cascades on the v5e:2x4 compiler's cost
+    model — 9.45 MB bytes-accessed vs 13.7 MB (outer-axis-first, no
+    permute) and 51.4 MB (inner-first + block permutation, the
+    DCN-shrinking schedule the wire arithmetic favors). Measured and
+    rejected round 3 (checkaot ``hier_rs/*``, BASELINE.md); revisit if
+    pod execution shows DCN-bound behavior the cost model misses."""
     n = _axis_size(axis_name)
     if x.shape[0] % n != 0:
         raise Mp4jError(
