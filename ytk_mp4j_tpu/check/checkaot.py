@@ -69,6 +69,14 @@ def _compile(name: str, results: dict, jitted, *avals) -> None:
         print(f"FAIL {name}: {str(e)[:300]}", file=sys.stderr)
 
 
+def _hier_mesh(devices, n: int) -> Mesh:
+    """The one inter x intra topology every hier program compiles for
+    (n//2 x 2, row-major ranks) — shared so the hier_rs evidence and
+    the gbdt hier train step measure the same topology."""
+    return Mesh(np.asarray(devices[:n]).reshape(n // 2, 2),
+                ("inter", "intra"))
+
+
 def _shard_mapped(mesh, body, in_specs, out_specs):
     return jax.jit(partial(
         jax.shard_map, mesh=mesh, check_vma=False,
@@ -255,8 +263,7 @@ def check_hier_reduce_scatter(results: dict, devices, n: int,
     before the DCN stage but pays a block permutation)."""
     if n % 2:
         return
-    mesh = Mesh(np.asarray(devices[:n]).reshape(n // 2, 2),
-                ("inter", "intra"))
+    mesh = _hier_mesh(devices, n)
     axes = ("inter", "intra")
 
     def current(x):
@@ -303,8 +310,7 @@ def check_gbdt(results: dict, devices, n: int, per: int = 8192):
     kd = jax.eval_shape(lambda: jax.random.key_data(jax.random.key(0)))
     meshes = {"flat": Mesh(np.asarray(devices[:n]), (AXIS,))}
     if n % 2 == 0:
-        meshes["hier"] = Mesh(
-            np.asarray(devices[:n]).reshape(n // 2, 2), ("inter", "intra"))
+        meshes["hier"] = _hier_mesh(devices, n)
     cfgs = {
         "": GBDTConfig(n_features=28, n_bins=256, depth=6),
         # the data-handling graph: learned missing direction +
