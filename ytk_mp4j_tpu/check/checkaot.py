@@ -115,9 +115,14 @@ def check_collectives(results: dict, mesh: Mesh, n: int, L: int = 4096):
              _f32(n, L))
 
 
-def check_rings(results: dict, mesh: Mesh, n: int, L: int = 8192):
+def check_rings(results: dict, mesh: Mesh, n: int, L: int | None = None):
     """The hand-scheduled ppermute ring and the Pallas RDMA kernels
-    (compiled path: entry barrier + credit backpressure included)."""
+    (compiled path: entry barrier + credit backpressure included).
+    ``L`` scales with the topology: the reduce-scatter kernel splits a
+    shard into n chunks and each chunk must be a full Mosaic tile
+    (min_chunk_elems) — a fixed 8192 under-fills at n = 16."""
+    if L is None:
+        L = max(8192, n * ring_kernel.min_chunk_elems(jnp.float32))
     _compile("ring/ppermute_allreduce", results,
              _shard_mapped(
                  mesh, lambda x: ring.ring_allreduce(
