@@ -41,6 +41,93 @@ def test_single_process_fallback():
         assert d == {"a": 1.0}
 
 
+def test_device_reduce_verdict_agreed_job_wide(monkeypatch):
+    """If the local MAX/MIN probe verdicts differ across ranks (TTL
+    timing, per-host env overrides), every rank must still pick the SAME
+    path: verdicts are exchanged once over the always-safe path and
+    AND-ed, then cached on the comm (ADVICE round 3, medium)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from ytk_mp4j_tpu.operators import Operators
+    from ytk_mp4j_tpu.ops import collectives as coll
+
+    comm = DistributedComm.__new__(DistributedComm)
+    comm._rank, comm._n, comm._closed = 0, 3, False
+    comm._djits, comm._agreed_native = {}, {}
+    comm._pmesh = Mesh(np.asarray(jax.devices()[:1]), ("proc",))
+
+    monkeypatch.setattr(coll, "resolve_native_reduce",
+                        lambda operator, devices=None: True)
+    definitive = {"v": True}
+    monkeypatch.setattr(coll, "native_reduce_definitive",
+                        lambda kind, devices=None: definitive["v"])
+    exchanges = []
+
+    def fake_exchange(obj):
+        exchanges.append(obj)
+        return [obj, (False, True), (True, True)]  # rank 1 disagrees
+
+    comm._exchange_obj = fake_exchange
+
+    # local probe said True, but the job-wide AND must win
+    assert comm._device_reduce_ok(Operators.MAX) is False
+    assert exchanges == [(True, True)]
+    # all ranks definitive: pinned, no second exchange
+    assert comm._device_reduce_ok(Operators.MAX) is False
+    assert exchanges == [(True, True)]
+    # SUM needs no probe and never exchanges
+    assert comm._device_reduce_ok(Operators.SUM) is True
+    assert exchanges == [(True, True)]
+    # PROD has no device reducer at all
+    assert comm._device_reduce_ok(Operators.PROD) is False
+
+
+def test_device_reduce_transient_verdict_not_pinned(monkeypatch):
+    """A transient probe verdict (optimistic True, not definitive) must
+    NOT be pinned job-wide: each call re-exchanges until every rank's
+    verdict is definitive, so a backend whose first probes hit infra
+    errors can still fall back to the host path later."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from ytk_mp4j_tpu.operators import Operators
+    from ytk_mp4j_tpu.ops import collectives as coll
+
+    comm = DistributedComm.__new__(DistributedComm)
+    comm._rank, comm._n, comm._closed = 0, 2, False
+    comm._djits, comm._agreed_native = {}, {}
+    comm._pmesh = Mesh(np.asarray(jax.devices()[:1]), ("proc",))
+
+    state = {"verdict": True, "definitive": False}
+    monkeypatch.setattr(coll, "resolve_native_reduce",
+                        lambda operator, devices=None: state["verdict"])
+    monkeypatch.setattr(coll, "native_reduce_definitive",
+                        lambda kind, devices=None: state["definitive"])
+    exchanges = []
+
+    def fake_exchange(obj):
+        exchanges.append(obj)
+        return [obj, obj]  # peer agrees with us
+
+    comm._exchange_obj = fake_exchange
+
+    assert comm._device_reduce_ok(Operators.MIN) is True
+    assert comm._device_reduce_ok(Operators.MIN) is True
+    assert len(exchanges) == 2          # transient: re-exchanged
+    assert comm._agreed_native == {}    # and never pinned
+    # probe finally lands a definitive rejection -> pinned False
+    state.update(verdict=False, definitive=True)
+    assert comm._device_reduce_ok(Operators.MIN) is False
+    assert comm._agreed_native == {"MIN": False}
+    assert comm._device_reduce_ok(Operators.MIN) is False
+    assert len(exchanges) == 3
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("procs", [2, 3])
 def test_checkdist_multiprocess(procs):

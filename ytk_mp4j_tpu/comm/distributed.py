@@ -102,6 +102,10 @@ class DistributedComm(CommSlave):
         self.final_code: int | None = None  # set by close()
         self._pmesh: Mesh | None = None
         self._djits: dict = {}
+        # operator.name -> job-wide agreed device-reduce verdict (see
+        # _device_reduce_ok): the probe result is exchanged once and
+        # AND-ed so every rank runs the same collective program
+        self._agreed_native: dict[str, bool] = {}
 
     # -- identity / control plane --------------------------------------
     @property
@@ -213,13 +217,49 @@ class DistributedComm(CommSlave):
         the MP4J_NATIVE_REDUCE / set_native_reduce overrides) says the
         backend accepts non-SUM all-reduce HLO — the same gate every
         other collective honors (axon rejected pmax/pmin in round 1).
-        False falls back to the allgather + host-reduce path."""
+        False falls back to the allgather + host-reduce path.
+
+        The probe verdict is resolved JOB-WIDE, not per process: the
+        local probe's transient/rejection classification, TTL timing, or
+        a per-host MP4J_NATIVE_REDUCE can differ across hosts, and ranks
+        disagreeing on device-vs-host here would run mismatched
+        collective programs (a hang, or worse). Every rank's local
+        (verdict, definitive) pair rides the always-safe
+        pickled-allgather path (:meth:`_exchange_obj`) and the AND of
+        verdicts decides; all ranks call collectives in the same program
+        order, so the exchange itself is symmetric. The agreed verdict
+        is PINNED on the comm only once every rank's local verdict is
+        definitive (override or cached probe, not a transient-failure
+        optimistic default — see
+        :func:`ops.collectives.native_reduce_definitive`); until then
+        each call re-exchanges, so a backend whose first probes hit
+        transient infra errors is not locked onto the native path
+        forever. Once pinned, later ``set_native_reduce`` / env flips do
+        NOT affect this comm — deliberately: a per-rank override
+        consulted mid-job is exactly the desync hazard this exchange
+        exists to prevent. Set overrides before first use, or construct
+        a fresh comm."""
         if operator.name not in self._DEVICE_REDUCERS:
             return False
+        if operator.lax_collective == "psum":
+            return True  # SUM: no probed collective, natively safe
+        agreed = self._agreed_native.get(operator.name)
+        if agreed is not None:  # pinned: skip the local probe entirely
+            return agreed       # (its TTL re-probes would be dead work)
         from ytk_mp4j_tpu.ops import collectives as coll
-        ok = coll.resolve_native_reduce(
-            operator, devices=self._proc_mesh().devices.flat)
-        return ok is None or ok
+        kind = operator.lax_collective
+        # materialize: .flat is a one-shot iterator and both resolver
+        # calls below list() it
+        devs = list(self._proc_mesh().devices.flat)
+        verdict = bool(coll.resolve_native_reduce(operator, devices=devs))
+        definitive = coll.native_reduce_definitive(kind, devices=devs)
+        if self._n > 1:
+            pairs = self._exchange_obj((verdict, definitive))
+            verdict = all(v for v, _ in pairs)
+            definitive = all(d for _, d in pairs)
+        if definitive:
+            self._agreed_native[operator.name] = verdict
+        return verdict
 
     def _proc_mesh(self) -> Mesh:
         if self._pmesh is None:
@@ -487,14 +527,22 @@ class DistributedComm(CommSlave):
         return d
 
     def scatter_map(self, d: dict, operand: Operand = Operands.DOUBLE,
-                    root: int = 0) -> dict:
+                    root: int = 0, partitioner=None) -> dict:
+        """``partitioner(key) -> rank`` overrides the placement rule
+        (contract parity with ``ProcessCommSlave.scatter_map``); it must
+        be the same function on every rank."""
         self._assert_open()
         self._check_root(root)
         if self._n == 1:
             return d
+        if partitioner is None:
+            partitioner = lambda k: meta.key_partition(k, self._n)  # noqa: E731
         src = self._exchange_obj(d)[root]
-        mine = {k: v for k, v in src.items()
-                if meta.key_partition(k, self._n) == self._rank}
+        mine = {}
+        for k, v in src.items():
+            if meta.check_partition_rank(partitioner(k), self._n,
+                                         k) == self._rank:
+                mine[k] = v
         d.clear()
         d.update(mine)
         return d

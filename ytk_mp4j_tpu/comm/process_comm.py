@@ -776,7 +776,8 @@ class ProcessCommSlave(CommSlave):
         if self._rank == root:
             shares: list[dict] = [{} for _ in range(self._n)]
             for k, v in d.items():
-                shares[partitioner(k)][k] = v
+                shares[meta.check_partition_rank(
+                    partitioner(k), self._n, k)][k] = v
             for peer in range(self._n):
                 if peer != root:
                     self._send(peer, shares[peer],

@@ -78,6 +78,19 @@ def key_partition(key, parts: int) -> int:
     return int.from_bytes(h.digest(), "little") % parts
 
 
+def check_partition_rank(p: int, parts: int, key) -> int:
+    """Validate a user ``partitioner``'s placement. Shared by every
+    backend's ``scatter_map`` so they agree on bad output: without
+    this, a buggy partitioner returning -1 would silently wrap to the
+    last rank via Python negative indexing on one backend and raise on
+    another."""
+    if not (0 <= p < parts):
+        raise Mp4jError(
+            f"partitioner placed key {key!r} on rank {p}, outside "
+            f"[0, {parts})")
+    return p
+
+
 def padded_block(length: int, parts: int) -> int:
     """Per-rank block size when padding ``length`` up to a multiple of
     ``parts`` (used by the TPU path, which needs equal static shapes)."""

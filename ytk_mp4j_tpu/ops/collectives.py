@@ -109,20 +109,55 @@ def resolve_native_reduce(operator: Operator, devices=None) -> bool | None:
     return _native_reduce_ok(kind, probe_now=True, devices=devices)
 
 
-def _native_reduce_ok(kind: str, probe_now: bool = False,
-                      devices=None) -> bool:
+def _override_verdict() -> bool | None:
+    """The forced verdict (set_native_reduce / MP4J_NATIVE_REDUCE), or
+    None when unforced. The single source of override classification —
+    :func:`_native_reduce_ok` and :func:`native_reduce_definitive` must
+    agree on it or a job-wide pin could be derived under different
+    rules than the verdict itself."""
     if _FORCE_NATIVE is not None:
         return _FORCE_NATIVE
     env = os.environ.get("MP4J_NATIVE_REDUCE")
     if env in ("0", "1"):
         return env == "1"
+    return None
+
+
+def _resolve_devices(devices=None) -> list | None:
+    """Materialized device list (accepts one-shot iterators), or None
+    when no backend exists at all."""
     if devices is not None:
-        devs = list(devices)
-    else:
-        try:
-            devs = jax.devices()
-        except Exception:  # pragma: no cover - no backend at all
-            return True
+        return list(devices)
+    try:
+        return list(jax.devices())
+    except Exception:  # pragma: no cover - no backend at all
+        return None
+
+
+def native_reduce_definitive(kind: str, devices=None) -> bool:
+    """True when the current verdict for ``kind`` is PINNED — an env /
+    :func:`set_native_reduce` override or a cached definitive probe —
+    rather than a transient-failure optimistic default. Multi-host
+    layers use this to decide whether a job-wide agreed verdict may be
+    cached for the life of the comm: a transient verdict must stay
+    re-examinable or a backend that genuinely rejects pmax/pmin would
+    be locked onto the failing native path forever."""
+    if _override_verdict() is not None:
+        return True
+    devs = _resolve_devices(devices)
+    if devs is None:  # pragma: no cover - no backend at all
+        return True
+    return (devs[0].platform, kind) in _PROBE_CACHE
+
+
+def _native_reduce_ok(kind: str, probe_now: bool = False,
+                      devices=None) -> bool:
+    forced = _override_verdict()
+    if forced is not None:
+        return forced
+    devs = _resolve_devices(devices)
+    if devs is None:  # pragma: no cover - no backend at all
+        return True
     key = (devs[0].platform, kind)
     ok = _PROBE_CACHE.get(key)
     if ok is None:
