@@ -1,0 +1,153 @@
+"""Persistent key<->code vocabularies (comm.keycodec) + their use by the
+TpuCommCluster map collectives (the configs[2] hot path)."""
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm.keycodec import (IntKeyCodec, ObjKeyCodec,
+                                        codec_for_key)
+from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+
+def test_codec_for_key_kinds():
+    assert isinstance(codec_for_key(7), IntKeyCodec)
+    assert isinstance(codec_for_key(np.int32(7)), IntKeyCodec)
+    assert isinstance(codec_for_key("w7"), ObjKeyCodec)
+    assert isinstance(codec_for_key(True), ObjKeyCodec)   # bool is NOT int
+    assert isinstance(codec_for_key((1, 2)), ObjKeyCodec)
+
+
+@pytest.mark.parametrize("codec_cls,mk", [
+    (IntKeyCodec, lambda i: i * 13 - 40),
+    (ObjKeyCodec, lambda i: f"feat:{i * 13 - 40}"),
+])
+def test_codec_roundtrip_and_growth(codec_cls, mk):
+    c = codec_cls()
+    d1 = {mk(i): None for i in range(50)}
+    codes1 = c.encode(d1.keys(), len(d1))
+    assert codes1.dtype == np.int32 and c.size == 50
+    assert sorted(codes1.tolist()) == list(range(50))  # dense codes
+    assert c.decode(codes1) == list(d1.keys())
+    # re-encoding the same keys is stable and does not grow the vocab
+    codes_again = c.encode(d1.keys(), len(d1))
+    np.testing.assert_array_equal(codes_again, codes1)
+    assert c.size == 50
+    # overlapping novelty grows; old codes keep their values
+    d2 = {mk(i): None for i in range(30, 80)}
+    codes2 = c.encode(d2.keys(), len(d2))
+    assert c.size == 80
+    assert c.decode(codes2) == list(d2.keys())
+    overlap = [k for k in d2 if k in d1]
+    old = dict(zip(d1.keys(), codes1.tolist()))
+    new = dict(zip(d2.keys(), codes2.tolist()))
+    assert all(old[k] == new[k] for k in overlap)
+
+
+@pytest.mark.parametrize("codec_cls,mk", [
+    (IntKeyCodec, lambda i: i * 7 - 11),
+    (ObjKeyCodec, lambda i: f"k{i * 7 - 11}"),
+])
+def test_codec_partition_matches_meta(codec_cls, mk):
+    c = codec_cls()
+    keys = [mk(i) for i in range(40)]
+    codes = c.encode(keys, len(keys))
+    for n in (3, 4):
+        got = c.partition(codes, n)
+        want = [meta.key_partition(k, n) for k in keys]
+        np.testing.assert_array_equal(got, want)
+    # growth after a partition call extends the cache coherently
+    more = [mk(i) for i in range(40, 55)]
+    codes2 = c.encode(more, len(more))
+    np.testing.assert_array_equal(
+        c.partition(codes2, 4), [meta.key_partition(k, 4) for k in more])
+
+
+def test_int_codec_rejects_non_int_keys():
+    c = IntKeyCodec()
+    with pytest.raises(Mp4jError, match="integer"):
+        c.encode(["a", "b"], 2)
+    # floats must RAISE, not silently truncate into a colliding int key
+    with pytest.raises(Mp4jError, match="integer"):
+        c.encode([2.5, 3.0], 2)
+    cl = TpuCommCluster(2)
+    with pytest.raises(Mp4jError, match="integer"):
+        cl.allreduce_map([{2: 1.0}, {2.5: 1.0}], Operands.DOUBLE,
+                         Operators.SUM)
+
+
+def test_int_codec_negative_and_large_keys():
+    c = IntKeyCodec()
+    keys = [-(2 ** 62), -1, 0, 5, 2 ** 62]
+    codes = c.encode(keys, len(keys))
+    assert c.decode(codes) == keys
+    assert all(isinstance(k, int) for k in c.decode(codes))
+
+
+# ------------------------------------------------- device map integration
+def test_device_allreduce_map_int_keys(rng):
+    """Int feature-id keys (the ytk-learn gradient shape) on the DEVICE
+    map path: values merge exactly, keys come back as python ints."""
+    cl = TpuCommCluster(4)
+    maps = [{int(k): float(v) for k, v in
+             zip(rng.integers(0, 300, 90), rng.standard_normal(90))}
+            for _ in range(4)]
+    want = {}
+    for m in maps:
+        for k, v in m.items():
+            want[k] = want.get(k, 0.0) + v
+    cl.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+    for m in maps:
+        assert set(m) == set(want)
+        assert all(type(k) is int for k in m)
+        for k in want:
+            np.testing.assert_allclose(m[k], want[k], rtol=1e-9)
+
+
+def test_device_map_vocab_persists_across_calls(rng):
+    """Repeated calls over a near-persistent vocabulary reuse the codec:
+    the vocab stops growing once the key stream stabilizes."""
+    cl = TpuCommCluster(4)
+    for step in range(3):
+        maps = [{f"w{i}": 1.0 for i in range(100)} for _ in range(4)]
+        cl.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+    codec = cl._codecs["obj"]
+    assert codec.size == 100
+    # int maps on the same cluster take their own codec
+    imaps = [{i: 1.0 for i in range(40)} for _ in range(4)]
+    cl.allreduce_map(imaps, Operands.DOUBLE, Operators.SUM)
+    assert cl._codecs["int"].size == 40
+    assert cl._codecs["obj"].size == 100
+    for m in imaps:
+        assert m == {i: 4.0 for i in range(40)}
+
+
+def test_device_map_mixed_key_kinds_in_one_call_raise():
+    cl = TpuCommCluster(4)
+    maps = [{1: 1.0}, {"a": 1.0}, {}, {}]
+    with pytest.raises(Mp4jError):
+        cl.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+
+
+def test_device_reduce_scatter_map_int_keys(rng):
+    """Partition cache must place int keys exactly like the socket
+    backend's per-key meta.key_partition."""
+    cl = TpuCommCluster(4)
+    maps = [{int(k): float(r) for k in rng.integers(0, 200, 60)}
+            for r in range(4)]
+    want = {}
+    for m in maps:
+        for k, v in m.items():
+            want[k] = want.get(k, 0.0) + v
+    cl.reduce_scatter_map(maps, Operands.DOUBLE, Operators.SUM)
+    seen = {}
+    for r, m in enumerate(maps):
+        for k, v in m.items():
+            assert meta.key_partition(k, 4) == r
+            seen[k] = v
+    assert set(seen) == set(want)
+    for k in want:
+        np.testing.assert_allclose(seen[k], want[k], rtol=1e-9)

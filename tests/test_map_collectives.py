@@ -125,6 +125,49 @@ def test_tpu_gather_scatter_broadcast_map(cluster, rng):
     assert rebuilt == src
 
 
+def test_tpu_scatter_map_partitioner_override(cluster):
+    """Contract parity with ProcessCommSlave.scatter_map(partitioner=):
+    the thread backend's global-thread-rank placement rule must be
+    expressible on the driver backend too."""
+    N, T = 4, 2   # 4 global thread ranks blocked onto 2 processes
+    src = {f"k{i}": float(i) for i in range(12)}
+    maps = [dict(src)] + [{"junk": 0.0} for _ in range(3)]
+    cluster.scatter_map(maps, Operands.DOUBLE, root=0,
+                        partitioner=lambda k: meta.key_partition(k, N) // T)
+    rebuilt = {}
+    for r, m in enumerate(maps):
+        for k, v in m.items():
+            assert meta.key_partition(k, N) // T == r
+            rebuilt[k] = v
+    assert rebuilt == src
+    # an out-of-range placement is an error, not a silent drop
+    bad = [dict(src)] + [{} for _ in range(3)]
+    with pytest.raises(Mp4jError, match="outside"):
+        cluster.scatter_map(bad, Operands.DOUBLE, root=0,
+                            partitioner=lambda k: 99)
+
+
+def test_socket_scatter_map_partitioner_range_checked():
+    """A buggy partitioner returning -1 must raise on the SOCKET backend
+    too — not silently wrap to the last rank via negative indexing
+    (backends must agree on bad input; meta.check_partition_rank)."""
+    from helpers import run_slaves
+
+    def fn(slave, r):
+        if r != 0:
+            return "skipped"    # root fails before any wire exchange
+        d = {f"k{i}": float(i) for i in range(4)}
+        try:
+            slave.scatter_map(d, Operands.DOUBLE, root=0,
+                              partitioner=lambda k: -1)
+        except Mp4jError as e:
+            return "raised" if "outside" in str(e) else str(e)
+        return "no error"
+
+    res = run_slaves(2, fn)
+    assert res[0] == "raised", res
+
+
 def test_tpu_map_vector_values(cluster, rng):
     maps = [{"a": np.array([1.0, 2.0]), "b": np.array([1.0, 1.0])},
             {"a": np.array([10.0, 20.0])},
@@ -242,6 +285,10 @@ def test_map_differential(cluster, op, rng):
 def test_tpu_map_mixed_value_shapes_rejected(cluster):
     maps = [{"a": 1.0}, {"b": np.ones(3)}, {}, {}]
     with pytest.raises(Mp4jError):
+        cluster.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+    # scalar vs shape-(1,) arrays must raise too, not silently flatten
+    maps = [{"a": 1.0}, {"a": np.ones(1)}, {}, {}]
+    with pytest.raises(Mp4jError, match="share"):
         cluster.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
 
 

@@ -84,6 +84,117 @@ def test_sparse_allreduce_vector_values():
     assert got == {2: [11.0, 22.0], 4: [5.0, 6.0]}
 
 
+def test_block_owner_matches_meta():
+    from ytk_mp4j_tpu import meta
+
+    for size, n in ((10, 3), (8, 8), (7, 8), (100, 4), (5, 2)):
+        codes = jnp.arange(size, dtype=jnp.int32)
+        got = np.asarray(jax.jit(
+            lambda c: sp.block_owner(c, size, n))(codes))
+        want = [meta.owner_of(i, 0, size, n) for i in range(size)]
+        np.testing.assert_array_equal(got, want)
+    # sentinel / out-of-range codes map to n (maskable)
+    codes = jnp.array([sp.SENTINEL, -1, 10], dtype=jnp.int32)
+    got = np.asarray(sp.block_owner(codes, 10, 4))
+    np.testing.assert_array_equal(got, [4, 4, 4])
+
+
+def _stage_per_rank(per_rank, vshape=()):
+    n = len(per_rank)
+    Lmax = max(len(i) for i, _ in per_rank)
+    idx = np.full((n, Lmax), sp.SENTINEL, dtype=np.int32)
+    val = np.zeros((n, Lmax) + vshape, dtype=np.float64)
+    for r, (ii, vv) in enumerate(per_rank):
+        for j, (i, v) in enumerate(zip(ii, vv)):
+            idx[r, j] = i
+            val[r, j] = v
+    return idx, val
+
+
+@pytest.mark.parametrize("n,size,capacity", [(4, 20, 32), (8, 13, 16),
+                                             (3, 7, 8)])
+def test_sparse_reduce_scatter(n, size, capacity, rng):
+    """Each member ends with exactly its block-owned share of the
+    reduced union, packed ascending; shares are disjoint and cover the
+    union. ``capacity >= size`` bounds the union like real callers do."""
+    from ytk_mp4j_tpu import meta
+
+    per_rank = []
+    for r in range(n):
+        k = int(rng.integers(1, size))
+        ii = sorted(rng.choice(size, k, replace=False).tolist())
+        per_rank.append((ii, [float(r * 100 + i) for i in ii]))
+    idx, val = _stage_per_rank(per_rank)
+    mesh = make_mesh(n)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("mp4j"), P("mp4j")),
+             out_specs=(P("mp4j"), P("mp4j")))
+    def f(i, v):
+        oi, ov = sp.sparse_reduce_scatter(i[0], v[0], capacity, size,
+                                          Operators.SUM, "mp4j")
+        return oi[None], ov[None]
+
+    oi, ov = map(np.asarray, f(idx, val))
+    want = {}
+    for ii, vv in per_rank:
+        for i, v in zip(ii, vv):
+            want[i] = want.get(i, 0.0) + v
+    seen = {}
+    for r in range(n):
+        live = oi[r] != sp.SENTINEL
+        codes = oi[r][live]
+        assert (np.diff(codes) > 0).all()       # ascending, deduped
+        for c, v in zip(codes, ov[r][live]):
+            assert meta.owner_of(int(c), 0, size, n) == r
+            assert int(c) not in seen           # disjoint shares
+            seen[int(c)] = float(v)
+    assert seen == want
+
+
+def test_sparse_allgather():
+    per_rank = [([5, 9], [1.0, 2.0]),
+                ([1], [3.0]),
+                ([5, 7], [4.0, 5.0])]   # 5 duplicates across members
+    idx, val = _stage_per_rank(per_rank)
+    mesh = make_mesh(3)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("mp4j"), P("mp4j")),
+             out_specs=(P(None), P(None)))
+    def f(i, v):
+        return sp.sparse_allgather(i[0], v[0], "mp4j")
+
+    oi, ov = map(np.asarray, f(idx, val))
+    live = oi != sp.SENTINEL
+    pairs = sorted(zip(oi[live].tolist(), ov[live].tolist()))
+    assert pairs == [(1, 3.0), (5, 1.0), (5, 4.0), (7, 5.0), (9, 2.0)]
+    # sentinel padding sits at the end
+    assert not live[live.argmin():].any() or live.all()
+
+
+def test_sparse_allgather_then_reduce_is_allreduce():
+    """The documented composition: allgather + segment_reduce_sorted
+    == sparse_allreduce."""
+    per_rank = [([2, 4], [1.0, 2.0]), ([2, 6], [10.0, 20.0])]
+    idx, val = _stage_per_rank(per_rank)
+    mesh = make_mesh(2)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("mp4j"), P("mp4j")),
+             out_specs=(P(None), P(None)))
+    def f(i, v):
+        gi, gv = sp.sparse_allgather(i[0], v[0], "mp4j")
+        return sp.segment_reduce_sorted(gi, gv, 4, Operators.SUM)
+
+    oi, ov = map(np.asarray, f(idx, val))
+    got = {int(i): float(v) for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert got == {2: 11.0, 4: 2.0, 6: 20.0}
+
+
 def test_sparse_to_dense():
     idx = jnp.array([0, 3, sp.SENTINEL], dtype=jnp.int32)
     val = jnp.array([1.5, 2.5, 99.0])

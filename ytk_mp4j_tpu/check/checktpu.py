@@ -207,6 +207,38 @@ def check_functional(t: Tally, n: int, length: int = 64, devices=None):
     t.expect("functional/sparse_allreduce",
              np.array(sorted(got.items())), np.array(sorted(want.items())),
              False)
+    # sparse reduce-scatter: each member keeps its block-owned share
+    size = 2 * n
+    f = jax.jit(partial(
+        jax.shard_map, mesh=mesh, check_vma=False,
+        in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis)))(
+        lambda i, v: tuple(
+            x[None] for x in sparse_ops.sparse_reduce_scatter(
+                i[0], v[0], 2 * n, size, Operators.SUM, axis))))
+    oi, ov = f(idx, val)
+    oi, ov = np.asarray(oi), np.asarray(ov)
+    got_rs = {}
+    for r in range(n):
+        for i, v in zip(oi[r], ov[r]):
+            if i != sparse_ops.SENTINEL:
+                t.expect("functional/sparse_reduce_scatter/owner",
+                         meta.owner_of(int(i), 0, size, n), r, True)
+                got_rs[int(i)] = float(v)
+    t.expect("functional/sparse_reduce_scatter",
+             np.array(sorted(got_rs.items())),
+             np.array(sorted(want.items())), False)
+    # sparse allgather: disjoint-union pairs, sorted, duplicates kept
+    f = jax.jit(partial(
+        jax.shard_map, mesh=mesh, check_vma=False,
+        in_specs=(P(axis), P(axis)), out_specs=(P(None), P(None)))(
+        lambda i, v: sparse_ops.sparse_allgather(i[0], v[0], axis)))
+    oi, ov = map(np.asarray, f(idx, val))
+    live = oi != sparse_ops.SENTINEL
+    t.expect("functional/sparse_allgather",
+             np.array(sorted(zip(oi[live], ov[live]))),
+             np.array(sorted((int(i), float(v))
+                             for row_i, row_v in zip(idx, val)
+                             for i, v in zip(row_i, row_v))), False)
 
 
 def _run_battery(n: int, devices=None) -> dict:

@@ -1,0 +1,180 @@
+"""Persistent key<->code vocabularies for the driver-mode map collectives.
+
+The reference's sparse ``Map<K, V>`` path re-serializes whole maps with
+Kryo every call (SURVEY.md section 3c). Round 2's TPU packing did the
+host half of that work per call too: ``sorted(set().union(*maps))`` over
+the full key union plus a per-entry Python pack loop — measured as the
+reason the device map path LOST to the socket dict loop at configs[2]
+(BASELINE.md round-3 A/B: 122k vs 169k keys/sec). A real sparse-gradient
+stream has a near-persistent vocabulary, so none of that work is
+per-call: these codecs assign each distinct key a stable int32 code ONCE
+(grow-only) and translate whole maps with vectorized numpy.
+
+Two implementations, chosen by key type at first use:
+
+- :class:`IntKeyCodec` — integer feature-id keys (the ytk-learn
+  sparse-gradient shape). Keys never touch Python: encode is one
+  ``np.fromiter`` + ``np.searchsorted`` against the sorted known-key
+  table; growth merges the (pre-sorted) novelty in with one stable
+  mergesort.
+- :class:`ObjKeyCodec` — strings and other hashables. Encode is one
+  C-level ``np.fromiter(map(dict.__getitem__, keys))`` pass; only NEW
+  keys take the Python insert path, once ever.
+
+Both cache ``meta.key_partition`` per code (the blake2b digest is by far
+the most expensive per-key operation in the scatter family), and both
+decode with one vectorized take from the code->key table.
+
+Codes are dense in [0, size) and stay below ``ops.sparse.SENTINEL``.
+"""
+
+from __future__ import annotations
+
+from operator import index as _as_index
+
+import numpy as np
+
+from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.ops.sparse import SENTINEL
+
+
+def codec_for_key(key):
+    """A fresh codec suited to ``key``'s type (bool is NOT an int key:
+    it would collide with 0/1 while claiming the fast path)."""
+    if isinstance(key, (int, np.integer)) and not isinstance(key, bool):
+        return IntKeyCodec()
+    return ObjKeyCodec()
+
+
+class _Partitions:
+    """code -> rank cache, grown alongside the vocabulary. Placement is
+    meta.key_partition on the ORIGINAL key (both backends must agree),
+    computed once per (key, n). ``tail_keys(old)`` materializes only
+    the keys for codes >= old — the cache-hit path does no
+    per-vocabulary work at all."""
+
+    def __init__(self):
+        self._by_n: dict[int, np.ndarray] = {}
+
+    def lookup(self, codes: np.ndarray, n: int, size: int,
+               tail_keys) -> np.ndarray:
+        arr = self._by_n.get(n)
+        old = 0 if arr is None else arr.size
+        if old < size:
+            new = np.fromiter(
+                (meta.key_partition(k, n) for k in tail_keys(old)),
+                np.int32, size - old)
+            arr = new if arr is None else np.concatenate([arr, new])
+            self._by_n[n] = arr
+        return arr[codes]
+
+
+class IntKeyCodec:
+    """Grow-only int64 key <-> int32 code vocabulary (vectorized)."""
+
+    def __init__(self):
+        self._sorted = np.empty(0, np.int64)        # known keys, sorted
+        self._sorted_codes = np.empty(0, np.int32)  # their codes
+        self._by_code = np.empty(0, np.int64)       # code -> key
+        self._partitions = _Partitions()
+
+    @property
+    def size(self) -> int:
+        return self._by_code.size
+
+    def _lookup(self, ks: np.ndarray) -> np.ndarray:
+        """Codes for ``ks``; -1 where unknown."""
+        if self._sorted.size == 0:
+            return np.full(ks.size, -1, np.int32)
+        pos = np.minimum(np.searchsorted(self._sorted, ks),
+                         self._sorted.size - 1)
+        return np.where(self._sorted[pos] == ks,
+                        self._sorted_codes[pos], np.int32(-1))
+
+    def encode(self, keys, count: int) -> np.ndarray:
+        """int32 codes for ``keys`` (re-iterable, ``count`` long),
+        assigning fresh codes to novel keys."""
+        try:
+            # operator.index is the exact-integer gate: floats (which
+            # np.fromiter(..., int64) would silently TRUNCATE — 2.5
+            # becoming key 2) raise TypeError, big ints stay exact
+            ks = np.fromiter(map(_as_index, keys), np.int64, count)
+        except (TypeError, ValueError, OverflowError) as e:
+            raise Mp4jError(
+                f"map keys must be homogeneous int64-representable "
+                f"integers on this stream: {e}") from None
+        codes = self._lookup(ks)
+        miss = codes < 0
+        if miss.any():
+            new = np.unique(ks[miss])
+            start = self._by_code.size
+            if start + new.size >= int(SENTINEL):
+                raise Mp4jError("key vocabulary overflows int32 codes")
+            new_codes = np.arange(start, start + new.size, dtype=np.int32)
+            self._by_code = np.concatenate([self._by_code, new])
+            order = np.argsort(
+                np.concatenate([self._sorted, new]), kind="stable")
+            allk = np.concatenate([self._sorted, new])
+            allc = np.concatenate([self._sorted_codes, new_codes])
+            self._sorted, self._sorted_codes = allk[order], allc[order]
+            codes = self._lookup(ks)
+        return codes
+
+    def decode(self, codes: np.ndarray) -> list:
+        """Python-int keys for ``codes`` (one vectorized take)."""
+        return self._by_code[codes].tolist()
+
+    def partition(self, codes: np.ndarray, n: int) -> np.ndarray:
+        # tolist() -> python ints: key_partition hashes repr(key), and
+        # repr(np.int64(5)) != repr(5) on numpy >= 2; only the NEW tail
+        # is ever materialized (cache hits do no per-vocab work)
+        return self._partitions.lookup(
+            codes, n, self._by_code.size,
+            lambda old: self._by_code[old:].tolist())
+
+
+class ObjKeyCodec:
+    """Grow-only hashable-key <-> int32 code vocabulary."""
+
+    def __init__(self):
+        self._code: dict = {}
+        self._by_code: list = []
+        self._arr: np.ndarray | None = None   # object array for decode
+        self._partitions = _Partitions()
+
+    @property
+    def size(self) -> int:
+        return len(self._by_code)
+
+    def encode(self, keys, count: int) -> np.ndarray:
+        code = self._code
+        try:
+            return np.fromiter(map(code.__getitem__, keys),
+                               np.int32, count)
+        except KeyError:
+            pass
+        except TypeError as e:
+            raise Mp4jError(f"map keys must be hashable: {e}") from None
+        start = len(self._by_code)
+        for k in keys:
+            if k not in code:
+                code[k] = len(self._by_code)
+                self._by_code.append(k)
+        if len(self._by_code) >= int(SENTINEL):
+            raise Mp4jError("key vocabulary overflows int32 codes")
+        if len(self._by_code) > start:
+            self._arr = None   # decode table stale
+        return np.fromiter(map(code.__getitem__, keys), np.int32, count)
+
+    def decode(self, codes: np.ndarray) -> list:
+        if self._arr is None or self._arr.size < len(self._by_code):
+            arr = np.empty(len(self._by_code), object)
+            arr[:] = self._by_code
+            self._arr = arr
+        return self._arr[codes].tolist()
+
+    def partition(self, codes: np.ndarray, n: int) -> np.ndarray:
+        return self._partitions.lookup(
+            codes, n, len(self._by_code),
+            lambda old: self._by_code[old:])

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.ops.collectives import _axis_size, flat_index
 
 # Index sentinel for padding slots. int32 max keeps sorts stable (padding
 # sorts to the end) and is never a legal key code.
@@ -168,6 +169,60 @@ def sparse_allreduce(idx, val, capacity: int,
     gv = lax.all_gather(val, axis_name, axis=0, tiled=True)
     si, sv = sort_by_key(gi, gv)
     return segment_reduce_sorted(si, sv, capacity, operator)
+
+
+def block_owner(codes, size: int, n: int):
+    """Owning member of each key code under the BLOCK partition of the
+    key space ``[0, size)`` — jit-side twin of :func:`meta.owner_of`
+    (ranks ``0..size%n-1`` own ``ceil(size/n)`` codes, the rest
+    ``floor``). SENTINEL (or any out-of-range) codes map to ``n`` so
+    callers can mask them with one compare."""
+    base, rem = divmod(size, n)
+    cut = rem * (base + 1)
+    small = codes // max(base + 1, 1)
+    big = rem + (codes - cut) // max(base, 1)
+    owner = jnp.where(codes < cut, small, big)
+    return jnp.where((codes >= 0) & (codes < size), owner, n)
+
+
+def sparse_reduce_scatter(idx, val, capacity: int, size: int,
+                          operator: Operator = Operators.SUM,
+                          axis_name: str = "mp4j"):
+    """Key-union sparse reduce-scatter inside ``shard_map``: the union
+    is reduced exactly like :func:`sparse_allreduce`, then each member
+    KEEPS only the keys it owns under the block partition of the key
+    space ``[0, size)`` (:func:`block_owner`), packed ascending into
+    ``capacity`` SENTINEL/identity-padded slots.
+
+    The placement rule is block-by-code, not the host backends'
+    blake2b ``meta.key_partition``: in-jit there is no original key to
+    hash, only its int code — and block ownership is exactly what a
+    mesh-sharded parameter table (member r owns rows
+    ``[r*V/n, (r+1)*V/n)``) needs from its gradient reduce-scatter.
+    """
+    oi, ov = sparse_allreduce(idx, val, capacity, operator, axis_name)
+    me = flat_index(axis_name)
+    mine = block_owner(oi, size, _axis_size(axis_name)) == me
+    ident = jnp.asarray(operator.identity(ov.dtype), dtype=ov.dtype)
+    keep_i = jnp.where(mine, oi, SENTINEL)
+    keep_v = jnp.where(
+        mine.reshape((capacity,) + (1,) * (ov.ndim - 1)), ov, ident)
+    # repack the surviving entries to the front: dropped slots carry
+    # SENTINEL and sort to the end (stably, preserving ascending order)
+    return sort_by_key(keep_i, keep_v)
+
+
+def sparse_allgather(idx, val, axis_name: str = "mp4j"):
+    """Concatenate every member's (idx, val) entries and sort them by
+    key code: the disjoint-union gather of the map family, in-jit.
+    Output is ``[n * L]`` with all live entries ascending and SENTINEL
+    padding at the end. Duplicate codes across members are RETAINED as
+    adjacent entries (static shapes cannot raise data-dependently; feed
+    the result to :func:`segment_reduce_sorted` to merge, which is
+    exactly :func:`sparse_allreduce`)."""
+    gi = lax.all_gather(idx, axis_name, axis=0, tiled=True)
+    gv = lax.all_gather(val, axis_name, axis=0, tiled=True)
+    return sort_by_key(gi, gv)
 
 
 def sparse_to_dense(idx, val, size: int,
