@@ -20,8 +20,8 @@ y = ((X[:, 0] > 0).astype(np.float32)
 # allgather (QuantileBinner.fit_distributed; check/checkdist.py).
 binner = QuantileBinner(B)
 sketches = [binner.local_sketch(s) for s in np.array_split(X, 4)]
-binner.merge_sketches(np.stack([e for e, _ in sketches]),
-                      np.stack([c for _, c in sketches]))
+binner.merge_sketches(np.stack([s.values for s in sketches]),
+                      np.stack([s.counts for s in sketches]))
 bins = binner.transform(X)
 
 cfg = GBDTConfig(n_features=F, n_bins=B, depth=4, n_trees=5,

@@ -57,8 +57,8 @@ ym = ((np.isnan(Xm[:, 2]) | (Xm[:, 2] > 0.8))
 # from each shard's sketch (per-feature finite counts weight the merge)
 mbinner = QuantileBinner(B, missing_bucket=True)
 msk = [mbinner.local_sketch(s) for s in np.array_split(Xm, 3)]
-mbinner.merge_sketches(np.stack([e for e, _ in msk]),
-                       np.stack([c for _, c in msk]))
+mbinner.merge_sketches(np.stack([s.values for s in msk]),
+                       np.stack([s.counts for s in msk]))
 mbins = np.array(mbinner.transform(Xm))       # writable copy
 mbins[:, 9] = codes + 1                       # codes -> bins [1, 6]
 mcfg = GBDTConfig(n_features=F, n_bins=B, depth=4, n_trees=20,

