@@ -305,6 +305,22 @@ def check_sparse(results: dict, mesh: Mesh, n: int, cap: int = 1024):
                            (P(None), P(None))),
              _i32(n, cap), _f32(n, cap))
 
+    def body_rs(i, v):
+        oi, ov = sparse_ops.sparse_reduce_scatter(
+            i[0], v[0], cap * n, cap * n, Operators.SUM, AXIS)
+        return oi[None], ov[None]
+    _compile("sparse/reduce_scatter", results,
+             _shard_mapped(mesh, body_rs, (P(AXIS), P(AXIS)),
+                           (P(AXIS), P(AXIS))),
+             _i32(n, cap), _f32(n, cap))
+
+    def body_ag(i, v):
+        return sparse_ops.sparse_allgather(i[0], v[0], AXIS)
+    _compile("sparse/allgather", results,
+             _shard_mapped(mesh, body_ag, (P(AXIS), P(AXIS)),
+                           (P(None), P(None))),
+             _i32(n, cap), _f32(n, cap))
+
 
 def check_gbdt(results: dict, devices, n: int, per: int = 8192):
     """The flagship consumer's full train step (Pallas histogram kernel
@@ -355,12 +371,23 @@ def check_ffm(results: dict, devices, n: int, per: int = 1024):
     mesh = Mesh(np.asarray(devices[:n]), (AXIS,))
     tr = FMTrainer(cfg, mesh=mesh, sparse_grads=True)
     params_avals = jax.eval_shape(lambda: tr.init_params(0))
+    batch_avals = (_i32(n, per, cfg.max_nnz), _i32(n, per, cfg.max_nnz),
+                   _f32(n, per, cfg.max_nnz), _f32(n, per, cfg.max_nnz),
+                   _f32(n, per), _f32(n, per))
     _compile("ffm/sparse_train_step", results,
              tr._build_step(per * cfg.max_nnz),
-             params_avals,
-             _i32(n, per, cfg.max_nnz), _i32(n, per, cfg.max_nnz),
-             _f32(n, per, cfg.max_nnz), _f32(n, per, cfg.max_nnz),
-             _f32(n, per), _f32(n, per))
+             params_avals, *batch_avals)
+    # round-4 A/B: mesh-sharded table (owner-routed rows over
+    # all_to_all + compacted per-shard scatter) vs the replicated path
+    trs = FMTrainer(cfg, mesh=mesh, sparse_grads=True,
+                    table_sharding="sharded")
+    sharded_avals = (
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((cfg.n_features,), jnp.float32),
+        _f32(trs.n_rows_padded, cfg.k))
+    _compile("ffm/sparse_train_step_sharded", results,
+             trs._build_step(per * cfg.max_nnz),
+             sharded_avals, *batch_avals)
 
 
 def main(argv=None) -> int:

@@ -253,6 +253,103 @@ def train_step_sparse(params, batch, cfg: FMConfig, capacity: int,
     return (w0, w, V), loss
 
 
+def _fetch_rows_sharded(Vs, flat_rows, me, axis_name):
+    """Owner-routed row fetch from a block-sharded table: every
+    member's row-ids ride one (tiny, int32) all_gather, owners answer
+    with their rows over one ``all_to_all``, and the per-owner
+    contributions sum to the complete rows (each id is owned by exactly
+    one member). Returns ([S, k] rows for THIS member's ids, gi [n, S]
+    all requests, owner [n, S]) — the latter two are reused by the
+    train step's backward routing."""
+    B, _k = Vs.shape
+    gi = lax.all_gather(flat_rows, axis_name, axis=0,
+                        tiled=False)            # [n, S] all requests
+    owner = gi // B
+    local = jnp.where(owner == me, gi - me * B, 0)
+    contrib = Vs[local]                         # [n, S, k] row gather
+    contrib = jnp.where((owner == me)[..., None], contrib, 0.0)
+    recv = lax.all_to_all(contrib, axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)   # [n, S, k]
+    return jnp.sum(recv, axis=0), gi, owner
+
+
+def train_step_sparse_sharded(params, batch, cfg: FMConfig, n: int,
+                              axis_name="mp4j"):
+    """One step with the embedding table SHARDED over the mesh: member
+    m owns rows ``[m*B, (m+1)*B)`` of the (padded) table, B = rows/n.
+
+    The replicated sparse step's serial floor is the per-chip
+    scatter-add of ALL members' gradient rows (n*S descriptors into a
+    full replica; BASELINE.md prices it at 69.2 of 74.6 costed GB,
+    ~80 ns/row). Sharding changes both sides:
+
+    - forward: slot row-ids ride one (tiny, int32) all_gather; each
+      member gathers the requested rows IT OWNS from its shard (row
+      gathers pipeline at ~4 ns/row) and one ``all_to_all`` delivers
+      them — wire n*S*k, the same order as the replicated path's
+      gradient all_gather;
+    - backward: gradient rows route to their owners by ``all_to_all``
+      (replacing the all_gather), then each member merges its received
+      rows by sort + segmented reduction into at most
+      ``C = min(n*S, B)`` slots — C is bounded by the SHARD SIZE, so
+      no overflow is possible — and scatter-adds C descriptors into
+      its [B, k] shard. Round-4 chip measurement: drop-mode scatters
+      pay the serial unit per DESCRIPTOR, not per applied row (7/8
+      sentinel rows save only 3%), so the compaction is what converts
+      ownership into a real 1/n serial-floor cut; the set-scatter
+      inside the segmented reduction is the cheaper scatter form
+      (round-3: 15 vs 42 ms at 524288 rows).
+
+    Table memory per chip is V/n rows — the piece that makes
+    configs[4]'s Criteo-scale vocabulary fit a pod at all.
+    """
+    from ytk_mp4j_tpu.ops.collectives import flat_index
+
+    feats, fields, vals, mask, y, sw = batch
+    w0, w, Vs = params              # Vs: [B, k], this member's shard
+    w0, w = (lax.pcast(w0, axis_name, to="varying"),
+             lax.pcast(w, axis_name, to="varying"))
+    B, k = Vs.shape
+    me = flat_index(axis_name)
+    rows = _slot_rows(feats, fields, cfg)       # [N, K] / [N, K, K]
+    S = rows.size
+    flat_rows = rows.reshape(-1).astype(jnp.int32)
+
+    # ---- forward: owner-routed row fetch ----
+    E_flat, gi, owner = _fetch_rows_sharded(Vs, flat_rows, me, axis_name)
+    E = E_flat.reshape(rows.shape + (k,))
+
+    xv = vals * mask
+    loss, (g0, gw, gE), denom = _weighted_mean_grads(
+        (w0, w, E),
+        lambda p: _score_from_slots(p[0], p[1], p[2], feats, xv, cfg),
+        y, sw, cfg, axis_name)
+    g0 = lax.psum(g0, axis_name)
+    gw = lax.psum(gw, axis_name)     # linear part stays dense (small)
+
+    # ---- backward: owner-routed gradient rows ----
+    dest = flat_rows // B                           # [S]
+    onehot = dest[None, :] == jnp.arange(n)[:, None]
+    send = gE.reshape(S, k)[None] * onehot[..., None]   # [n, S, k]
+    recvg = lax.all_to_all(send, axis_name, split_axis=0,
+                           concat_axis=0, tiled=False)  # [n, S, k]
+    # received row j,s carries my local row id iff I own gi[j, s]
+    loc_ids = jnp.where(owner == me, gi - me * B, sparse_ops.SENTINEL)
+    si, sv = sparse_ops.sort_by_key(loc_ids.reshape(-1),
+                                    recvg.reshape(-1, k))
+    C = min(n * S, B)
+    li, lv = sparse_ops.segment_reduce_sorted(si, sv, C, Operators.SUM)
+
+    lr = cfg.learning_rate
+    w0 = w0 - lr * (g0 / denom)
+    w = w - lr * (gw / denom + cfg.l2 * w)
+    if cfg.l2:
+        Vs = Vs * (1.0 - lr * cfg.l2)
+    safe = jnp.where(li == sparse_ops.SENTINEL, B, li)
+    Vs = Vs.at[safe].add(-(lr / denom) * lv, mode="drop")
+    return (w0, w, Vs), loss
+
+
 def predict(params, feats, fields, vals, mask, cfg: FMConfig):
     z = _score(params, feats, fields, vals, mask, cfg)
     if cfg.loss == "logistic":
@@ -268,15 +365,28 @@ class FMTrainer(DataParallelTrainer):
     BASELINE.json configs[4]); default is the dense psum.
     """
 
+    TABLE_SHARDINGS = ("replicated", "sharded")
+
     def __init__(self, cfg: FMConfig, mesh=None, n_devices=None,
-                 sparse_grads: bool = False, sparse_capacity: int | None = None):
+                 sparse_grads: bool = False,
+                 sparse_capacity: int | None = None,
+                 table_sharding: str = "replicated"):
         super().__init__(mesh=mesh, n_devices=n_devices)
         self.cfg = cfg
         self.sparse_grads = sparse_grads
         self.sparse_capacity = sparse_capacity
+        if table_sharding not in self.TABLE_SHARDINGS:
+            raise Mp4jError(
+                f"table_sharding must be one of {self.TABLE_SHARDINGS}")
+        if table_sharding == "sharded" and not sparse_grads:
+            raise Mp4jError(
+                "table_sharding='sharded' rides the sparse-gradient "
+                "path; pass sparse_grads=True")
+        self.table_sharding = table_sharding
         self._step = None
         self._step_key = None
         self._eval_fn = None
+        self._pred_fn = None      # sharded serve (jit retraces by shape)
         self.eval_history_: list[float] = []
 
     @property
@@ -286,19 +396,75 @@ class FMTrainer(DataParallelTrainer):
             return self.cfg.n_features
         return self.cfg.n_features * self.cfg.n_fields
 
+    @property
+    def n_rows_padded(self) -> int:
+        """Table rows padded to a multiple of the shard count (sharded
+        mode stores B = n_rows_padded / n rows per member; the padding
+        rows are never referenced — ids stay < n_rows)."""
+        n = self.n_shards
+        return -(-self.n_rows // n) * n
+
     def init_params(self, seed: int = 0):
         rng = np.random.default_rng(seed)
         V = (self.cfg.init_scale
              * rng.standard_normal((self.n_rows, self.cfg.k))).astype(
                  np.float32)
-        return (jnp.zeros((), jnp.float32),
-                jnp.zeros((self.cfg.n_features,), jnp.float32),
-                jnp.asarray(V))
+        params = (jnp.zeros((), jnp.float32),
+                  jnp.zeros((self.cfg.n_features,), jnp.float32),
+                  jnp.asarray(V) if self.table_sharding != "sharded"
+                  else V)
+        return self._stage_table(params)   # no-op unless sharded
+
+    def full_table(self, params) -> np.ndarray:
+        """The complete [n_rows, k] embedding table on the host,
+        whatever the sharding (the serve/save shape)."""
+        return self._to_host(params[2])[: self.n_rows]
+
+    def _stage_table(self, params):
+        """Sharded mode: place a host/full-size table onto the mesh
+        (padded to n_rows_padded, block-sharded). Already-staged params
+        (from init_params or a previous step) pass through."""
+        if self.table_sharding != "sharded":
+            return params
+        V = params[2]
+        if (isinstance(V, jax.Array)
+                and V.shape == (self.n_rows_padded, self.cfg.k)):
+            return params
+        V = np.asarray(V)[: self.n_rows]
+        pad = self.n_rows_padded - self.n_rows
+        if pad:
+            V = np.pad(V, ((0, pad), (0, 0)))
+        Vg = jax.make_array_from_callback(
+            V.shape, self._row_sharding(), lambda idx: V[idx])
+        return (jnp.asarray(params[0]), jnp.asarray(params[1]), Vg)
+
+    def save_params(self, path: str, params) -> None:
+        """Persist with the table in its portable [n_rows, k] shape
+        (a sharded table is gathered + unpadded first, so the file is
+        loadable at any shard count)."""
+        if self.table_sharding == "sharded":
+            params = (self._to_host(params[0]),
+                      self._to_host(params[1]), self.full_table(params))
+        super().save_params(path, params)
 
     def _build_step(self, per_shard_slots: int):
         cfg = self.cfg
         axes = self.axes
         dspec = P(axes)
+        if self.table_sharding == "sharded":
+            step_fn = partial(train_step_sparse_sharded, cfg=cfg,
+                              n=self.n_shards, axis_name=axes)
+            pspec = (P(), P(), dspec)   # table sharded over the mesh
+
+            @partial(jax.shard_map, mesh=self.mesh, check_vma=False,
+                     in_specs=(pspec,) + (dspec,) * 6,
+                     out_specs=(pspec, P()))
+            def step(params, feats, fields, vals, mask, y, sw):
+                batch = (feats[0], fields[0], vals[0], mask[0], y[0],
+                         sw[0])
+                return step_fn(params, batch)
+
+            return jax.jit(step)
         if self.sparse_grads:
             cap = self.sparse_capacity
             if cap is None:
@@ -381,6 +547,7 @@ class FMTrainer(DataParallelTrainer):
             self._step_key = per_shard_slots
         if params is None:
             params = self.init_params(seed)
+        params = self._stage_table(params)
         va = None
         if eval_set is not None:
             va = self._prep_eval(*eval_set)
@@ -397,6 +564,62 @@ class FMTrainer(DataParallelTrainer):
                     params = stopper.best_state
                     losses = losses[:stopper.best_round + 1]
                 break
+        return params, np.asarray(jax.device_get(losses))
+
+    def fit_stream(self, batches, params=None, seed: int = 0,
+                   batch_rows: int | None = None):
+        """Chunked (out-of-core) training for data that cannot be staged
+        in memory — the Criteo-1TB shape of configs[4], where
+        ytk-learn consumes streamed libsvm-format text. ``batches`` is
+        any iterator/generator of ``(feats, fields, vals, y)``
+        minibatches (``utils.libsvm.read_libsvm`` streams them from
+        disk); one optimizer step runs per chunk.
+
+        Every chunk is padded to ``batch_rows`` total rows (default:
+        the first chunk's size rounded up to the shard count) with
+        zero-weight rows, so ONE jitted program serves the whole
+        stream — drifting chunk sizes would otherwise recompile per
+        distinct size. A chunk larger than ``batch_rows`` raises.
+        Feeding the full dataset as a single chunk E times is
+        numerically identical to ``fit(n_steps=E)`` (tested in
+        tests/test_fm.py). Returns (params, per-chunk losses)."""
+        if params is None:
+            params = self.init_params(seed)
+        params = self._stage_table(params)
+        if batch_rows is not None:
+            # the padded batch splits evenly over the mesh
+            batch_rows = -(-batch_rows // self.n_shards) * self.n_shards
+        losses = []
+        for feats, fields, vals, y in batches:
+            y = np.asarray(y, np.float32)
+            feats, fields, vals, mask = self._stage_instances(
+                feats, fields, vals)
+            N = feats.shape[0]
+            if batch_rows is None:
+                batch_rows = -(-N // self.n_shards) * self.n_shards
+            if N > batch_rows:
+                raise Mp4jError(
+                    f"chunk of {N} rows exceeds batch_rows="
+                    f"{batch_rows}; raise batch_rows or shrink the "
+                    "reader's chunk size")
+            pad = batch_rows - N
+            sw = np.ones(N, np.float32)
+            if pad:
+                rows = ((0, pad),)
+                feats, fields, vals, mask = (
+                    np.pad(a, rows + ((0, 0),))
+                    for a in (feats, fields, vals, mask))
+                y, sw = np.pad(y, rows), np.pad(sw, rows)
+            per = batch_rows // self.n_shards
+            sharded = tuple(self._put_sharded(a, per)
+                            for a in (feats, fields, vals, mask, y, sw))
+            per_shard_slots = per * self.cfg.max_nnz
+            if self._step is None or self._step_key != per_shard_slots:
+                self._step = self._build_step(per_shard_slots)
+                self._step_key = per_shard_slots
+            params, loss = self._step(params, *sharded)
+            # bound in-flight programs, like fit()
+            losses.append(jax.block_until_ready(loss))
         return params, np.asarray(jax.device_get(losses))
 
     def _stage_instances(self, feats, fields, vals):
@@ -438,9 +661,48 @@ class FMTrainer(DataParallelTrainer):
         # meshes; a plain local jit cannot consume those directly
         return float(self._eval_fn(self._local_values(params), *va))
 
+    def _build_sharded_predict(self):
+        """Serve-side shard_map program: owner-routed row fetch from
+        the SHARDED table — the full [n_rows, k] replica is never
+        materialized anywhere, which is the point of sharding a
+        Criteo-scale vocabulary in the first place."""
+        from ytk_mp4j_tpu.ops.collectives import flat_index
+
+        cfg = self.cfg
+        axes = self.axes
+        dspec = P(axes)
+
+        @partial(jax.shard_map, mesh=self.mesh, check_vma=False,
+                 in_specs=((P(), P(), dspec),) + (dspec,) * 4,
+                 out_specs=dspec)
+        def run(params, feats, fields, vals, mask):
+            w0, w, Vs = params
+            f0, fl0 = feats[0], fields[0]
+            rows = _slot_rows(f0, fl0, cfg)
+            E_flat, _, _ = _fetch_rows_sharded(
+                Vs, rows.reshape(-1).astype(jnp.int32),
+                flat_index(axes), axes)
+            E = E_flat.reshape(rows.shape + (Vs.shape[1],))
+            z = _score_from_slots(w0, w, E, f0, vals[0] * mask[0], cfg)
+            if cfg.loss == "logistic":
+                z = jax.nn.sigmoid(z)
+            return z[None]
+
+        return jax.jit(run)
+
     def predict(self, params, feats, fields, vals):
         feats, fields, vals, mask = self._stage_instances(feats, fields,
                                                           vals)
+        if self.table_sharding == "sharded":
+            params = self._stage_table(params)
+            N = feats.shape[0]
+            (f, fl, v, m), per, _sw = self._pad_rows(
+                [feats, fields, vals, mask])
+            if self._pred_fn is None:
+                self._pred_fn = self._build_sharded_predict()
+            staged = [self._put_sharded(a, per) for a in (f, fl, v, m)]
+            out = np.asarray(self._pred_fn(params, *staged)).reshape(-1)
+            return out[:N]
         return np.asarray(predict(params, jnp.asarray(feats),
                                   jnp.asarray(fields), jnp.asarray(vals),
                                   jnp.asarray(mask), self.cfg))

@@ -1,0 +1,113 @@
+"""Streaming libsvm / libffm text reader — the consumer-side ingestion
+path for data that cannot be staged in memory (ytk-learn trains from
+libsvm-format files; BASELINE.json configs[4] names a 1TB workload).
+
+Formats, one instance per line:
+
+    libsvm:  ``label feat:val feat:val ...``
+    libffm:  ``label field:feat:val field:feat:val ...``
+
+``read_libsvm`` yields fixed-width ``(feats, fields, vals, y)`` numpy
+chunks of at most ``chunk_rows`` rows, each slot axis padded to
+``max_nnz`` (padded slots carry value 0, the mask convention of
+``FMTrainer``) — exactly the minibatch shape ``FMTrainer.fit_stream``
+consumes, so ``fit_stream(read_libsvm(path, ...))`` trains end-to-end
+without ever holding more than one chunk in memory.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+
+def parse_line(line: str, max_nnz: int, lineno: int):
+    """One ``label [field:]feat:val ...`` line -> (y, feats, fields,
+    vals) lists. Mixed 2- and 3-part tokens on one line are an error;
+    more than ``max_nnz`` tokens are an error (silent truncation would
+    quietly change the model)."""
+    parts = line.split()
+    try:
+        y = float(parts[0])
+    except ValueError:
+        raise Mp4jError(
+            f"line {lineno}: label {parts[0]!r} is not a number") from None
+    if len(parts) - 1 > max_nnz:
+        raise Mp4jError(
+            f"line {lineno}: {len(parts) - 1} entries exceed "
+            f"max_nnz={max_nnz}")
+    feats, fields, vals = [], [], []
+    width = None
+    for tok in parts[1:]:
+        pieces = tok.split(":")
+        if width is None:
+            width = len(pieces)
+        if len(pieces) != width or width not in (2, 3):
+            raise Mp4jError(
+                f"line {lineno}: token {tok!r} is neither feat:val nor "
+                "field:feat:val (or the line mixes the two)")
+        try:
+            if width == 2:
+                feats.append(int(pieces[0]))
+                fields.append(0)
+                vals.append(float(pieces[1]))
+            else:
+                fields.append(int(pieces[0]))
+                feats.append(int(pieces[1]))
+                vals.append(float(pieces[2]))
+        except ValueError:
+            raise Mp4jError(
+                f"line {lineno}: malformed token {tok!r}") from None
+    return y, feats, fields, vals
+
+
+def read_libsvm(path_or_lines, chunk_rows: int, max_nnz: int):
+    """Stream a libsvm/libffm source in fixed-width numpy chunks.
+
+    ``path_or_lines``: a file path or any iterable of text lines (an
+    open file object streams without loading the file). Yields
+    ``(feats [N, max_nnz] i32, fields [N, max_nnz] i32,
+    vals [N, max_nnz] f32, y [N] f32)`` with ``N <= chunk_rows`` —
+    feed directly to ``FMTrainer.fit_stream`` (pass
+    ``batch_rows=chunk_rows`` so the short final chunk reuses the same
+    compiled step).
+    """
+    if chunk_rows <= 0:
+        raise Mp4jError(f"chunk_rows must be positive, got {chunk_rows}")
+
+    def chunks(lines):
+        buf_y, buf_f, buf_fl, buf_v = [], [], [], []
+
+        def flush():
+            n = len(buf_y)
+            feats = np.zeros((n, max_nnz), np.int32)
+            fields = np.zeros((n, max_nnz), np.int32)
+            vals = np.zeros((n, max_nnz), np.float32)
+            for i, (f, fl, v) in enumerate(zip(buf_f, buf_fl, buf_v)):
+                feats[i, : len(f)] = f
+                fields[i, : len(fl)] = fl
+                vals[i, : len(v)] = v
+            y = np.asarray(buf_y, np.float32)
+            buf_y.clear(), buf_f.clear(), buf_fl.clear(), buf_v.clear()
+            return feats, fields, vals, y
+
+        for lineno, line in enumerate(lines, 1):
+            if not line.strip():
+                continue
+            y, feats, fields, vals = parse_line(line, max_nnz, lineno)
+            buf_y.append(y)
+            buf_f.append(feats)
+            buf_fl.append(fields)
+            buf_v.append(vals)
+            if len(buf_y) == chunk_rows:
+                yield flush()
+        if buf_y:
+            yield flush()
+
+    if isinstance(path_or_lines, str):
+        def from_path():
+            with open(path_or_lines) as fh:
+                yield from chunks(fh)
+        return from_path()
+    return chunks(path_or_lines)
