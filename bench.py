@@ -288,6 +288,34 @@ def bench_ffm_tpu(n=8192, n_features=100_000, n_fields=8, k=8,
     return 1.0 / dt
 
 
+def bench_ffm_stream(chunks=6, rows=8192):
+    """configs[4] ingestion: rows/sec through ``fit_stream`` — chunk
+    staging + padding + one sparse FFM step per chunk (the out-of-core
+    path a Criteo-scale run must ride; chunk synthesis stands in for
+    the file reader)."""
+    from ytk_mp4j_tpu.models.fm import FMConfig, FMTrainer
+
+    rng = np.random.default_rng(3)
+    cfg = FMConfig(model="ffm", n_features=100_000, n_fields=8, k=8,
+                   max_nnz=8, learning_rate=0.05)
+    tr = FMTrainer(cfg, sparse_grads=True)
+
+    def gen(n):
+        for _ in range(n):
+            feats = rng.integers(0, cfg.n_features,
+                                 (rows, 8)).astype(np.int32)
+            fields = rng.integers(0, 8, (rows, 8)).astype(np.int32)
+            vals = np.ones((rows, 8), np.float32)
+            y = (rng.random(rows) > 0.5).astype(np.float32)
+            yield feats, fields, vals, y
+
+    params, _ = tr.fit_stream(gen(1), batch_rows=rows)  # compile once
+    t0 = time.perf_counter()
+    params, _ = tr.fit_stream(gen(chunks), params=params,
+                              batch_rows=rows)
+    return chunks * rows / (time.perf_counter() - t0)
+
+
 def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
     """Map<String,Double> sparse-grad allreduce over loopback TCP
     (BASELINE.md configs[2], the reference's Kryo operand path —
@@ -338,6 +366,7 @@ def main():
     map_int_keys = bench_socket_map(int_keys=True)
     tpu_gbs, trees_per_sec, n_chips = bench_tpu(n=n_tpu)
     ffm_steps = bench_ffm_tpu()
+    ffm_stream_rows = bench_ffm_stream()
     print(json.dumps({
         "metric": "gbdt-histogram-allreduce GB/s/chip",
         "value": round(tpu_gbs, 4),
@@ -349,6 +378,14 @@ def main():
             "socket_collective_gbs": round(sock_coll_gbs, 4),
             "socket_native_collective_gbs": round(sock_native_coll_gbs, 4),
             "ffm_sparse_steps_per_sec": round(ffm_steps, 3),
+            "ffm_stream_rows_per_sec": round(ffm_stream_rows, 0),
+            "vs_baseline_derate_caveat": (
+                "this host has ONE core, so the 4 socket-baseline "
+                "slaves time-share it; on a realistic 4-core host the "
+                "socket denominator rises up to ~4x and the honest "
+                "ratio lands near vs_baseline/4 (see BASELINE.md) — "
+                "still clearing the >=10x north star, but vs_baseline "
+                "as printed is environment-specific"),
             "socket_map_allreduce_keys_per_sec": round(map_keys, 0),
             "socket_map_int_allreduce_keys_per_sec": round(map_int_keys, 0),
             "n_chips": n_chips,
