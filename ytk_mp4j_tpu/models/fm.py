@@ -420,6 +420,17 @@ class FMTrainer(DataParallelTrainer):
         whatever the sharding (the serve/save shape)."""
         return self._to_host(params[2])[: self.n_rows]
 
+    def _place_params(self, params):
+        """Commit params to their exact step shardings (replicated
+        scalars/linear weights; replicated or block-sharded table) so
+        the first step call compiles the same program signature as
+        every later one — see ``DataParallelTrainer._place_replicated``
+        for the duplicate-compile failure this prevents."""
+        if self.table_sharding == "sharded":
+            params = self._stage_table(params)
+            return (*self._place_replicated(params[:2]), params[2])
+        return self._place_replicated(params)
+
     def _stage_table(self, params):
         """Sharded mode: place a host/full-size table onto the mesh
         (padded to n_rows_padded, block-sharded). Already-staged params
@@ -547,7 +558,7 @@ class FMTrainer(DataParallelTrainer):
             self._step_key = per_shard_slots
         if params is None:
             params = self.init_params(seed)
-        params = self._stage_table(params)
+        params = self._place_params(params)
         va = None
         if eval_set is not None:
             va = self._prep_eval(*eval_set)
@@ -585,7 +596,7 @@ class FMTrainer(DataParallelTrainer):
         tests/test_fm.py). Returns (params, per-chunk losses)."""
         if params is None:
             params = self.init_params(seed)
-        params = self._stage_table(params)
+        params = self._place_params(params)
         if batch_rows is not None:
             # the padded batch splits evenly over the mesh
             batch_rows = -(-batch_rows // self.n_shards) * self.n_shards

@@ -188,6 +188,9 @@ class LinearTrainer(DataParallelTrainer):
         dx, dy, dsw = self.shard_data(x, y)
         if params is None:
             params = self.init_params()
+        # committed up front: an uncommitted first call would compile
+        # the step twice (see DataParallelTrainer._place_replicated)
+        params = self._place_replicated(params)
         vel = jax.tree_util.tree_map(jnp.zeros_like, params)
         va = None
         if eval_set is not None:
