@@ -232,6 +232,45 @@ def check_ffm_global_mesh(comm) -> int:
     return fails
 
 
+def check_ffm_round4_global_mesh(comm) -> int:
+    """Round-4 FFM surfaces at DCN scale: the mesh-SHARDED embedding
+    table and the streaming fit must both train to the replicated
+    full-batch losses over the global (all-process) mesh."""
+    from ytk_mp4j_tpu.comm.distributed import global_mesh
+    from ytk_mp4j_tpu.models.fm import FMConfig, FMTrainer
+
+    fails = 0
+    rng = np.random.default_rng(42)             # same data everywhere
+    N, K, nf, k, F = 192, 3, 3, 3, 300
+    feats = rng.integers(0, F, (N, K)).astype(np.int32)
+    fields = rng.integers(0, nf, (N, K)).astype(np.int32)
+    vals = rng.random((N, K)).astype(np.float32)
+    y = (rng.random(N) > 0.5).astype(np.float32)
+    cfg = FMConfig(model="ffm", n_features=F, n_fields=nf, k=k,
+                   max_nnz=K, learning_rate=0.2, init_scale=0.1)
+
+    rep = FMTrainer(cfg, mesh=global_mesh(), sparse_grads=True)
+    _, l_rep = rep.fit(feats, fields, vals, y, n_steps=3, seed=11)
+    sh = FMTrainer(cfg, mesh=global_mesh(), sparse_grads=True,
+                   table_sharding="sharded")
+    _, l_sh = sh.fit(feats, fields, vals, y, n_steps=3, seed=11)
+    if not (all(np.isfinite(m) for m in l_sh)
+            and np.allclose(l_sh, l_rep, rtol=1e-4, atol=1e-6)):
+        comm.error(f"sharded-table global-mesh MISMATCH: {l_sh} "
+                   f"vs {l_rep}")
+        fails += 1
+
+    # reuse rep: same cfg/mesh/slots -> same compiled step; fit_stream
+    # with params=None re-inits from the seed, no state carryover
+    _, l_stream = rep.fit_stream(
+        ((feats, fields, vals, y) for _ in range(3)), seed=11)
+    if not np.allclose(l_stream, l_rep, rtol=1e-5, atol=1e-7):
+        comm.error(f"fit_stream global-mesh MISMATCH: {l_stream} "
+                   f"vs {l_rep}")
+        fails += 1
+    return fails
+
+
 def check_binning_dist(comm) -> int:
     """Distributed quantile binning at DCN scale: each process sketches
     its own shard, ONE allgather merges the sketches, and every rank
@@ -347,6 +386,7 @@ def main(argv=None) -> int:
         fails += check_global_mesh(comm)
         fails += check_gbdt_global_mesh(comm)
         fails += check_ffm_global_mesh(comm)
+        fails += check_ffm_round4_global_mesh(comm)
         fails += check_binning_dist(comm)
         fails += check_dense_plane_timing(comm)
         comm.info(f"checkdist done: {fails} failures")

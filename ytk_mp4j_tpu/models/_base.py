@@ -160,8 +160,10 @@ class DataParallelTrainer:
 
         ``make_array_from_callback`` (each process materializes only its
         addressable shards) makes this work unchanged on MULTI-PROCESS
-        meshes (jax.distributed), where a plain device_put cannot target
-        non-addressable devices; the callback path is identical to
+        meshes (jax.distributed), where a plain device_put cannot
+        target non-addressable devices for ROW-SHARDED placements like
+        this one (fully-REPLICATED placements of host inputs are fine —
+        see ``_place_replicated``); the callback path is identical to
         device_put on single-process meshes."""
         a = a.reshape((self.n_shards, per) + a.shape[1:])
         return jax.make_array_from_callback(
