@@ -101,6 +101,36 @@ def check(comm, length: int = 97) -> int:
     expect("reduce_scatter_map",
            d == {k: v for k, v in want_merged.items()
                  if meta.key_partition(k, n) == r})
+    # int-keyed maps with a DRIFTING vocabulary: the device plane's
+    # synchronized codecs must keep codes identical across processes
+    # while only novel keys ride the pickled exchange
+    for step in range(3):
+        imaps = [{int(q * 5 + j + 3 * step): float(q * 10 + j)
+                  for j in range(4)} for q in range(n)]
+        want: dict = {}
+        for m in imaps:
+            for k, v in m.items():
+                want[k] = want.get(k, 0.0) + v
+        d = dict(imaps[r])
+        comm.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        expect(f"allreduce_map_int/{step}", d == want)
+        d = dict(imaps[r])
+        comm.reduce_scatter_map(d, Operands.DOUBLE, Operators.SUM)
+        expect(f"reduce_scatter_map_int/{step}",
+               d == {k: v for k, v in want.items()
+                     if meta.key_partition(k, n) == r})
+    # rooted reduce on the map device plane: only root's dict merges
+    d = dict(maps[r])
+    comm.reduce_map(d, Operands.DOUBLE, Operators.SUM, root=n - 1)
+    expect("reduce_map", d == (want_merged if r == n - 1 else maps[r]))
+    # MAX on the map device plane (segment reducers, not all-reduce HLO)
+    d = dict(maps[r])
+    want_max: dict = {}
+    for m in maps:
+        for k, v in m.items():
+            want_max[k] = max(want_max.get(k, -np.inf), v)
+    comm.allreduce_map(d, Operands.DOUBLE, Operators.MAX)
+    expect("allreduce_map_max", d == want_max)
     return fails
 
 

@@ -16,10 +16,13 @@ Two layers are exposed here:
   the built-in SUM/MAX/MIN ride ONE device collective (psum / pmax /
   pmin / psum_scatter over a one-device-per-process mesh — 2L(n-1)/n
   wire bytes); PROD, custom operators, and the gather family use
-  ``multihost_utils`` allgather; map operands are pickled and exchanged
-  as padded byte buffers (the Kryo analogue at DCN scale). This is the
-  host-data path — device-resident perf work belongs on the meshes
-  below.
+  ``multihost_utils`` allgather. Numeric map operands ride the device
+  plane too (round 4): key<->code vocabularies are kept identical on
+  every process — only NOVEL keys ride a small pickled exchange, near
+  empty once a gradient stream's vocabulary stabilizes — and the
+  values travel as one device sparse allreduce; object values (and
+  64-bit without x64) fall back to the pickled whole-map exchange
+  (the Kryo analogue at DCN scale).
 - :func:`global_mesh` / :func:`hier_global_mesh` — mesh builders over
   ALL processes' devices for the perf path: user jit code with
   ``shard_map`` + ``ops.collectives`` (and the model families) runs
@@ -106,6 +109,9 @@ class DistributedComm(CommSlave):
         # _device_reduce_ok): the probe result is exchanged once and
         # AND-ed so every rank runs the same collective program
         self._agreed_native: dict[str, bool] = {}
+        # key kind -> codec, kept IDENTICAL across processes (grown
+        # only inside _union_device's synchronized novel-key exchange)
+        self._codecs_by_kind: dict[str, object] = {}
 
     # -- identity / control plane --------------------------------------
     @property
@@ -445,7 +451,14 @@ class DistributedComm(CommSlave):
         arr[s:e] = merged[s - lo: e - lo]
         return arr
 
-    # -- map collectives (pickled-object path) -------------------------
+    # -- map collectives -----------------------------------------------
+    # Two planes. The DEVICE plane (numeric operands): key<->code
+    # vocabularies kept IDENTICAL on every process (only novel keys
+    # ride a small pickled exchange — near-empty once a gradient
+    # stream's vocabulary stabilizes) and the values ride ONE device
+    # sparse allreduce over the per-process mesh, like the dense plane.
+    # The HOST plane (object values, or 64-bit without x64): the
+    # pickled whole-map exchange, the reference's Kryo analogue.
     @staticmethod
     def _merge_maps(operator: Operator, acc: dict, src: dict) -> dict:
         # plain per-key loop by measurement — see
@@ -454,16 +467,145 @@ class DistributedComm(CommSlave):
             acc[k] = operator.np_fn(acc[k], v) if k in acc else v
         return acc
 
+    def _map_device_ok(self, operand: Operand) -> bool:
+        if not operand.is_numeric:
+            return False
+        if (operand.dtype.itemsize == 8
+                and not jax.config.jax_enable_x64):
+            return False
+        return True
+
+    def _union_device(self, d: dict, operand: Operand,
+                      operator: Operator):
+        """The job-wide reduced union via the device plane as
+        ``(codec, codes, values)``, or None when every rank's map is
+        empty. Codec synchronization: each call, every rank's NOVEL
+        keys (plus its entry count, value shape and key kind) ride one
+        pickled exchange; all ranks then grow their codec with the same
+        union in the same order, so codes agree job-wide without ever
+        exchanging full maps again."""
+        from ytk_mp4j_tpu.comm import keycodec
+        from ytk_mp4j_tpu.ops import sparse as sparse_ops
+
+        k0 = next(iter(d)) if d else None
+        kind = None if k0 is None else keycodec.kind_of(k0)
+        vshape = None if not d else np.shape(d[k0])
+        codec = self._codecs_by_kind.get(kind) if kind else None
+        if kind and codec is None:
+            codec = self._codecs_by_kind[kind] = (
+                keycodec.codec_for_kind(kind))
+        novel = codec.novel(d.keys(), len(d)) if d else []
+        infos = self._exchange_obj((kind, novel, len(d), vshape))
+        kinds = {i[0] for i in infos if i[0] is not None}
+        if len(kinds) > 1:
+            raise Mp4jError(
+                f"map key kinds differ across ranks: {sorted(kinds)}")
+        vshapes = {i[3] for i in infos if i[3] is not None}
+        if len(vshapes) > 1:
+            raise Mp4jError(
+                f"map values must share a shape across ranks; got "
+                f"{sorted(vshapes)}")
+        total = sum(i[2] for i in infos)
+        if total == 0:
+            return None
+        job_kind = next(iter(kinds))
+        vshape = next(iter(vshapes))
+        if codec is None:   # this rank was empty: adopt the job's kind
+            codec = self._codecs_by_kind.get(job_kind)
+            if codec is None:
+                codec = self._codecs_by_kind[job_kind] = (
+                    keycodec.codec_for_kind(job_kind))
+        union_novel = [k for i in infos for k in i[1]]
+        if union_novel:
+            codec.encode(union_novel, len(union_novel))
+        Lmax = keycodec.pow2_bucket(max(1, max(i[2] for i in infos)))
+        ident = operator.identity(operand.dtype)
+        idx = np.full(Lmax, sparse_ops.SENTINEL, np.int32)
+        val = np.full((Lmax,) + vshape, ident, dtype=operand.dtype)
+        c = len(d)
+        if c:
+            idx[:c] = codec.encode(d.keys(), c)
+            try:
+                v = np.asarray(list(d.values()), dtype=operand.dtype)
+            except (TypeError, ValueError) as e:
+                raise Mp4jError(
+                    f"map values must share shape {vshape} and be "
+                    f"{operand.dtype}-castable: {e}") from None
+            if v.shape != (c,) + vshape:
+                raise Mp4jError(
+                    f"map values must share a shape; this rank has "
+                    f"{v.shape[1:]} vs {vshape}")
+            val[:c] = v
+        cap = keycodec.pow2_bucket(min(codec.size, total))
+        oi, ov = self._device_sparse_allreduce(idx, val, cap, operand,
+                                               operator)
+        live = oi != sparse_ops.SENTINEL
+        return codec, oi[live], ov[live]
+
+    def _device_sparse_allreduce(self, idx, val, capacity: int,
+                                 operand: Operand, operator: Operator):
+        from functools import partial
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ytk_mp4j_tpu.ops import sparse as sparse_ops
+
+        mesh = self._proc_mesh()
+        vshape = val.shape[1:]
+        key = ("sparse", idx.shape[0], capacity, vshape,
+               val.dtype.str, operator.name, id(operator))
+        fn = self._djits.get(key)
+        if fn is None:
+            def body(i, v):
+                return sparse_ops.sparse_allreduce(
+                    i[0], v[0], capacity, operator, "proc")
+
+            fn = jax.jit(partial(
+                jax.shard_map, mesh=mesh, check_vma=False,
+                in_specs=(P("proc"), P("proc")),
+                out_specs=(P(None), P(None)))(body))
+            self._djits[key] = fn
+        n = self._n
+        gi = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("proc")), idx[None, :],
+            (n,) + idx.shape)
+        gv = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("proc")), val[None],
+            (n,) + val.shape)
+        oi, ov = fn(gi, gv)
+        # two fetches is fine HERE, unlike the driver backend's
+        # single-fetch rule (tpu_comm._union_codes): each process reads
+        # its own LOCAL device — no ~100 ms tunnel RTT per asarray —
+        # and deriving the union host-side would mean shipping every
+        # rank's full code list through the pickled exchange, the O(K)
+        # per-call cost this plane exists to avoid
+        return (np.asarray(oi.addressable_data(0)),
+                np.asarray(ov.addressable_data(0)))
+
+    def _merged_union(self, d: dict, operand: Operand,
+                      operator: Operator) -> dict | None:
+        """The job-wide merged union dict via whichever plane applies;
+        None when the device plane saw every rank empty."""
+        if self._map_device_ok(operand):
+            out = self._union_device(d, operand, operator)
+            if out is None:
+                return None
+            codec, codes, vals = out
+            return dict(zip(codec.decode(codes), list(vals)))
+        merged: dict = {}
+        for m in self._exchange_obj(d):
+            self._merge_maps(operator, merged, m)
+        return merged
+
     def allreduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
                       operator: Operator = Operators.SUM) -> dict:
         self._assert_open()
         if self._n == 1:
             return d
-        acc: dict = {}
-        for m in self._exchange_obj(d):
-            self._merge_maps(operator, acc, m)
+        merged = self._merged_union(d, operand, operator)
+        if merged is None:
+            return d
         d.clear()
-        d.update(acc)
+        d.update(merged)
         return d
 
     def reduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
@@ -472,12 +614,12 @@ class DistributedComm(CommSlave):
         self._check_root(root)
         if self._n == 1:
             return d
-        acc: dict = {}
-        for m in self._exchange_obj(d):
-            self._merge_maps(operator, acc, m)
+        merged = self._merged_union(d, operand, operator)
+        if merged is None:
+            return d
         if self._rank == root:
             d.clear()
-            d.update(acc)
+            d.update(merged)
         return d
 
     def broadcast_map(self, d: dict, operand: Operand = Operands.DOUBLE,
@@ -553,11 +695,21 @@ class DistributedComm(CommSlave):
         self._assert_open()
         if self._n == 1:
             return d
-        acc: dict = {}
-        for m in self._exchange_obj(d):
-            self._merge_maps(operator, acc, m)
-        mine = {k: v for k, v in acc.items()
-                if meta.key_partition(k, self._n) == self._rank}
+        if self._map_device_ok(operand):
+            out = self._union_device(d, operand, operator)
+            if out is None:
+                return d
+            codec, codes, vals = out
+            # blake2b placement cached per code on the codec
+            mask = codec.partition(codes, self._n) == self._rank
+            mine = dict(zip(codec.decode(codes[mask]),
+                            list(vals[mask])))
+        else:
+            acc: dict = {}
+            for m in self._exchange_obj(d):
+                self._merge_maps(operator, acc, m)
+            mine = {k: v for k, v in acc.items()
+                    if meta.key_partition(k, self._n) == self._rank}
         d.clear()
         d.update(mine)
         return d

@@ -39,12 +39,30 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.ops.sparse import SENTINEL
 
 
-def codec_for_key(key):
-    """A fresh codec suited to ``key``'s type (bool is NOT an int key:
-    it would collide with 0/1 while claiming the fast path)."""
+def kind_of(key) -> str:
+    """``"int"`` or ``"obj"`` — the ONE key-kind rule every backend
+    shares (bool is NOT an int key: it would collide with 0/1 while
+    claiming the fast path)."""
     if isinstance(key, (int, np.integer)) and not isinstance(key, bool):
-        return IntKeyCodec()
-    return ObjKeyCodec()
+        return "int"
+    return "obj"
+
+
+def codec_for_kind(kind: str):
+    """A fresh codec for a :func:`kind_of` kind."""
+    return IntKeyCodec() if kind == "int" else ObjKeyCodec()
+
+
+def codec_for_key(key):
+    """A fresh codec suited to ``key``'s type."""
+    return codec_for_kind(kind_of(key))
+
+
+def pow2_bucket(x: int) -> int:
+    """Smallest power of 2 >= x (x >= 1) — the shared bucket rule that
+    bounds map-collective recompiles at O(log max-keys) programs on
+    every backend (see ``tpu_comm._encode_maps``)."""
+    return 1 << (x - 1).bit_length()
 
 
 class _Partitions:
@@ -125,6 +143,18 @@ class IntKeyCodec:
         """Python-int keys for ``codes`` (one vectorized take)."""
         return self._by_code[codes].tolist()
 
+    def novel(self, keys, count: int) -> list:
+        """The subset of ``keys`` not yet in the vocabulary (insertion
+        candidates for SPMD vocab synchronization — every member must
+        grow its codec with the SAME keys in the same order)."""
+        try:
+            ks = np.fromiter(map(_as_index, keys), np.int64, count)
+        except (TypeError, ValueError, OverflowError) as e:
+            raise Mp4jError(
+                f"map keys must be homogeneous int64-representable "
+                f"integers on this stream: {e}") from None
+        return ks[self._lookup(ks) < 0].tolist()
+
     def partition(self, codes: np.ndarray, n: int) -> np.ndarray:
         # tolist() -> python ints: key_partition hashes repr(key), and
         # repr(np.int64(5)) != repr(5) on numpy >= 2; only the NEW tail
@@ -173,6 +203,11 @@ class ObjKeyCodec:
             arr[:] = self._by_code
             self._arr = arr
         return self._arr[codes].tolist()
+
+    def novel(self, keys, count: int) -> list:
+        """See :meth:`IntKeyCodec.novel`."""
+        code = self._code
+        return [k for k in keys if k not in code]
 
     def partition(self, codes: np.ndarray, n: int) -> np.ndarray:
         return self._partitions.lookup(

@@ -53,9 +53,7 @@ def _x64_enabled() -> bool:
     return bool(jax.config.jax_enable_x64)
 
 
-def _pow2_bucket(x: int) -> int:
-    """Smallest power of 2 >= x (x >= 1)."""
-    return 1 << (x - 1).bit_length()
+_pow2_bucket = keycodec.pow2_bucket
 
 
 class TpuCommCluster:
@@ -525,11 +523,10 @@ class TpuCommCluster:
                 k0 = next(iter(m))
                 vshape = np.shape(m[k0])
                 break
-        kind = ("int" if isinstance(k0, (int, np.integer))
-                and not isinstance(k0, bool) else "obj")
+        kind = keycodec.kind_of(k0)
         codec = self._codecs.get(kind)
         if codec is None:
-            codec = self._codecs[kind] = keycodec.codec_for_key(k0)
+            codec = self._codecs[kind] = keycodec.codec_for_kind(kind)
         # round the per-rank slot count up to a power of 2: real sparse
         # gradient streams drift in key count every step, and an exact
         # Lmax would join the jit key and recompile per step; padding is
