@@ -316,6 +316,29 @@ def bench_ffm_stream(chunks=6, rows=8192):
     return chunks * rows / (time.perf_counter() - t0)
 
 
+def bench_device_map(keys=50_000, reps=5):
+    """configs[2] on the DEVICE path: merged keys/sec for an int-keyed
+    map allreduce on the default backend (n=1 driver, union == map —
+    the host encode/decode + one device round-trip per call is the
+    measured quantity; the union merge itself rides the device at any
+    n). The full union-size A/B vs the socket loop is in BASELINE.md;
+    this extra pins the headline size every round."""
+    from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    cl = TpuCommCluster(1)
+    base = {i: float(i) for i in range(keys)}
+    cl.allreduce_map([dict(base)], Operands.FLOAT, Operators.SUM)  # warm
+    per_call = [[dict(base)] for _ in range(reps)]
+    t0 = time.perf_counter()
+    nk = 0
+    for ms in per_call:
+        cl.allreduce_map(ms, Operands.FLOAT, Operators.SUM)
+        nk += len(ms[0])
+    return nk / (time.perf_counter() - t0)
+
+
 def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
     """Map<String,Double> sparse-grad allreduce over loopback TCP
     (BASELINE.md configs[2], the reference's Kryo operand path —
@@ -367,6 +390,7 @@ def main():
     tpu_gbs, trees_per_sec, n_chips = bench_tpu(n=n_tpu)
     ffm_steps = bench_ffm_tpu()
     ffm_stream_rows = bench_ffm_stream()
+    dev_map_keys = bench_device_map()
     print(json.dumps({
         "metric": "gbdt-histogram-allreduce GB/s/chip",
         "value": round(tpu_gbs, 4),
@@ -388,6 +412,7 @@ def main():
                 "as printed is environment-specific"),
             "socket_map_allreduce_keys_per_sec": round(map_keys, 0),
             "socket_map_int_allreduce_keys_per_sec": round(map_int_keys, 0),
+            "device_map_int_allreduce_keys_per_sec": round(dev_map_keys, 0),
             "n_chips": n_chips,
             "config": f"Higgs-like synthetic, F=28, B=256, depth=6, "
                       f"N_tpu={n_tpu:.0e}, N_socket=2e5/4 procs; 10 "

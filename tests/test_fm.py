@@ -409,3 +409,25 @@ def test_sharded_fit_stream(rng):
         ((feats, fields, vals, y) for _ in range(4)))
     assert losses.shape == (4,) and np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_sharded_table_on_hier_mesh(rng):
+    """The sharded table composes with the hierarchical inter x intra
+    mesh: P((inter, intra)) block-shards the table row-major over all
+    members, flat_index ranks them the same way, and the all_to_all
+    routing rides the axis tuple — losses must match the flat mesh."""
+    from ytk_mp4j_tpu.parallel import make_hier_mesh
+
+    feats, fields, vals, y = make_sparse_classification(rng, n=96)
+    cfg = FMConfig(n_features=64, n_fields=4, k=4, max_nnz=4,
+                   model="ffm", learning_rate=0.3, init_scale=0.1)
+    flat = FMTrainer(cfg, mesh=make_mesh(8), sparse_grads=True,
+                     table_sharding="sharded")
+    p_f, l_f = flat.fit(feats, fields, vals, y, n_steps=3, seed=3)
+    hier = FMTrainer(cfg, mesh=make_hier_mesh(4, 2), sparse_grads=True,
+                     table_sharding="sharded")
+    p_h, l_h = hier.fit(feats, fields, vals, y, n_steps=3, seed=3)
+    np.testing.assert_allclose(l_h, l_f, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(hier.full_table(p_h),
+                               flat.full_table(p_f), rtol=1e-5,
+                               atol=1e-7)

@@ -131,6 +131,22 @@ def check(comm, length: int = 97) -> int:
             want_max[k] = max(want_max.get(k, -np.inf), v)
     comm.allreduce_map(d, Operands.DOUBLE, Operators.MAX)
     expect("allreduce_map_max", d == want_max)
+    # a HOST-ONLY custom operator (python truthiness — untraceable)
+    # must route numeric maps onto the pickled plane, not crash in jit
+    from ytk_mp4j_tpu.operators import Operator
+    absmax = Operator.custom(
+        "ABSMAX_HOST", lambda a, b: a if abs(a) > abs(b) else b, 0.0)
+    d = {k: (1.0 + v) * (-1.0 if r % 2 else 1.0)
+         for k, v in maps[r].items()}
+    plus = [{k: (1.0 + v) * (-1.0 if q % 2 else 1.0)
+             for k, v in maps[q].items()} for q in range(n)]
+    want_abs: dict = {}
+    for m in plus:
+        for k, v in m.items():
+            want_abs[k] = (v if k not in want_abs
+                           or abs(v) > abs(want_abs[k]) else want_abs[k])
+    comm.allreduce_map(d, Operands.DOUBLE, absmax)
+    expect("allreduce_map_custom_host", d == want_abs)
     return fails
 
 

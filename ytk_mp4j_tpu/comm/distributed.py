@@ -112,6 +112,8 @@ class DistributedComm(CommSlave):
         # key kind -> codec, kept IDENTICAL across processes (grown
         # only inside _union_device's synchronized novel-key exchange)
         self._codecs_by_kind: dict[str, object] = {}
+        # job-wide AND of jax_enable_x64 (see _job_x64)
+        self._x64_all: bool | None = None
 
     # -- identity / control plane --------------------------------------
     @property
@@ -467,11 +469,27 @@ class DistributedComm(CommSlave):
             acc[k] = operator.np_fn(acc[k], v) if k in acc else v
         return acc
 
-    def _map_device_ok(self, operand: Operand) -> bool:
+    def _job_x64(self) -> bool:
+        """jax_enable_x64 agreed JOB-WIDE (AND over ranks, pinned):
+        a per-host flag divergence would otherwise route ranks onto
+        different planes — mismatched programs, a hang. Pinned like
+        ``_agreed_native``: flip the config before first use."""
+        if self._x64_all is None:
+            flag = bool(jax.config.jax_enable_x64)
+            self._x64_all = (all(self._exchange_obj(flag))
+                             if self._n > 1 else flag)
+        return self._x64_all
+
+    def _map_device_ok(self, operand: Operand,
+                       operator: Operator) -> bool:
         if not operand.is_numeric:
             return False
-        if (operand.dtype.itemsize == 8
-                and not jax.config.jax_enable_x64):
+        if operator.name not in ("SUM", "MAX", "MIN", "PROD"):
+            # a custom operator's fn may be host-only python (legal on
+            # the per-scalar merge loop); only the builtins are known
+            # jit-safe, so customs keep the pickled plane
+            return False
+        if operand.dtype.itemsize == 8 and not self._job_x64():
             return False
         return True
 
@@ -480,10 +498,15 @@ class DistributedComm(CommSlave):
         """The job-wide reduced union via the device plane as
         ``(codec, codes, values)``, or None when every rank's map is
         empty. Codec synchronization: each call, every rank's NOVEL
-        keys (plus its entry count, value shape and key kind) ride one
-        pickled exchange; all ranks then grow their codec with the same
-        union in the same order, so codes agree job-wide without ever
-        exchanging full maps again."""
+        keys (plus its entry count, value shape, key kind and any LOCAL
+        validation error) ride one pickled exchange; all ranks then
+        grow their codec with the same union in the same order, so
+        codes agree job-wide without ever exchanging full maps again.
+
+        All local validation (key kinds, value cast/shape) happens
+        BEFORE the exchange and its outcome rides it: a bad map on one
+        rank must raise on EVERY rank, not error on one while its peers
+        block in the device collective."""
         from ytk_mp4j_tpu.comm import keycodec
         from ytk_mp4j_tpu.ops import sparse as sparse_ops
 
@@ -494,8 +517,28 @@ class DistributedComm(CommSlave):
         if kind and codec is None:
             codec = self._codecs_by_kind[kind] = (
                 keycodec.codec_for_kind(kind))
-        novel = codec.novel(d.keys(), len(d)) if d else []
-        infos = self._exchange_obj((kind, novel, len(d), vshape))
+        c = len(d)
+        err = None
+        novel: list = []
+        v = None
+        if c:
+            try:
+                novel = codec.novel(d.keys(), c)
+                v = np.asarray(list(d.values()), dtype=operand.dtype)
+                if v.shape != (c,) + vshape:
+                    raise Mp4jError(
+                        f"map values must share a shape; rank "
+                        f"{self._rank} has {v.shape[1:]} vs {vshape}")
+            except Mp4jError as e:
+                err = str(e)
+            except (TypeError, ValueError) as e:
+                err = (f"map values must share shape {vshape} and be "
+                       f"{operand.dtype}-castable: {e}")
+        infos = self._exchange_obj((kind, novel, c, vshape, err))
+        errs = [i[4] for i in infos if i[4]]
+        if errs:
+            raise Mp4jError(f"map collective invalid on some rank: "
+                            f"{errs[0]}")
         kinds = {i[0] for i in infos if i[0] is not None}
         if len(kinds) > 1:
             raise Mp4jError(
@@ -522,19 +565,8 @@ class DistributedComm(CommSlave):
         ident = operator.identity(operand.dtype)
         idx = np.full(Lmax, sparse_ops.SENTINEL, np.int32)
         val = np.full((Lmax,) + vshape, ident, dtype=operand.dtype)
-        c = len(d)
         if c:
             idx[:c] = codec.encode(d.keys(), c)
-            try:
-                v = np.asarray(list(d.values()), dtype=operand.dtype)
-            except (TypeError, ValueError) as e:
-                raise Mp4jError(
-                    f"map values must share shape {vshape} and be "
-                    f"{operand.dtype}-castable: {e}") from None
-            if v.shape != (c,) + vshape:
-                raise Mp4jError(
-                    f"map values must share a shape; this rank has "
-                    f"{v.shape[1:]} vs {vshape}")
             val[:c] = v
         cap = keycodec.pow2_bucket(min(codec.size, total))
         oi, ov = self._device_sparse_allreduce(idx, val, cap, operand,
@@ -585,7 +617,7 @@ class DistributedComm(CommSlave):
                       operator: Operator) -> dict | None:
         """The job-wide merged union dict via whichever plane applies;
         None when the device plane saw every rank empty."""
-        if self._map_device_ok(operand):
+        if self._map_device_ok(operand, operator):
             out = self._union_device(d, operand, operator)
             if out is None:
                 return None
@@ -695,7 +727,7 @@ class DistributedComm(CommSlave):
         self._assert_open()
         if self._n == 1:
             return d
-        if self._map_device_ok(operand):
+        if self._map_device_ok(operand, operator):
             out = self._union_device(d, operand, operator)
             if out is None:
                 return d
