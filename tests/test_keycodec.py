@@ -125,6 +125,22 @@ def test_device_map_vocab_persists_across_calls(rng):
         assert m == {i: 4.0 for i in range(40)}
 
 
+def test_reset_map_vocabularies(rng):
+    """Key churn on a long-lived cluster: reset drops the grow-only
+    vocabularies; the next call rebuilds from live keys and results
+    stay correct."""
+    cl = TpuCommCluster(4)
+    maps = [{f"epoch0:{i}": 1.0 for i in range(50)} for _ in range(4)]
+    cl.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+    assert cl._codecs["obj"].size == 50
+    cl.reset_map_vocabularies()
+    assert "obj" not in cl._codecs
+    maps = [{f"epoch1:{i}": 1.0 for i in range(30)} for _ in range(4)]
+    cl.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)
+    assert cl._codecs["obj"].size == 30        # only live keys
+    assert maps[0] == {f"epoch1:{i}": 4.0 for i in range(30)}
+
+
 def test_device_map_mixed_key_kinds_in_one_call_raise():
     cl = TpuCommCluster(4)
     maps = [{1: 1.0}, {"a": 1.0}, {}, {}]

@@ -131,6 +131,12 @@ def check(comm, length: int = 97) -> int:
             want_max[k] = max(want_max.get(k, -np.inf), v)
     comm.allreduce_map(d, Operands.DOUBLE, Operators.MAX)
     expect("allreduce_map_max", d == want_max)
+    # vocabulary reset is collective: every rank resets at the same
+    # point, then the next call resynchronizes from live keys
+    comm.reset_map_vocabularies()
+    d = dict(maps[r])
+    comm.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+    expect("allreduce_map_after_reset", d == want_merged)
     # a HOST-ONLY custom operator (python truthiness — untraceable)
     # must route numeric maps onto the pickled plane, not crash in jit
     from ytk_mp4j_tpu.operators import Operator

@@ -43,6 +43,14 @@ class CommSlave(abc.ABC):
     # -- centralized logging (reference: info()/error() forwarded to the
     # master's console, SURVEY.md section 3e). Default: local stderr with a
     # rank prefix; socket backends override to forward to the master.
+    def reset_map_vocabularies(self) -> None:
+        """Drop any persistent map key<->code vocabularies. No-op on
+        backends without codecs (socket/thread merge host dicts
+        directly) so periodic-reset code is portable across the slave
+        contract; the device backends override. COLLECTIVE in effect
+        where state exists: every rank must call it at the same program
+        point."""
+
     def info(self, msg: str) -> None:
         print(self._fmt("INFO", msg), file=sys.stderr, flush=True)
 

@@ -628,6 +628,15 @@ class DistributedComm(CommSlave):
             self._merge_maps(operator, merged, m)
         return merged
 
+    def reset_map_vocabularies(self) -> None:
+        """Drop the synchronized key<->code vocabularies (see
+        ``TpuCommCluster.reset_map_vocabularies`` for why). COLLECTIVE
+        in effect: every rank must call it at the same program point —
+        a one-sided reset would silently desynchronize codes (this rank
+        would re-insert keys its peers already hold under old codes)."""
+        self._assert_open()
+        self._codecs_by_kind.clear()
+
     def allreduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
                       operator: Operator = Operators.SUM) -> dict:
         self._assert_open()

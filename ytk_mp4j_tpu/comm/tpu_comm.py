@@ -720,6 +720,16 @@ class TpuCommCluster:
             m.update(shares[r])
         return maps
 
+    def reset_map_vocabularies(self) -> None:
+        """Drop the persistent key<->code vocabularies (and their cached
+        partitions). The codecs are grow-only; on a long-lived cluster
+        whose key space CHURNS (rather than stabilizes) they — and the
+        union capacity buckets keyed on them — grow without bound.
+        After a reset the next map collective rebuilds from the live
+        keys. Compiled programs are kept (they are keyed on shapes, not
+        vocabularies)."""
+        self._codecs.clear()
+
     # ------------------------------------------------------------------
     def barrier(self):
         """Synchronize: run a trivial device collective to completion."""
