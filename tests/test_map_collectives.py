@@ -180,6 +180,20 @@ def test_tpu_map_vector_values(cluster, rng):
         np.testing.assert_allclose(m["c"], [5.0, 5.0])
 
 
+def test_tpu_map_bfloat16_values(cluster):
+    """BFLOAT16 map values halve the collective payload and merge on
+    the device path (values come back as bf16 scalars; small-int sums
+    are exact in bf16)."""
+    maps = [{f"w{i}": float(i + r) for i in range(20)} for r in range(4)]
+    want = {f"w{i}": sum(float(i + r) for r in range(4))
+            for i in range(20)}
+    cluster.allreduce_map(maps, Operands.BFLOAT16, Operators.SUM)
+    for m in maps:
+        assert set(m) == set(want)
+        for k in want:
+            assert abs(float(m[k]) - want[k]) <= 0.5, (k, m[k])
+
+
 def test_tpu_empty_maps(cluster):
     maps = [{} for _ in range(4)]
     cluster.allreduce_map(maps, Operands.DOUBLE, Operators.SUM)

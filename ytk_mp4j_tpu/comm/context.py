@@ -40,9 +40,6 @@ class CommSlave(abc.ABC):
     @abc.abstractmethod
     def close(self, code: int = 0) -> None: ...
 
-    # -- centralized logging (reference: info()/error() forwarded to the
-    # master's console, SURVEY.md section 3e). Default: local stderr with a
-    # rank prefix; socket backends override to forward to the master.
     def reset_map_vocabularies(self) -> None:
         """Drop any persistent map key<->code vocabularies. No-op on
         backends without codecs (socket/thread merge host dicts
@@ -51,6 +48,9 @@ class CommSlave(abc.ABC):
         where state exists: every rank must call it at the same program
         point."""
 
+    # -- centralized logging (reference: info()/error() forwarded to the
+    # master's console, SURVEY.md section 3e). Default: local stderr with a
+    # rank prefix; socket backends override to forward to the master.
     def info(self, msg: str) -> None:
         print(self._fmt("INFO", msg), file=sys.stderr, flush=True)
 
