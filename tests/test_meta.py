@@ -43,3 +43,21 @@ def test_padded_block():
     assert meta.padded_block(10, 4) == 3
     assert meta.padded_block(8, 4) == 2
     assert meta.padded_block(1, 8) == 1
+
+
+def test_key_partition_canonicalizes_integral_keys():
+    """np.integer keys must place exactly like python ints: repr-based
+    hashing would split them on numpy >= 2 ('np.int64(5)' vs '5'), and
+    the map codecs decode to python ints — every path must agree.
+    bool stays un-canonicalized (it would collide with 0/1)."""
+    import numpy as np
+
+    for k in (0, 5, -3, 2**40):
+        for np_k in (np.int32(k) if abs(k) < 2**31 else np.int64(k),
+                     np.int64(k)):
+            for parts in (2, 3, 7):
+                assert (meta.key_partition(np_k, parts)
+                        == meta.key_partition(k, parts)), (k, parts)
+    assert meta.key_partition(True, 3) == meta.key_partition(True, 3)
+    # strings and tuples keep their repr-based placement
+    assert isinstance(meta.key_partition("w5", 4), int)

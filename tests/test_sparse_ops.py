@@ -242,3 +242,16 @@ def test_sparse_allreduce_wide_vector_values(rng):
     assert set(got) == {1, 3}
     np.testing.assert_allclose(got[3], v0 + v1, rtol=1e-6)
     np.testing.assert_allclose(got[1], v2, rtol=1e-6)
+
+
+def test_custom_operator_shadowing_builtin_name():
+    """A user operator NAMED like a builtin must run its own fn through
+    the generic segment reduction — not silently inherit segment_max
+    (round-4 review regression: the reducer table was keyed by name)."""
+    absmax = Operator.custom(
+        "MAX", lambda a, b: jnp.where(jnp.abs(a) >= jnp.abs(b), a, b),
+        0.0)
+    per_rank = [([3], [-5.0]), ([3], [3.0])]
+    oi, ov = run_sparse_allreduce(per_rank, capacity=2, operator=absmax)
+    got = {int(i): float(v) for i, v in zip(oi, ov) if i != sp.SENTINEL}
+    assert got == {3: -5.0}, got          # builtin MAX would say 3.0

@@ -305,11 +305,27 @@ def check_ffm_round4_global_mesh(comm) -> int:
     _, l_rep = rep.fit(feats, fields, vals, y, n_steps=3, seed=11)
     sh = FMTrainer(cfg, mesh=global_mesh(), sparse_grads=True,
                    table_sharding="sharded")
-    _, l_sh = sh.fit(feats, fields, vals, y, n_steps=3, seed=11)
+    p_sh, l_sh = sh.fit(feats, fields, vals, y, n_steps=3, seed=11)
     if not (all(np.isfinite(m) for m in l_sh)
             and np.allclose(l_sh, l_rep, rtol=1e-4, atol=1e-6)):
         comm.error(f"sharded-table global-mesh MISMATCH: {l_sh} "
                    f"vs {l_rep}")
+        fails += 1
+    # sharded SERVE over the multi-process mesh (a collective: every
+    # process calls predict together; the output fetch is a
+    # process_allgather) vs a local dense scorer on the gathered table
+    import jax
+
+    from ytk_mp4j_tpu.parallel import make_mesh
+
+    got = sh.predict(p_sh, feats, fields, vals)
+    local = FMTrainer(cfg, mesh=make_mesh(
+        1, devices=jax.local_devices()[:1]))
+    want = local.predict(
+        (sh._to_host(p_sh[0]), sh._to_host(p_sh[1]),
+         sh.full_table(p_sh)), feats, fields, vals)
+    if not np.allclose(got, want, rtol=1e-4, atol=1e-5):
+        comm.error("sharded predict global-mesh MISMATCH")
         fails += 1
 
     # reuse rep: same cfg/mesh/slots -> same compiled step; fit_stream

@@ -484,10 +484,12 @@ class DistributedComm(CommSlave):
                        operator: Operator) -> bool:
         if not operand.is_numeric:
             return False
-        if operator.name not in ("SUM", "MAX", "MIN", "PROD"):
+        if operator not in (Operators.SUM, Operators.MAX,
+                            Operators.MIN, Operators.PROD):
             # a custom operator's fn may be host-only python (legal on
-            # the per-scalar merge loop); only the builtins are known
-            # jit-safe, so customs keep the pickled plane
+            # the per-scalar merge loop); only the BUILTIN objects
+            # (equality, not name — a custom named "MAX" is not MAX)
+            # are known jit-safe, so customs keep the pickled plane
             return False
         if operand.dtype.itemsize == 8 and not self._job_x64():
             return False
@@ -524,16 +526,10 @@ class DistributedComm(CommSlave):
         if c:
             try:
                 novel = codec.novel(d.keys(), c)
-                v = np.asarray(list(d.values()), dtype=operand.dtype)
-                if v.shape != (c,) + vshape:
-                    raise Mp4jError(
-                        f"map values must share a shape; rank "
-                        f"{self._rank} has {v.shape[1:]} vs {vshape}")
+                v = keycodec.pack_values(d.values(), c, vshape,
+                                         operand.dtype)
             except Mp4jError as e:
                 err = str(e)
-            except (TypeError, ValueError) as e:
-                err = (f"map values must share shape {vshape} and be "
-                       f"{operand.dtype}-castable: {e}")
         infos = self._exchange_obj((kind, novel, c, vshape, err))
         errs = [i[4] for i in infos if i[4]]
         if errs:

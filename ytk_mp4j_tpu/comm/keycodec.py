@@ -58,6 +58,25 @@ def codec_for_key(key):
     return codec_for_kind(kind_of(key))
 
 
+def pack_values(values, count: int, vshape, dtype) -> np.ndarray:
+    """One vectorized map-values -> ``[count, *vshape]`` conversion,
+    shared by the driver and multi-host map planes so their
+    accept/reject behavior cannot drift: ragged mixes raise via
+    asarray, and the explicit shape check also catches scalar vs
+    shape-(1,) mixes that a fromiter would silently flatten."""
+    try:
+        v = np.asarray(list(values), dtype=dtype)
+    except (TypeError, ValueError) as e:
+        raise Mp4jError(
+            f"map values must share shape {vshape} and be "
+            f"{dtype}-castable: {e}") from None
+    if v.shape != (count,) + tuple(vshape):
+        raise Mp4jError(
+            f"map values must share a shape; got {v.shape[1:]} vs "
+            f"{vshape}")
+    return v
+
+
 def pow2_bucket(x: int) -> int:
     """Smallest power of 2 >= x (x >= 1) — the shared bucket rule that
     bounds map-collective recompiles at O(log max-keys) programs on

@@ -541,21 +541,8 @@ class TpuCommCluster:
             if c == 0:
                 continue
             idx[r, :c] = codec.encode(m.keys(), c)
-            # one vectorized conversion per rank; shape coherence falls
-            # out of asarray (ragged mixes raise) + the explicit shape
-            # check (which also catches scalar vs shape-(1,) mixes that
-            # fromiter would silently flatten)
-            try:
-                v = np.asarray(list(m.values()), dtype=operand.dtype)
-            except (TypeError, ValueError) as e:
-                raise Mp4jError(
-                    f"map values must share shape {vshape} and be "
-                    f"{operand.dtype}-castable: {e}") from None
-            if v.shape != (c,) + vshape:
-                raise Mp4jError(
-                    f"map values must share a shape; rank {r} has "
-                    f"{v.shape[1:]} vs {vshape}")
-            val[r, :c] = v
+            val[r, :c] = keycodec.pack_values(m.values(), c, vshape,
+                                              operand.dtype)
         # every key of this call is in the vocabulary, so the union's
         # unique-code count is bounded by both the vocabulary size and
         # the total entry count

@@ -71,9 +71,20 @@ def key_partition(key, parts: int) -> int:
     differential tests see identical key placement. Python's builtin
     ``hash`` is salted per-process (PYTHONHASHSEED), so a keyed-stable
     blake2b digest of the key's string form is used instead.
+
+    Integral keys are canonicalized through ``__index__`` first:
+    ``repr(np.int64(5))`` is ``"np.int64(5)"`` on numpy >= 2, which
+    would place the same logical key differently than python ``5`` (and
+    differently than the key codecs, which decode to python ints).
+    bool is deliberately NOT canonicalized — it would collide with 0/1.
     """
     import hashlib
 
+    if not isinstance(key, bool):
+        try:
+            key = key.__index__()
+        except (AttributeError, TypeError):
+            pass
     h = hashlib.blake2b(repr(key).encode("utf-8"), digest_size=8)
     return int.from_bytes(h.digest(), "little") % parts
 

@@ -712,8 +712,12 @@ class FMTrainer(DataParallelTrainer):
             if self._pred_fn is None:
                 self._pred_fn = self._build_sharded_predict()
             staged = [self._put_sharded(a, per) for a in (f, fl, v, m)]
-            out = np.asarray(self._pred_fn(params, *staged)).reshape(-1)
-            return out[:N]
+            # _to_host, not np.asarray: on multi-process (global)
+            # meshes the output spans non-addressable devices, so the
+            # fetch is a collective process_allgather — every process
+            # must call predict together there
+            out = self._to_host(self._pred_fn(params, *staged))
+            return out.reshape(-1)[:N]
         return np.asarray(predict(params, jnp.asarray(feats),
                                   jnp.asarray(fields), jnp.asarray(vals),
                                   jnp.asarray(mask), self.cfg))

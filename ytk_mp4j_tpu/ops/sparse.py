@@ -35,11 +35,15 @@ from ytk_mp4j_tpu.ops.collectives import _axis_size, flat_index
 # sorts to the end) and is never a legal key code.
 SENTINEL = jnp.iinfo(jnp.int32).max
 
+# keyed by the BUILTIN Operator objects (frozen dataclass equality),
+# not by name: a user-defined Operator.custom("MAX", fn, ...) must take
+# the generic reduction with ITS OWN fn, not silently inherit the
+# builtin segment_max
 _SEGMENT_REDUCERS = {
-    "SUM": jax.ops.segment_sum,
-    "PROD": jax.ops.segment_prod,
-    "MAX": jax.ops.segment_max,
-    "MIN": jax.ops.segment_min,
+    Operators.SUM: jax.ops.segment_sum,
+    Operators.PROD: jax.ops.segment_prod,
+    Operators.MAX: jax.ops.segment_max,
+    Operators.MIN: jax.ops.segment_min,
 }
 
 
@@ -109,7 +113,7 @@ def segment_reduce_sorted(idx, val, capacity: int,
     # padding slots (SENTINEL) must not open new live segments; they sort
     # to the end so they share one trailing segment region
     seg = jnp.cumsum(bounds) - 1
-    reducer = _SEGMENT_REDUCERS.get(operator.name)
+    reducer = _SEGMENT_REDUCERS.get(operator)
     if reducer is not None:
         out_val = reducer(val, seg, num_segments=capacity)
     else:
