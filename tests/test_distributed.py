@@ -123,9 +123,68 @@ def test_device_reduce_transient_verdict_not_pinned(monkeypatch):
     # probe finally lands a definitive rejection -> pinned False
     state.update(verdict=False, definitive=True)
     assert comm._device_reduce_ok(Operators.MIN) is False
-    assert comm._agreed_native == {"MIN": False}
+    assert comm._agreed_native == {"pmin": False}
     assert comm._device_reduce_ok(Operators.MIN) is False
     assert len(exchanges) == 3
+
+
+def test_device_reduce_rejects_shadowing_custom_operator():
+    """A custom operator NAMED "MAX"/"SUM" must never take the native
+    device-reduce path — even after the builtin pinned its verdict
+    (ADVICE round 4, medium: the gate and the pin were keyed by
+    operator.name, so the custom inherited lax.pmax)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from ytk_mp4j_tpu.operators import Operator, Operators
+
+    comm = DistributedComm.__new__(DistributedComm)
+    comm._rank, comm._n, comm._closed = 0, 2, False
+    comm._djits = {}
+    # builtin MAX already pinned native job-wide
+    comm._agreed_native = {"pmax": True}
+    comm._pmesh = Mesh(np.asarray(jax.devices()[:1]), ("proc",))
+
+    absmax = Operator.custom(
+        "MAX", lambda a, b: np.where(np.abs(a) >= np.abs(b), a, b), 0.0)
+    assert comm._device_reduce_ok(Operators.MAX) is True
+    assert comm._device_reduce_ok(absmax) is False
+    fake_sum = Operator.custom("SUM", lambda a, b: a, 0.0)
+    assert comm._device_reduce_ok(fake_sum) is False
+
+
+def test_reduce_scatter_shadowing_custom_sum_goes_host_path():
+    """reduce_scatter_array routed custom operators named "SUM" onto
+    psum_scatter (name equality); the gate is now object identity and
+    the custom's own fn must decide the result."""
+    import numpy as np
+
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operator, Operators
+
+    comm = DistributedComm.__new__(DistributedComm)
+    comm._rank, comm._n, comm._closed = 0, 2, False
+    comm._djits, comm._agreed_native = {}, {}
+
+    device_calls = []
+    comm._device_rows_collective = (
+        lambda kind, block, lax_name:
+        device_calls.append((kind, lax_name)) or block)
+    # two ranks: ours and a peer row of all 10s
+    comm._allgather_rows = lambda row: np.stack(
+        [row, np.full_like(row, 10.0)])
+
+    first = Operator.custom("SUM", lambda a, b: a, 0.0)  # keeps first
+    arr = np.arange(4, dtype=np.float32)
+    out = comm.reduce_scatter_array(arr.copy(), Operands.FLOAT, first)
+    assert device_calls == []           # builtin psum_scatter NOT taken
+    np.testing.assert_array_equal(out[:2], arr[:2])  # fn: keep ours
+
+    # and the real builtin still rides the device plane
+    comm.reduce_scatter_array(arr.copy(), Operands.FLOAT, Operators.SUM)
+    assert device_calls == [("reduce_scatter", "psum")]
 
 
 @pytest.mark.slow

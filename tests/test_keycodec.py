@@ -79,6 +79,27 @@ def test_int_codec_rejects_non_int_keys():
                          Operators.SUM)
 
 
+@pytest.mark.parametrize("codec_cls,mk", [
+    (IntKeyCodec, lambda i: i),
+    (ObjKeyCodec, lambda i: f"k{i}"),
+])
+def test_codec_overflow_checked_before_growth(codec_cls, mk,
+                                              monkeypatch):
+    """The int32/SENTINEL overflow must raise BEFORE the vocabulary
+    grows (ADVICE round 4, low): a post-insert check left an oversized
+    vocab whose sentinel-colliding codes the all-known fast path then
+    returned without error."""
+    from ytk_mp4j_tpu.comm import keycodec
+    monkeypatch.setattr(keycodec, "SENTINEL", 3)
+    c = codec_cls()
+    c.encode([mk(0), mk(1)], 2)
+    with pytest.raises(Mp4jError, match="overflow"):
+        c.encode([mk(2), mk(3)], 2)
+    assert c.size == 2                 # NOT mutated by the failed call
+    np.testing.assert_array_equal(     # fast path stays sentinel-free
+        c.encode([mk(0), mk(1)], 2), [0, 1])
+
+
 def test_int_codec_negative_and_large_keys():
     c = IntKeyCodec()
     keys = [-(2 ** 62), -1, 0, 5, 2 ** 62]

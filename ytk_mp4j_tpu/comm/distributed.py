@@ -218,7 +218,11 @@ class DistributedComm(CommSlave):
     # 2L(n-1)/n. PROD and custom operators keep the allgather path —
     # XLA has no pprod/custom all-reduce primitive, and a log/exp
     # rewrite would change float semantics.
-    _DEVICE_REDUCERS = {"SUM": "psum", "MAX": "pmax", "MIN": "pmin"}
+    # Gated on the builtin Operator OBJECTS (identity, not name): a
+    # custom operator named "MAX" must keep the host-reduce path — its
+    # fn is the semantics, pmax is not (same shadowing class as
+    # sparse._SEGMENT_REDUCERS / _map_device_ok). The lax primitive
+    # comes from operator.lax_collective, never from a name table.
 
     def _device_reduce_ok(self, operator: Operator) -> bool:
         """SUM always lowers natively; MAX/MIN only where the probe (or
@@ -247,11 +251,12 @@ class DistributedComm(CommSlave):
         consulted mid-job is exactly the desync hazard this exchange
         exists to prevent. Set overrides before first use, or construct
         a fresh comm."""
-        if operator.name not in self._DEVICE_REDUCERS:
-            return False
+        if not any(operator is b for b in
+                   (Operators.SUM, Operators.MAX, Operators.MIN)):
+            return False  # identity, not name: custom "MAX" is not MAX
         if operator.lax_collective == "psum":
             return True  # SUM: no probed collective, natively safe
-        agreed = self._agreed_native.get(operator.name)
+        agreed = self._agreed_native.get(operator.lax_collective)
         if agreed is not None:  # pinned: skip the local probe entirely
             return agreed       # (its TTL re-probes would be dead work)
         from ytk_mp4j_tpu.ops import collectives as coll
@@ -266,7 +271,7 @@ class DistributedComm(CommSlave):
             verdict = all(v for v, _ in pairs)
             definitive = all(d for _, d in pairs)
         if definitive:
-            self._agreed_native[operator.name] = verdict
+            self._agreed_native[operator.lax_collective] = verdict
         return verdict
 
     def _proc_mesh(self) -> Mesh:
@@ -281,21 +286,23 @@ class DistributedComm(CommSlave):
         return self._pmesh
 
     def _device_rows_collective(self, kind: str, block: np.ndarray,
-                                op_name: str) -> np.ndarray:
+                                lax_name: str) -> np.ndarray:
         """Run ONE device collective over per-process [L] blocks.
         kind="allreduce" returns the reduced [L]; kind="reduce_scatter"
-        expects [n*B] (n equal blocks) and returns this rank's [B]."""
+        expects [n*B] (n equal blocks) and returns this rank's [B].
+        ``lax_name`` is the lax primitive (psum/pmax/pmin), taken from
+        the builtin operator's ``lax_collective`` by the callers."""
         from functools import partial
         from jax import lax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._proc_mesh()
         sharding = NamedSharding(mesh, P("proc"))
-        key = (kind, op_name, block.dtype.str, block.size)
+        key = (kind, lax_name, block.dtype.str, block.size)
         fn = self._djits.get(key)
         if fn is None:
             if kind == "allreduce":
-                red = getattr(lax, self._DEVICE_REDUCERS[op_name])
+                red = getattr(lax, lax_name)
 
                 def body(x):
                     return red(x[0], "proc")[None]
@@ -325,7 +332,7 @@ class DistributedComm(CommSlave):
         if self._device_reduce_ok(operator):
             arr[lo:hi] = self._device_rows_collective(
                 "allreduce", np.ascontiguousarray(arr[lo:hi]),
-                operator.name)
+                operator.lax_collective)
             return arr
         rows = self._allgather_rows(np.ascontiguousarray(arr[lo:hi]))
         arr[lo:hi] = self._reduce_rows(rows, operator)
@@ -342,7 +349,7 @@ class DistributedComm(CommSlave):
         if self._device_reduce_ok(operator):
             merged = self._device_rows_collective(
                 "allreduce", np.ascontiguousarray(arr[lo:hi]),
-                operator.name)
+                operator.lax_collective)
             if self._rank == root:
                 arr[lo:hi] = merged
             return arr
@@ -426,7 +433,7 @@ class DistributedComm(CommSlave):
         if self._n == 1:
             return arr
         s, e = ranges[self._rank]
-        if operator.name == "SUM":
+        if operator is Operators.SUM:  # identity: custom "SUM" is host
             # device psum_scatter over the (possibly uneven) ranges:
             # pack each range into an identity-padded equal block so
             # shard r's scattered segment IS range r
@@ -436,7 +443,7 @@ class DistributedComm(CommSlave):
             for r, (rs, re) in enumerate(ranges):
                 blocks[r * B: r * B + (re - rs)] = arr[rs:re]
             mine = self._device_rows_collective("reduce_scatter", blocks,
-                                                operator.name)
+                                                operator.lax_collective)
             arr[s:e] = mine[: e - s]
             return arr
         if self._device_reduce_ok(operator):
@@ -444,7 +451,7 @@ class DistributedComm(CommSlave):
             lo, hi = ranges[0][0], ranges[-1][1]
             merged = self._device_rows_collective(
                 "allreduce", np.ascontiguousarray(arr[lo:hi]),
-                operator.name)
+                operator.lax_collective)
             arr[s:e] = merged[s - lo: e - lo]
             return arr
         lo, hi = ranges[0][0], ranges[-1][1]

@@ -206,12 +206,19 @@ class ObjKeyCodec:
         except TypeError as e:
             raise Mp4jError(f"map keys must be hashable: {e}") from None
         start = len(self._by_code)
-        for k in keys:
-            if k not in code:
-                code[k] = len(self._by_code)
-                self._by_code.append(k)
-        if len(self._by_code) >= int(SENTINEL):
+        # count the prospective insertions and raise BEFORE growing
+        # (mirrors IntKeyCodec): a post-insert check would leave an
+        # oversized vocabulary behind whose sentinel-colliding codes a
+        # later all-known encode (the fast path above) happily returns
+        try:
+            novel = dict.fromkeys(k for k in keys if k not in code)
+        except TypeError as e:
+            raise Mp4jError(f"map keys must be hashable: {e}") from None
+        if start + len(novel) >= int(SENTINEL):
             raise Mp4jError("key vocabulary overflows int32 codes")
+        for k in novel:
+            code[k] = len(self._by_code)
+            self._by_code.append(k)
         if len(self._by_code) > start:
             self._arr = None   # decode table stale
         return np.fromiter(map(code.__getitem__, keys), np.int32, count)
