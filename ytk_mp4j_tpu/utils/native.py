@@ -27,7 +27,8 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
 _SRC = os.path.join(_CSRC, "mp4j_native.cpp")
-_SRCS = [_SRC, os.path.join(_CSRC, "mp4j_transport.cpp")]
+_SRCS = [_SRC, os.path.join(_CSRC, "mp4j_transport.cpp"),
+         os.path.join(_CSRC, "mp4j_parse.cpp")]
 _BUILD_DIR = os.path.join(_CSRC, "build")
 _SO = os.path.join(_BUILD_DIR, "libmp4j_native.so")
 
@@ -85,6 +86,14 @@ def _load():
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64,
+        ]
+        lib.mp4j_parse_libsvm.restype = ctypes.c_int64
+        lib.mp4j_parse_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
         ]
         _lib = lib
         HAVE_NATIVE = True
@@ -168,6 +177,37 @@ def sendrecv_raw(send_fd: int, recv_fd: int, sarr: np.ndarray | None,
     if rc != 0:
         raise Mp4jError(_RAW_ERRORS.get(rc, f"raw exchange failed ({rc})"))
     return True
+
+
+def parse_libsvm_chunk(blob: bytes, n_rows: int, max_nnz: int):
+    """Native one-pass chunk parse (csrc/mp4j_parse.cpp): a chunk of
+    newline-joined libsvm/libffm lines -> padded
+    ``(feats, fields, vals, y)`` arrays, exactly the shape
+    ``utils.libsvm.read_libsvm`` yields.
+
+    Returns None when the native library is unavailable OR the strict
+    parser refused the chunk (exotic-but-valid literals, or genuinely
+    malformed lines) — the caller replays through the Python parser,
+    which either accepts slowly or raises the exact diagnostic.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    feats = np.zeros((n_rows, max_nnz), np.int32)
+    fields = np.zeros((n_rows, max_nnz), np.int32)
+    vals = np.zeros((n_rows, max_nnz), np.float32)
+    y = np.zeros(n_rows, np.float32)
+    out_rows = ctypes.c_int64(0)
+    rc = lib.mp4j_parse_libsvm(
+        blob, len(blob), max_nnz, n_rows,
+        feats.ctypes.data_as(ctypes.c_void_p),
+        fields.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p),
+        y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.byref(out_rows))
+    if rc != 0 or out_rows.value != n_rows:
+        return None
+    return feats, fields, vals, y
 
 
 # NOTE: a native sorted-u64 key-union kernel (merge_unique_u64) plus a
