@@ -2,7 +2,14 @@
 a libffm-format text file streamed chunk-by-chunk through
 ``FMTrainer.fit_stream`` (one jitted step per chunk, never more than
 one chunk in host memory), checked against the in-memory fit on the
-same data."""
+same data.
+
+The pipeline is fully composed: text parses through the native C++
+chunk scanner (csrc/mp4j_parse.cpp), chunk k+1 stages while the device
+runs step k (fit_stream double-buffers; ``max_in_flight=0`` would
+serialize), and at pod scale the same loop runs with
+``table_sharding="sharded"`` so the vocabulary shards over the mesh
+(examples stay replicated — 1-chip measurement keeps it faster)."""
 import os
 import tempfile
 

@@ -289,6 +289,50 @@ def test_read_libsvm_errors():
         run(["1 1:1"], chunk_rows=0, max_nnz=4)
 
 
+def test_read_libsvm_native_differential(rng):
+    """The native chunk scanner (csrc/mp4j_parse.cpp) must parse
+    byte-identically to the per-line Python contract on random
+    well-formed chunks, and refused shapes must replay losslessly."""
+    from ytk_mp4j_tpu.utils.libsvm import read_libsvm, _parse_chunk_slow
+
+    for _ in range(10):
+        n = int(rng.integers(1, 40))
+        lines = []
+        for _i in range(n):
+            kk = int(rng.integers(0, 5))
+            if rng.random() < 0.5:
+                toks = " ".join(
+                    f"{rng.integers(0, 10**6)}:{rng.normal():.6g}"
+                    for _ in range(kk))
+            else:
+                toks = " ".join(
+                    f"{rng.integers(0, 50)}:{rng.integers(0, 10**6)}:"
+                    f"{rng.normal():.6g}" for _ in range(kk))
+            lines.append(f"{rng.normal():.4g} {toks}")
+        a = list(read_libsvm(iter(lines), chunk_rows=64, max_nnz=5))[0]
+        b = _parse_chunk_slow(lines, list(range(1, n + 1)), 5)
+        for x, z in zip(a, b):
+            np.testing.assert_array_equal(x, z)
+
+
+def test_read_libsvm_exotic_literals_and_overflow():
+    """Literals the strict native scanner refuses but Python accepts
+    (inf labels, underscore ints) must still parse via the replay path;
+    out-of-int32 ids must error, never silently wrap."""
+    from ytk_mp4j_tpu.utils.libsvm import read_libsvm
+
+    got = list(read_libsvm(iter(["inf 4:1_0"]), chunk_rows=4, max_nnz=2))
+    assert np.isinf(got[0][3][0]) and got[0][2][0, 0] == 10.0
+    with pytest.raises((OverflowError, Mp4jError)):
+        list(read_libsvm(iter(["1 5000000000:1.0"]), chunk_rows=4,
+                         max_nnz=2))
+    # first defect in FILE order is the one diagnosed, even when a
+    # later line has a "cheaper" error class
+    with pytest.raises(Mp4jError, match="line 1.*not a number"):
+        list(read_libsvm(iter(["bad 1:2", "1 1:1", "1 1:1 2:1 3:1"]),
+                         chunk_rows=4, max_nnz=2))
+
+
 def test_stream_from_libsvm_end_to_end(rng, tmp_path):
     """File -> read_libsvm -> fit_stream: the configs[4] consumer flow
     at toy scale, never holding more than one chunk."""
@@ -396,19 +440,42 @@ def test_sharded_requires_sparse():
     with pytest.raises(Mp4jError, match="table_sharding"):
         FMTrainer(cfg, mesh=make_mesh(2), sparse_grads=True,
                   table_sharding="bogus")
+    # a tuned replicated-path capacity must not be silently dropped by
+    # the sharded step (ADVICE round 4, low) — nor by the dense step,
+    # which has no capacity at all
+    with pytest.raises(Mp4jError, match="sparse_capacity"):
+        FMTrainer(cfg, mesh=make_mesh(2), sparse_grads=True,
+                  sparse_capacity=128, table_sharding="sharded")
+    with pytest.raises(Mp4jError, match="sparse_capacity"):
+        FMTrainer(cfg, mesh=make_mesh(2), sparse_capacity=128)
 
 
 def test_sharded_fit_stream(rng):
-    """The streaming path composes with the sharded table."""
+    """The streaming path composes with the sharded table — the full
+    configs[4] shape (streamed chunks AND a mesh-sharded vocabulary):
+    losses must MATCH the replicated stream exactly, pipelined or
+    serialized."""
     feats, fields, vals, y = make_sparse_classification(rng, n=128)
     cfg = FMConfig(n_features=64, n_fields=4, k=4, max_nnz=4,
                    model="ffm", learning_rate=0.5, init_scale=0.1)
-    tr = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=True,
+    chunks = lambda: (  # noqa: E731 - two uneven chunks per epoch x 2
+        (feats[s], fields[s], vals[s], y[s])
+        for _ in range(2) for s in (slice(0, 80), slice(80, None)))
+    rep = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=True)
+    p_r, l_r = rep.fit_stream(chunks(), seed=5, batch_rows=80)
+    sh = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=True,
                    table_sharding="sharded")
-    params, losses = tr.fit_stream(
-        ((feats, fields, vals, y) for _ in range(4)))
-    assert losses.shape == (4,) and np.isfinite(losses).all()
-    assert losses[-1] < losses[0]
+    p_s, l_s = sh.fit_stream(chunks(), seed=5, batch_rows=80)
+    assert l_s.shape == (4,) and np.isfinite(l_s).all()
+    np.testing.assert_allclose(l_s, l_r, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(sh.full_table(p_s), np.asarray(p_r[2]),
+                               rtol=1e-5, atol=1e-6)
+    # serialized pipeline (max_in_flight=0) is numerically identical
+    sh0 = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=True,
+                    table_sharding="sharded")
+    _, l_s0 = sh0.fit_stream(chunks(), seed=5, batch_rows=80,
+                             max_in_flight=0)
+    np.testing.assert_allclose(l_s0, l_s, rtol=1e-6, atol=1e-8)
 
 
 def test_sharded_table_on_hier_mesh(rng):

@@ -382,6 +382,16 @@ class FMTrainer(DataParallelTrainer):
             raise Mp4jError(
                 "table_sharding='sharded' rides the sparse-gradient "
                 "path; pass sparse_grads=True")
+        if sparse_capacity is not None and (
+                table_sharding == "sharded" or not sparse_grads):
+            # only the replicated sparse step consumes it; anywhere
+            # else a tuned capacity would be silently dropped
+            raise Mp4jError(
+                "sparse_capacity applies to the replicated sparse path "
+                "only (sparse_grads=True, table_sharding='replicated'); "
+                "the sharded step sizes its buffers as "
+                "C = min(n_shards * batch_slots, table_rows) and the "
+                "dense step has no capacity at all")
         self.table_sharding = table_sharding
         self._step = None
         self._step_key = None
@@ -578,7 +588,8 @@ class FMTrainer(DataParallelTrainer):
         return params, np.asarray(jax.device_get(losses))
 
     def fit_stream(self, batches, params=None, seed: int = 0,
-                   batch_rows: int | None = None):
+                   batch_rows: int | None = None,
+                   max_in_flight: int = 2):
         """Chunked (out-of-core) training for data that cannot be staged
         in memory — the Criteo-1TB shape of configs[4], where
         ytk-learn consumes streamed libsvm-format text. ``batches`` is
@@ -593,45 +604,85 @@ class FMTrainer(DataParallelTrainer):
         distinct size. A chunk larger than ``batch_rows`` raises.
         Feeding the full dataset as a single chunk E times is
         numerically identical to ``fit(n_steps=E)`` (tested in
-        tests/test_fm.py). Returns (params, per-chunk losses)."""
+        tests/test_fm.py). Returns (params, per-chunk losses).
+
+        The pipeline is DOUBLE-BUFFERED: step k is dispatched
+        asynchronously and chunk k+1 is parsed/padded/staged while the
+        device runs it; losses are fetched once at the end. At most
+        ``max_in_flight`` steps stay in flight (the dispatch loop
+        blocks on the (k - max_in_flight)-th loss), bounding device
+        memory at ~max_in_flight staged batches. ``max_in_flight=0``
+        reproduces the fully serialized round-4 behavior (the A/B
+        baseline in bench.py; overlap measured 1.4-1.9x on the
+        streaming bench, BASELINE.md round 5)."""
         if params is None:
             params = self.init_params(seed)
         params = self._place_params(params)
         if batch_rows is not None:
             # the padded batch splits evenly over the mesh
             batch_rows = -(-batch_rows // self.n_shards) * self.n_shards
-        losses = []
-        for feats, fields, vals, y in batches:
-            y = np.asarray(y, np.float32)
-            feats, fields, vals, mask = self._stage_instances(
-                feats, fields, vals)
-            N = feats.shape[0]
-            if batch_rows is None:
-                batch_rows = -(-N // self.n_shards) * self.n_shards
-            if N > batch_rows:
-                raise Mp4jError(
-                    f"chunk of {N} rows exceeds batch_rows="
-                    f"{batch_rows}; raise batch_rows or shrink the "
-                    "reader's chunk size")
-            pad = batch_rows - N
-            sw = np.ones(N, np.float32)
-            if pad:
-                rows = ((0, pad),)
-                feats, fields, vals, mask = (
-                    np.pad(a, rows + ((0, 0),))
-                    for a in (feats, fields, vals, mask))
-                y, sw = np.pad(y, rows), np.pad(sw, rows)
-            per = batch_rows // self.n_shards
-            sharded = tuple(self._put_sharded(a, per)
-                            for a in (feats, fields, vals, mask, y, sw))
-            per_shard_slots = per * self.cfg.max_nnz
-            if self._step is None or self._step_key != per_shard_slots:
-                self._step = self._build_step(per_shard_slots)
-                self._step_key = per_shard_slots
-            params, loss = self._step(params, *sharded)
-            # bound in-flight programs, like fit()
-            losses.append(jax.block_until_ready(loss))
-        return params, np.asarray(jax.device_get(losses))
+        pending: list = []
+        staged = None
+        for chunk in batches:
+            if staged is not None:  # overlap: device runs step k-1
+                params = self._dispatch_stream_step(
+                    params, staged, pending, max_in_flight)
+            staged, batch_rows = self._stage_stream_chunk(
+                chunk, batch_rows)
+        if staged is not None:
+            params = self._dispatch_stream_step(
+                params, staged, pending, max_in_flight)
+        # plain device_get, by measurement: jnp.stack + one fetch
+        # recompiles per distinct chunk count (slower on the tunnel),
+        # and prefixing copy_to_host_async calls also measured slower
+        # (BASELINE.md round 5) — the runtime already overlaps these
+        # fetches with the steps still draining
+        return params, np.asarray(jax.device_get(pending))
+
+    def _stage_stream_chunk(self, chunk, batch_rows: int | None):
+        """Host half of one stream step: validate, pad to ``batch_rows``
+        (resolving it from the first chunk), and start the async
+        device placement. Returns ((sharded..., per_shard_slots),
+        batch_rows)."""
+        feats, fields, vals, y = chunk
+        y = np.asarray(y, np.float32)
+        feats, fields, vals, mask = self._stage_instances(
+            feats, fields, vals)
+        N = feats.shape[0]
+        if batch_rows is None:
+            batch_rows = -(-N // self.n_shards) * self.n_shards
+        if N > batch_rows:
+            raise Mp4jError(
+                f"chunk of {N} rows exceeds batch_rows="
+                f"{batch_rows}; raise batch_rows or shrink the "
+                "reader's chunk size")
+        pad = batch_rows - N
+        sw = np.ones(N, np.float32)
+        if pad:
+            rows = ((0, pad),)
+            feats, fields, vals, mask = (
+                np.pad(a, rows + ((0, 0),))
+                for a in (feats, fields, vals, mask))
+            y, sw = np.pad(y, rows), np.pad(sw, rows)
+        per = batch_rows // self.n_shards
+        sharded = tuple(self._put_sharded(a, per)
+                        for a in (feats, fields, vals, mask, y, sw))
+        return (sharded, per * self.cfg.max_nnz), batch_rows
+
+    def _dispatch_stream_step(self, params, staged, pending: list,
+                              max_in_flight: int):
+        """Device half: (re)build the step if the padded shape changed,
+        dispatch it asynchronously, and throttle the pipeline to
+        ``max_in_flight`` outstanding steps."""
+        sharded, per_shard_slots = staged
+        if self._step is None or self._step_key != per_shard_slots:
+            self._step = self._build_step(per_shard_slots)
+            self._step_key = per_shard_slots
+        params, loss = self._step(params, *sharded)
+        pending.append(loss)
+        if len(pending) > max_in_flight:
+            jax.block_until_ready(pending[-1 - max_in_flight])
+        return params
 
     def _stage_instances(self, feats, fields, vals):
         """The one staging path for padded-sparse instances: validate id
