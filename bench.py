@@ -288,11 +288,13 @@ def bench_ffm_tpu(n=8192, n_features=100_000, n_fields=8, k=8,
     return 1.0 / dt
 
 
-def bench_ffm_stream(chunks=6, rows=8192):
+def bench_ffm_stream(chunks=6, rows=8192, max_in_flight=2):
     """configs[4] ingestion: rows/sec through ``fit_stream`` — chunk
     staging + padding + one sparse FFM step per chunk (the out-of-core
     path a Criteo-scale run must ride; chunk synthesis stands in for
-    the file reader)."""
+    the file reader). ``max_in_flight=0`` serializes host staging with
+    device compute (the round-4 behavior) — the A/B denominator for
+    the double-buffering win."""
     from ytk_mp4j_tpu.models.fm import FMConfig, FMTrainer
 
     rng = np.random.default_rng(3)
@@ -312,7 +314,58 @@ def bench_ffm_stream(chunks=6, rows=8192):
     params, _ = tr.fit_stream(gen(1), batch_rows=rows)  # compile once
     t0 = time.perf_counter()
     params, _ = tr.fit_stream(gen(chunks), params=params,
-                              batch_rows=rows)
+                              batch_rows=rows,
+                              max_in_flight=max_in_flight)
+    return chunks * rows / (time.perf_counter() - t0)
+
+
+def _make_ffm_lines(rows, n_features=100_000, n_fields=8, max_nnz=8,
+                    seed=3):
+    rng = np.random.default_rng(seed)
+    feats = rng.integers(0, n_features, (rows, max_nnz))
+    vals = rng.random((rows, max_nnz))
+    y = (rng.random(rows) > 0.5).astype(np.int32)
+    return [
+        f"{y[i]} " + " ".join(
+            f"{j % n_fields}:{feats[i, j]}:{vals[i, j]:.4f}"
+            for j in range(max_nnz))
+        for i in range(rows)
+    ]
+
+
+def bench_libsvm_reader(rows=100_000, chunk_rows=8192):
+    """Reader alone: rows/sec through ``read_libsvm`` (the native
+    csrc/mp4j_parse.cpp scanner) on Criteo-shaped libffm text held in
+    memory — no training, no device."""
+    from ytk_mp4j_tpu.utils.libsvm import read_libsvm
+
+    lines = _make_ffm_lines(rows)
+    t0 = time.perf_counter()
+    got = sum(c[3].size
+              for c in read_libsvm(iter(lines), chunk_rows=chunk_rows,
+                                   max_nnz=8))
+    assert got == rows
+    return rows / (time.perf_counter() - t0)
+
+
+def bench_ffm_stream_text(chunks=6, rows=8192, max_in_flight=2):
+    """configs[4] END-TO-END: libffm TEXT -> native chunk parse ->
+    pad/stage -> double-buffered sparse FFM steps; rows/sec with the
+    reader INCLUDED (the figure round 4's bench excluded)."""
+    from ytk_mp4j_tpu.models.fm import FMConfig, FMTrainer
+    from ytk_mp4j_tpu.utils.libsvm import read_libsvm
+
+    cfg = FMConfig(model="ffm", n_features=100_000, n_fields=8, k=8,
+                   max_nnz=8, learning_rate=0.05)
+    tr = FMTrainer(cfg, sparse_grads=True)
+    lines = _make_ffm_lines(chunks * rows)
+    params, _ = tr.fit_stream(            # compile once
+        read_libsvm(iter(lines[:rows]), chunk_rows=rows, max_nnz=8),
+        batch_rows=rows)
+    t0 = time.perf_counter()
+    params, _ = tr.fit_stream(
+        read_libsvm(iter(lines), chunk_rows=rows, max_nnz=8),
+        params=params, batch_rows=rows, max_in_flight=max_in_flight)
     return chunks * rows / (time.perf_counter() - t0)
 
 
@@ -337,6 +390,28 @@ def bench_device_map(keys=50_000, reps=5):
         cl.allreduce_map(ms, Operands.FLOAT, Operators.SUM)
         nk += len(ms[0])
     return nk / (time.perf_counter() - t0)
+
+
+def bench_device_map_chained(keys=50_000, chain=8):
+    """configs[2] STEADY-STATE: ``chain`` map allreduces dispatched per
+    host resolution (``allreduce_map_async`` + deferred ``result()``),
+    so the per-call tunnel round-trip amortizes across the chain — the
+    rate a real pod (no tunnel) sees per call. The sync variant
+    (``bench_device_map``) pays the full round-trip every call."""
+    from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    cl = TpuCommCluster(1)
+    base = {i: float(i) for i in range(keys)}
+    cl.allreduce_map([dict(base)], Operands.FLOAT, Operators.SUM)  # warm
+    batches = [[dict(base)] for _ in range(chain)]
+    t0 = time.perf_counter()
+    handles = [cl.allreduce_map_async(ms, Operands.FLOAT, Operators.SUM)
+               for ms in batches]
+    for h in handles:
+        h.result()
+    return chain * keys / (time.perf_counter() - t0)
 
 
 def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
@@ -390,7 +465,11 @@ def main():
     tpu_gbs, trees_per_sec, n_chips = bench_tpu(n=n_tpu)
     ffm_steps = bench_ffm_tpu()
     ffm_stream_rows = bench_ffm_stream()
+    ffm_stream_rows_serial = bench_ffm_stream(max_in_flight=0)
+    reader_rows = bench_libsvm_reader()
+    ffm_text_rows = bench_ffm_stream_text()
     dev_map_keys = bench_device_map()
+    dev_map_keys_chained = bench_device_map_chained()
     print(json.dumps({
         "metric": "gbdt-histogram-allreduce GB/s/chip",
         "value": round(tpu_gbs, 4),
@@ -403,6 +482,10 @@ def main():
             "socket_native_collective_gbs": round(sock_native_coll_gbs, 4),
             "ffm_sparse_steps_per_sec": round(ffm_steps, 3),
             "ffm_stream_rows_per_sec": round(ffm_stream_rows, 0),
+            "ffm_stream_rows_per_sec_serialized": round(
+                ffm_stream_rows_serial, 0),
+            "libsvm_reader_rows_per_sec": round(reader_rows, 0),
+            "ffm_stream_text_rows_per_sec": round(ffm_text_rows, 0),
             "vs_baseline_derate_caveat": (
                 "this host has ONE core, so the 4 socket-baseline "
                 "slaves time-share it; on a realistic 4-core host the "
@@ -413,6 +496,8 @@ def main():
             "socket_map_allreduce_keys_per_sec": round(map_keys, 0),
             "socket_map_int_allreduce_keys_per_sec": round(map_int_keys, 0),
             "device_map_int_allreduce_keys_per_sec": round(dev_map_keys, 0),
+            "device_map_chained_keys_per_sec": round(
+                dev_map_keys_chained, 0),
             "n_chips": n_chips,
             "config": f"Higgs-like synthetic, F=28, B=256, depth=6, "
                       f"N_tpu={n_tpu:.0e}, N_socket=2e5/4 procs; 10 "

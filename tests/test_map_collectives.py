@@ -60,6 +60,35 @@ def test_tpu_allreduce_map(cluster, op, rng):
         assert_map_close(m, want)
 
 
+def test_tpu_allreduce_map_async_matches_sync(cluster, rng):
+    """allreduce_map_async + result() must leave the maps in exactly
+    the synchronous post-state; chained dispatches stay independent and
+    result() is idempotent."""
+    maps_a = make_maps(4, rng)
+    maps_b = make_maps(4, rng, n_keys=35)
+    want_a = expected_map_reduce(maps_a, "SUM")
+    want_b = expected_map_reduce(maps_b, "SUM")
+    # chain two dispatches before resolving either
+    ha = cluster.allreduce_map_async(maps_a, Operands.DOUBLE,
+                                     Operators.SUM)
+    hb = cluster.allreduce_map_async(maps_b, Operands.DOUBLE,
+                                     Operators.SUM)
+    got_b = hb.result()
+    got_a = ha.result()
+    assert got_a is maps_a and got_b is maps_b   # in-place semantics
+    for m in maps_a:
+        assert_map_close(m, want_a)
+    for m in maps_b:
+        assert_map_close(m, want_b)
+    ha.result()                                  # idempotent
+    for m in maps_a:
+        assert_map_close(m, want_a)
+    # all-empty maps resolve to all-empty
+    empty = [{} for _ in range(4)]
+    assert cluster.allreduce_map_async(empty).result() is empty
+    assert all(m == {} for m in empty)
+
+
 def test_tpu_reduce_map(cluster, rng):
     maps = make_maps(4, rng)
     origs = [dict(m) for m in maps]

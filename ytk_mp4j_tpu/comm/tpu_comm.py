@@ -56,6 +56,40 @@ def _x64_enabled() -> bool:
 _pow2_bucket = keycodec.pow2_bucket
 
 
+class PendingMap:
+    """Deferred result of :meth:`TpuCommCluster.allreduce_map_async`.
+
+    The device collective and the device->host copy are already in
+    flight when this handle exists; :meth:`result` performs the single
+    blocking fetch, decodes, and mutates the call's maps in place
+    (identical post-state to the synchronous ``allreduce_map``).
+    Chaining k dispatches before resolving any handle overlaps the k
+    host encodes with device work and d2h transfers — the synchronous
+    API instead pays one full dispatch+fetch round-trip per call, which
+    on a remote-tunnel topology (~100 ms RTT) is the dominant cost
+    (BASELINE.md round-5 chained A/B)."""
+
+    def __init__(self, codec, codes, ov, maps):
+        self._codec = codec
+        self._codes = codes
+        self._ov = ov
+        self._maps = maps
+        self._done = False
+
+    def result(self):
+        """Block, decode, and mutate the maps in place; idempotent."""
+        if not self._done:
+            if self._codec is not None:
+                merged = TpuCommCluster._decode_union(
+                    self._codec, self._codes, self._ov)
+                for m in self._maps:
+                    m.clear()
+                    m.update(merged)
+                self._ov = None   # release the device buffer
+            self._done = True
+        return self._maps
+
+
 class TpuCommCluster:
     """SPMD collectives over ``n`` devices of a mesh.
 
@@ -612,6 +646,30 @@ class TpuCommCluster:
             m.clear()
             m.update(merged)
         return maps
+
+    def allreduce_map_async(self, maps,
+                            operand: Operand = Operands.DOUBLE,
+                            operator: Operator = Operators.SUM
+                            ) -> PendingMap:
+        """Pipelined :meth:`allreduce_map`: dispatch the device
+        collective and start the device->host value copy, but defer the
+        blocking fetch/decode/mutation to the returned handle's
+        ``result()``. Per-call work overlaps across chained dispatches,
+        so a k-deep chain pays ~one round-trip, not k (the steady-state
+        rate a real pod sees; measured in bench.py /
+        BASELINE.md round 5). The input dicts must not be mutated
+        between dispatch and ``result()``."""
+        maps = self._norm_maps(maps, operand)
+        enc = self._encode_maps(maps, operand, operator)
+        if enc is None:
+            return PendingMap(None, None, None, maps)
+        codec, idx, val, _vshape, cap = enc
+        _oi, ov = self._device_sparse_allreduce(idx, val, cap, operator)
+        try:
+            ov.copy_to_host_async()
+        except (AttributeError, RuntimeError):  # pragma: no cover
+            pass    # prefetch is best-effort; result() fetches anyway
+        return PendingMap(codec, self._union_codes(idx), ov, maps)
 
     def reduce_map(self, maps, operand: Operand = Operands.DOUBLE,
                    operator: Operator = Operators.SUM, root: int = 0):
