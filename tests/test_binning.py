@@ -583,3 +583,135 @@ def test_fit_distributed_config_mismatch_raises(rng):
 
     with pytest.raises(Mp4jError, match="mismatch"):
         run_slaves(2, job)
+
+
+# ------------------------------------------------- weighted sketches
+def test_fit_weighted_matches_numpy_oracle(rng):
+    """Weighted fit == numpy's weighted quantiles (inverted_cdf is the
+    one method numpy defines weights for; same convention here)."""
+    N, F, B = 5_000, 3, 16
+    X = np.stack([rng.standard_normal(N),
+                  rng.lognormal(0.0, 1.0, N),
+                  rng.integers(0, 7, N).astype(np.float64)],
+                 axis=1).astype(np.float32)
+    w = rng.gamma(0.3, 2.0, N)       # heavily skewed weights
+    b = QuantileBinner(B).fit(X, sample=None, sample_weight=w)
+    qs = np.arange(1, B) / B
+    for f in range(F):
+        want = np.quantile(X[:, f].astype(np.float64), qs,
+                           method="inverted_cdf", weights=w)
+        np.testing.assert_allclose(b.edges[f], want, rtol=1e-6,
+                                   atol=1e-6)
+
+
+def test_fit_weighted_integer_weights_equal_duplication(rng):
+    """Integer weights must bin exactly like physically duplicated
+    rows (the defining property of weighted quantiles), including
+    heavy ties."""
+    N, B = 800, 8
+    X = rng.integers(0, 5, (N, 2)).astype(np.float32)   # many ties
+    k = rng.integers(1, 6, N)
+    b_w = QuantileBinner(B).fit(X, sample=None,
+                                sample_weight=k.astype(np.float64))
+    b_d = QuantileBinner(B).fit(np.repeat(X, k, axis=0), sample=None,
+                                sample_weight=np.ones(int(k.sum())))
+    np.testing.assert_array_equal(b_w.edges, b_d.edges)
+
+
+def test_weighted_sketch_single_rank_merge_matches_weighted_fit(rng):
+    """A one-shard weighted merge reproduces the weighted fit exactly
+    for distinct-valued data (the ordinates land on the grid, so the
+    inversion hits every quantile point)."""
+    N, B = 4_000, 16
+    X = rng.standard_normal((N, 2)).astype(np.float32)
+    w = rng.gamma(1.0, 1.0, N)
+    b = QuantileBinner(B)
+    sk = b.local_sketch(X, sample=None, sample_weight=w)
+    b.merge_sketches(sk.values[None], sk.counts[None],
+                     sk.finite[None], cdf_stack=sk.cdf[None])
+    want = QuantileBinner(B).fit(X, sample=None, sample_weight=w)
+    np.testing.assert_allclose(b.edges, want.edges, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_weighted_sketch_merge_skewed_shards(rng):
+    """Pooled weighted merge across shards with SKEWED weights: edges
+    must land within the documented 2/Q of the pooled weighted
+    quantile positions; a tied value holding ~90% of the total WEIGHT
+    (not rows) must capture every internal edge exactly."""
+    B, R = 16, 3
+    qs = np.arange(1, B) / B
+    # continuous case, weights concentrated on one shard
+    shards = [rng.standard_normal((3_000, 1)).astype(np.float32) + r
+              for r in range(R)]
+    weights = [np.full(3_000, 10.0 ** r) for r in range(R)]
+    b = QuantileBinner(B)
+    sk = [b.local_sketch(s, sample=None, sample_weight=w)
+          for s, w in zip(shards, weights)]
+    b.merge_sketches(np.stack([s.values for s in sk]),
+                     np.stack([s.counts for s in sk]),
+                     np.stack([s.finite for s in sk]),
+                     cdf_stack=np.stack([s.cdf for s in sk]))
+    pooled = np.concatenate(shards)[:, 0].astype(np.float64)
+    pw = np.concatenate(weights)
+    want = np.quantile(pooled, qs, method="inverted_cdf", weights=pw)
+    # position error in WEIGHTED quantile space
+    o = np.argsort(pooled)
+    cw = np.cumsum(pw[o]) / pw.sum()
+    for e, q in zip(b.edges[0], qs):
+        lo = np.searchsorted(pooled[o], e, side="left")
+        hi = np.searchsorted(pooled[o], e, side="right")
+        fl = cw[lo - 1] if lo > 0 else 0.0
+        fr = cw[hi - 1] if hi > 0 else 0.0
+        err = max(0.0, max(fl - q, q - fr))
+        assert err < 2.0 / B, (e, q, err, want)
+    # heavy-tie-by-weight case: one row value owns 99% of the total
+    # weight, so every internal quantile of a B=16 binner lands
+    # strictly inside its CDF jump
+    vals = rng.standard_normal((1_000, 1)).astype(np.float32)
+    vals[0, 0] = 0.5
+    w = np.ones(1_000)
+    w[0] = 99_000.0
+    halves = [(vals[:500], w[:500]), (vals[500:], w[500:])]
+    b2 = QuantileBinner(B)
+    sk2 = [b2.local_sketch(s, sample=None, sample_weight=ww)
+           for s, ww in halves]
+    b2.merge_sketches(np.stack([s.values for s in sk2]),
+                      np.stack([s.counts for s in sk2]),
+                      np.stack([s.finite for s in sk2]),
+                      cdf_stack=np.stack([s.cdf for s in sk2]))
+    # every internal quantile (1/B..15/16) falls inside the 90% jump
+    assert (b2.edges[0] == np.float32(0.5)).all(), b2.edges[0]
+
+
+def test_weighted_fit_distributed_matches_weighted_fit(rng):
+    """fit_distributed with per-rank weights pools to the weighted fit
+    (single-rank comm: exact; the multi-rank path shares the same
+    merge, covered by the skewed-shard test above)."""
+    X = rng.standard_normal((2_000, 2)).astype(np.float32)
+    w = rng.gamma(1.0, 1.0, 2_000)
+    b = QuantileBinner(8).fit_distributed(X, _OneRankComm(),
+                                          sample=None, sample_weight=w)
+    want = QuantileBinner(8).fit(X, sample=None, sample_weight=w)
+    np.testing.assert_allclose(b.edges, want.edges, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_weight_validation_errors(rng):
+    X = rng.standard_normal((10, 2)).astype(np.float32)
+    b = QuantileBinner(4)
+    with pytest.raises(Mp4jError, match="sample_weight"):
+        b.fit(X, sample_weight=np.ones(5))
+    with pytest.raises(Mp4jError, match="finite and non-negative"):
+        b.fit(X, sample_weight=-np.ones(10))
+    with pytest.raises(Mp4jError, match="finite and non-negative"):
+        b.fit(X, sample_weight=np.full(10, np.nan))
+    # zero-weight rows carry no evidence: a feature whose only finite
+    # values have weight 0 must raise like an all-NaN feature
+    X2 = np.stack([np.arange(10, dtype=np.float32),
+                   np.full(10, np.nan, np.float32)], axis=1)
+    X2[:3, 1] = 1.0
+    w = np.ones(10)
+    w[:3] = 0.0
+    with pytest.raises(Mp4jError, match="no\nfinite values|no finite"):
+        b.fit(X2, sample_weight=w)

@@ -60,6 +60,44 @@ class FeatureSketch(NamedTuple):
     cdf: np.ndarray
 
 
+def _check_weights(sample_weight, n_rows: int) -> np.ndarray:
+    """Validate instance weights for the weighted sketch paths:
+    [N] finite non-negative, not identically zero."""
+    sw = np.asarray(sample_weight, np.float64)
+    if sw.shape != (n_rows,):
+        raise Mp4jError(
+            f"sample_weight must be [N={n_rows}], got {sw.shape}")
+    if not np.isfinite(sw).all() or (sw < 0).any():
+        raise Mp4jError(
+            "sample_weight must be finite and non-negative")
+    if n_rows and not (sw > 0).any():
+        raise Mp4jError("sample_weight sums to zero: no weighted mass "
+                        "to fit quantiles from")
+    return sw
+
+
+def _sorted_weighted_col(col, w):
+    """One feature column -> (sorted values, cumulative weights) with
+    NaN and zero-weight rows dropped. Returns (None, None) when no
+    weighted data remains."""
+    m = ~np.isnan(col) & (w > 0)
+    v, wv = col[m], w[m]
+    if v.size == 0:
+        return None, None
+    o = np.argsort(v, kind="stable")
+    return v[o], np.cumsum(wv[o])
+
+
+def _wq_inverted_cdf(v_sorted, cw, qs):
+    """Weighted quantiles, inverted-CDF convention: the smallest value
+    whose weighted CDF reaches q — ``np.quantile(...,
+    method="inverted_cdf", weights=...)`` and the classic GBDT weighted
+    quantile sketch both define quantiles this way, and it is exact
+    under ties (integer weights == row duplication, property-tested)."""
+    pos = np.searchsorted(cw, np.asarray(qs) * cw[-1], side="left")
+    return v_sorted[np.minimum(pos, v_sorted.size - 1)]
+
+
 def _cdf_limits(xp, fp, x):
     """Left and right limits of the piecewise-linear CDF through
     ``(xp, fp)`` — duplicate ``xp`` entries form vertical jumps —
@@ -116,31 +154,56 @@ class QuantileBinner:
         # [F, B-1] f32 ([F, B-2] under missing_bucket)
         self.edges: np.ndarray | None = None
 
-    def fit(self, X, sample: int | None = 1_000_000, seed: int = 0):
+    def fit(self, X, sample: int | None = 1_000_000, seed: int = 0,
+            sample_weight=None):
         """Fit per-feature quantile edges from (a row sample of) X.
 
         Missing values (NaN) are ignored when computing quantiles; at
         transform time they land in bin 0 (the missing bucket — every
         ``x >= edge`` comparison is False). A feature with no finite
         values at all cannot be binned and raises.
-        """
+
+        ``sample_weight`` ([N] >= 0, optional — ytk-learn's instance
+        weights): edges become WEIGHTED quantiles (inverted-CDF
+        convention, matching ``np.quantile(method="inverted_cdf",
+        weights=...)``; integer weights bin exactly like row
+        duplication). ``None`` keeps the round-4 unweighted path
+        bit-for-bit (numpy's default linear interpolation)."""
         X = np.asarray(X, np.float32)
         if X.ndim != 2:
             raise Mp4jError(f"X must be [N, F], got {X.shape}")
+        sw = (None if sample_weight is None
+              else _check_weights(sample_weight, X.shape[0]))
         if sample is not None and X.shape[0] > sample:
             idx = np.random.default_rng(seed).choice(
                 X.shape[0], sample, replace=False)
             X = X[idx]
-        # a feature must have at least one finite value; inf sentinels
-        # are fine (they produce inf edges, which compare like any other
-        # value at transform time and land inf samples in the top bins)
-        bad = ~np.isfinite(X).any(axis=0)
+            if sw is not None:
+                sw = sw[idx]   # uniform row sample keeps weights unbiased
+        # a feature must have at least one finite value (of positive
+        # weight, when weighted); inf sentinels are fine (they produce
+        # inf edges, which compare like any other value at transform
+        # time and land inf samples in the top bins)
+        evid = (np.isfinite(X) if sw is None
+                else np.isfinite(X) & (sw[:, None] > 0))
+        bad = ~evid.any(axis=0)
         if bad.any():
             raise Mp4jError(
                 f"features {np.flatnonzero(bad).tolist()} have no "
-                "finite values to fit quantile edges from")
+                "finite values to fit quantile edges from"
+                + ("" if sw is None else " (zero-weight rows carry no "
+                   "evidence)"))
         nb = self.n_bins - 1 if self.missing_bucket else self.n_bins
         qs = np.arange(1, nb) / nb
+        if sw is not None:
+            edges = np.empty((X.shape[1], nb - 1), np.float32)
+            for f in range(X.shape[1]):
+                v, cw = _sorted_weighted_col(X[:, f], sw)
+                edges[f] = _wq_inverted_cdf(v, cw, qs)
+            # inverted_cdf picks actual data values — no inf-inf
+            # interpolation, so no NaN repair is needed
+            self.edges = edges
+            return self
         with warnings.catch_warnings():
             # inf sentinels make nanquantile warn on inf-inf interpolation
             warnings.simplefilter("ignore", RuntimeWarning)
@@ -152,7 +215,7 @@ class QuantileBinner:
         return self
 
     def local_sketch(self, X_shard, sample: int | None = 1_000_000,
-                     seed: int = 0) -> FeatureSketch:
+                     seed: int = 0, sample_weight=None) -> FeatureSketch:
         """Per-rank half of the distributed fit: a :class:`FeatureSketch`
         with this shard's quantile points ``[min, q_{1/Q}, ...,
         q_{(Q-1)/Q}, max]`` ([F, Q+1]), merge-weight counts [F] (f32 —
@@ -162,19 +225,38 @@ class QuantileBinner:
         [F, Q+1] — the grid for distinct data, true empirical jumps at
         tied points (see :class:`FeatureSketch`). A feature with no
         data on this shard yields NaN sketch rows and count 0 — legal
-        locally, resolved at merge (another rank may hold its data)."""
+        locally, resolved at merge (another rank may hold its data).
+
+        ``sample_weight`` ([N] >= 0, optional): quantile points become
+        weighted quantiles (see :meth:`fit`), merge counts become
+        per-feature WEIGHT totals (the [R, F] counts stack already IS
+        the merge's weight vector, so weighted shards pool correctly
+        with no wire-format change), and the CDF ordinates carry the
+        weighted empirical limits at every point — ties and skewed
+        weights ride the merge at their true mass."""
         X = np.asarray(X_shard, np.float32)
         if X.ndim != 2:
             raise Mp4jError(f"X must be [N, F], got {X.shape}")
-        # merge weight = the FULL shard's data count (NaN = missing is
-        # excluded; inf sentinels are data, exactly as in fit) — it must
-        # be taken before sampling, or a 10M-row shard sampled to 1M
-        # would weigh the same as a true 1M-row shard in the merge
-        counts = (~np.isnan(X)).sum(axis=0).astype(np.float32)
+        sw = (None if sample_weight is None
+              else _check_weights(sample_weight, X.shape[0]))
+        # merge weight = the FULL shard's data count / weight total
+        # (NaN = missing is excluded; inf sentinels are data, exactly
+        # as in fit) — it must be taken before sampling, or a 10M-row
+        # shard sampled to 1M would weigh the same as a true 1M-row
+        # shard in the merge
+        if sw is None:
+            counts = (~np.isnan(X)).sum(axis=0).astype(np.float32)
+        else:
+            counts = ((~np.isnan(X)) * sw[:, None]).sum(
+                axis=0).astype(np.float32)
         if sample is not None and X.shape[0] > sample:
             idx = np.random.default_rng(seed).choice(
                 X.shape[0], sample, replace=False)
             X = X[idx]
+            if sw is not None:
+                sw = sw[idx]
+        if sw is not None:
+            return self._weighted_sketch(X, sw, counts)
         # evidence comes from the rows actually sketched, mirroring
         # fit()'s sample-then-check order: if sampling dropped every
         # data row of a feature, the sketch row is all-NaN and must
@@ -227,6 +309,56 @@ class QuantileBinner:
             cdfs[f] = np.maximum.accumulate(np.clip(cdfs[f], 0.0, 1.0))
         # a shard whose feature is all-NaN contributes a NaN sketch row
         # with count 0 — merge_sketches skips it by the count
+        return FeatureSketch(sketch, counts, finite, cdfs)
+
+    def _weighted_sketch(self, X, sw, counts) -> FeatureSketch:
+        """Weighted :meth:`local_sketch` body: per-feature weighted
+        quantile points + weighted empirical CDF ordinates. For
+        distinct-valued data the ordinates land exactly on the grid
+        (each inverted-CDF point v_q satisfies F_left < q <= F_right),
+        so the merge's single-rank inversion reproduces the weighted
+        fit at every grid quantile; tied runs are widened to their true
+        weighted jump, like the unweighted path."""
+        F = X.shape[1]
+        nb = self.n_bins - 1 if self.missing_bucket else self.n_bins
+        E = nb + 1
+        qs = np.arange(1, nb) / nb
+        grid = np.arange(E) / nb
+        sketch = np.full((F, E), np.nan, np.float32)
+        cdfs = np.tile(grid.astype(np.float32), (F, 1))
+        finite = np.zeros(F, np.float32)
+        counts = counts.astype(np.float32).copy()
+        for f in range(F):
+            v, cw = _sorted_weighted_col(X[:, f], sw)
+            if v is None:
+                # sampling (or zero weights) left no data: the sketch
+                # row must carry no merge weight, like the unweighted
+                # sample-then-check order
+                counts[f] = 0.0
+                continue
+            finite[f] = float(np.isfinite(v).any())
+            inner = _wq_inverted_cdf(v, cw, qs)
+            row = np.concatenate([[v[0]], inner,
+                                  [v[-1]]]).astype(np.float32)
+            sketch[f] = row
+            W = cw[-1]
+            cw0 = np.concatenate([[0.0], cw])
+            left = cw0[np.searchsorted(v, row, side="left")] / W
+            right = cw0[np.searchsorted(v, row, side="right")] / W
+            out = np.empty(E)
+            j = 0
+            while j < E:
+                k = j
+                while k + 1 < E and row[k + 1] == row[j]:
+                    k += 1
+                if k > j:
+                    a = min(grid[j], left[j])
+                    b = max(grid[k], right[j])
+                    out[j:k + 1] = np.linspace(a, b, k - j + 1)
+                else:
+                    out[j] = np.clip(grid[j], left[j], right[j])
+                j = k + 1
+            cdfs[f] = np.maximum.accumulate(np.clip(out, 0.0, 1.0))
         return FeatureSketch(sketch, counts, finite, cdfs)
 
     def merge_sketches(self, sketch_stack, counts_stack,
@@ -328,7 +460,8 @@ class QuantileBinner:
         return self
 
     def fit_distributed(self, X_shard, comm,
-                        sample: int | None = 1_000_000, seed: int = 0):
+                        sample: int | None = 1_000_000, seed: int = 0,
+                        sample_weight=None):
         """SPMD distributed fit: every rank calls this with ITS OWN
         shard and an mp4j comm exposing ``rank`` / ``slave_num`` /
         ``allgather_array`` (socket, thread, and jax.distributed
@@ -340,11 +473,15 @@ class QuantileBinner:
         F) header, validated after the allgather: a binner-config or
         feature-count mismatch across ranks would otherwise garble the
         merge silently (or shear the flat buffer into misaligned
-        segments)."""
+        segments).
+
+        ``sample_weight`` weighs THIS RANK's rows (see
+        :meth:`local_sketch`); the merge pools weighted and unweighted
+        shards through the same counts vector."""
         from ytk_mp4j_tpu.operands import Operands
 
-        edges, counts, finite, cdfs = self.local_sketch(X_shard, sample,
-                                                        seed)
+        edges, counts, finite, cdfs = self.local_sketch(
+            X_shard, sample, seed, sample_weight=sample_weight)
         F, E = edges.shape
         n, r = comm.slave_num, comm.rank
         hdr = np.asarray(
