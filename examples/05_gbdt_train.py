@@ -13,24 +13,29 @@ X = rng.standard_normal((N, F)).astype(np.float32)
 y = ((X[:, 0] > 0).astype(np.float32)
      + 0.1 * rng.standard_normal(N).astype(np.float32))
 
-# continuous -> bin ids. Binning is fit DISTRIBUTED-style: each data
-# shard is sketched independently (per-feature quantile CDF + count)
-# and the sketches merge into one set of edges — on a real multi-host
-# job the same two calls run per rank with the sketches riding one
-# allgather (QuantileBinner.fit_distributed; check/checkdist.py).
+# The one-call consumer path (ytk-learn shape): RAW continuous
+# features in, the trainer quantile-bins internally (train_raw) and
+# keeps the fitted binner for serving. On a multi-process job, pass
+# ``comm=`` and the binner fits DISTRIBUTED (each rank sketches its
+# own shard, one allgather merges — check/checkdist.py runs that).
+cfg = GBDTConfig(n_features=F, n_bins=B, depth=4, n_trees=5,
+                 learning_rate=0.3)
+trainer = GBDTTrainer(cfg)  # all available devices, data-parallel
+trees, train_preds = trainer.train_raw(X, y)
+
+preds = trainer.predict_raw(X, trees)           # ensemble inference
+mse0 = float(np.mean(y ** 2))
+mse = float(np.mean((preds - y) ** 2))
+print(f"mse: {mse0:.4f} -> {mse:.4f} after {len(trees)} trees")
+assert mse < mse0
+
+# the manual wiring underneath: the sketch/merge pair is what
+# fit_distributed runs per rank on a multi-host job (edges are the
+# merge's 2/Q-approximation of train_raw's exact local fit)
 binner = QuantileBinner(B)
 sketches = [binner.local_sketch(s) for s in np.array_split(X, 4)]
 binner.merge_sketches(np.stack([s.values for s in sketches]),
                       np.stack([s.counts for s in sketches]))
 bins = binner.transform(X)
-
-cfg = GBDTConfig(n_features=F, n_bins=B, depth=4, n_trees=5,
-                 learning_rate=0.3)
-trainer = GBDTTrainer(cfg)  # all available devices, data-parallel
-trees, train_preds = trainer.train(bins, y)
-
-preds = trainer.predict(bins, trees)            # ensemble inference
-mse0 = float(np.mean(y ** 2))
-mse = float(np.mean((preds - y) ** 2))
-print(f"mse: {mse0:.4f} -> {mse:.4f} after {len(trees)} trees")
-assert mse < mse0
+manual_preds = GBDTTrainer(cfg).train(bins, y)[1]
+assert np.isfinite(manual_preds).all()

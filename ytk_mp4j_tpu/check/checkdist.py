@@ -336,6 +336,15 @@ def check_ffm_round4_global_mesh(comm) -> int:
         comm.error(f"fit_stream global-mesh MISMATCH: {l_stream} "
                    f"vs {l_rep}")
         fails += 1
+    # configs[4] COMPOSED at DCN scale: streamed chunks into the
+    # mesh-SHARDED table (reuses sh's compiled step; double-buffered
+    # dispatch path)
+    _, l_shs = sh.fit_stream(
+        ((feats, fields, vals, y) for _ in range(3)), seed=11)
+    if not np.allclose(l_shs, l_rep, rtol=1e-5, atol=1e-7):
+        comm.error(f"sharded fit_stream global-mesh MISMATCH: {l_shs} "
+                   f"vs {l_rep}")
+        fails += 1
     return fails
 
 
@@ -375,6 +384,39 @@ def check_binning_dist(comm) -> int:
         err = max(err, float(np.abs(pos - qs).max()))
     if err > 2.0 / B:
         comm.error(f"binning quantile error {err:.4f} > {2.0 / B:.4f}")
+        fails += 1
+
+    # distributed binning FROM INSIDE the trainer (round-5 consumer
+    # path): every rank calls train_raw(comm=...) together; the binner
+    # fits via fit_distributed on each rank's own rows and the edges +
+    # predictions must agree across ranks
+    from ytk_mp4j_tpu.models.gbdt import GBDTConfig, GBDTTrainer
+    from ytk_mp4j_tpu.parallel import make_mesh
+    import jax
+
+    Xr = shards[comm.rank]
+    yr = (Xr[:, 0] > 0).astype(np.float32)
+    cfg = GBDTConfig(n_features=F, n_bins=B, depth=2, n_trees=2,
+                     learning_rate=0.5)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(
+        1, devices=jax.local_devices()[:1]))
+    trees, _ = tr.train_raw(Xr, yr, seed=4, comm=comm)
+    # per-rank data -> per-rank trees; the BINNER must still be
+    # job-identical (the distributed sketch merge) and the merged
+    # edges must match the standalone fit_distributed above
+    seg = tr.binner_.edges.ravel().astype(np.float32)
+    buf2 = np.zeros(comm.slave_num * seg.size, np.float32)
+    buf2[comm.rank * seg.size:(comm.rank + 1) * seg.size] = seg
+    comm.allgather_array(buf2, Operands.FLOAT)
+    rows2 = buf2.reshape(comm.slave_num, seg.size)
+    if not all(np.array_equal(rows2[0], r) for r in rows2[1:]):
+        comm.error("train_raw distributed binning DIFFERS across ranks")
+        fails += 1
+    if not np.array_equal(tr.binner_.edges, binner.edges):
+        comm.error("train_raw binner != standalone fit_distributed")
+        fails += 1
+    if not np.isfinite(tr.predict_raw(X[:64], trees)).all():
+        comm.error("train_raw predict_raw produced non-finite values")
         fails += 1
     return fails
 

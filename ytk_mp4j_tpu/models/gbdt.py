@@ -668,6 +668,7 @@ class GBDTTrainer(DataParallelTrainer):
         self._margin_step = None
         self._stacked_trees = None
         self.eval_history_: list[float] = []
+        self.binner_ = None    # fitted by train_raw; rides save_model
 
     def _build_step(self):
         cfg = self.cfg
@@ -781,6 +782,69 @@ class GBDTTrainer(DataParallelTrainer):
         if self.cfg.loss == "softmax":
             return trees, preds.reshape(-1, self.cfg.n_classes)
         return trees, preds.reshape(-1)
+
+    def train_raw(self, X, y, n_trees: int | None = None, seed: int = 0,
+                  sample_weight: np.ndarray | None = None,
+                  eval_set=None, early_stopping_rounds: int | None = None,
+                  binner=None, comm=None,
+                  bin_sample: int | None = 1_000_000):
+        """The ytk-learn consumer entry point: RAW continuous features
+        [N, F] -> internal quantile binning -> boosted training, in one
+        call (the reference consumer bins internally; SURVEY.md
+        section 1 flagship consumer + section 3b).
+
+        A :class:`~ytk_mp4j_tpu.models.binning.QuantileBinner` with
+        ``n_bins=cfg.n_bins`` and ``missing_bucket=cfg.missing_bin`` is
+        fitted on X — via ``fit_distributed`` over ``comm`` when one is
+        given (an mp4j comm with ``slave_num > 1``: every rank calls
+        ``train_raw`` together, each sketches its OWN X and one
+        allgather merges, so raw features never leave their rank) —
+        then X is transformed and :meth:`train` runs. NaN feature
+        values flow to the missing bucket (pair with
+        ``cfg.missing_bin=True`` for learned default directions).
+
+        The fitted binner is kept as ``self.binner_`` and persisted by
+        :meth:`save_model`; ``eval_set=(X_va, y_va)`` takes RAW
+        features, transformed with the same binner. Pass a pre-fitted
+        ``binner`` to reuse edges (its edges are used as-is).
+        ``sample_weight`` both weights the quantile sketch (a heavily
+        weighted region earns finer bins, ytk-learn's weighted
+        training) and scales the boosting gradients. Returns
+        ``(trees, margins)`` like :meth:`train`; serve raw features
+        with :meth:`predict_raw`."""
+        from ytk_mp4j_tpu.models.binning import QuantileBinner
+
+        X = np.asarray(X, np.float32)
+        if binner is None:
+            binner = QuantileBinner(n_bins=self.cfg.n_bins,
+                                    missing_bucket=self.cfg.missing_bin)
+        if binner.edges is None:
+            if comm is not None and comm.slave_num > 1:
+                binner.fit_distributed(X, comm, sample=bin_sample,
+                                       seed=seed,
+                                       sample_weight=sample_weight)
+            else:
+                binner.fit(X, sample=bin_sample, seed=seed,
+                           sample_weight=sample_weight)
+        self.binner_ = binner
+        if eval_set is not None:
+            eval_set = (binner.transform(eval_set[0]), eval_set[1])
+        return self.train(
+            binner.transform(X), y, n_trees=n_trees, seed=seed,
+            sample_weight=sample_weight, eval_set=eval_set,
+            early_stopping_rounds=early_stopping_rounds)
+
+    def predict_raw(self, X, trees, proba: bool = False):
+        """Serve RAW continuous features through the binner fitted by
+        :meth:`train_raw` (or installed on ``self.binner_`` by
+        :meth:`load_model`'s caller)."""
+        if self.binner_ is None:
+            raise Mp4jError(
+                "no fitted binner on this trainer: train with "
+                "train_raw, or set trainer.binner_ (load_model returns "
+                "the persisted binner)")
+        return self.predict(self.binner_.transform(X), trees,
+                            proba=proba)
 
     def _check_bins_width(self, bins, what: str = "bins") -> None:
         """A bin matrix narrower/wider than cfg.n_features would make
@@ -937,11 +1001,13 @@ class GBDTTrainer(DataParallelTrainer):
                 np.zeros(self.cfg.n_features)).astype(np.float64)
 
     def save_model(self, path: str, trees, binner=None) -> None:
-        """Persist the ensemble (and optionally the fitted binner's
-        edges) as a portable .npz — the reference consumer's
-        train-then-serve flow."""
+        """Persist the ensemble (and the fitted binner's edges — the
+        one from :meth:`train_raw` by default) as a portable .npz —
+        the reference consumer's train-then-serve flow."""
         from ytk_mp4j_tpu.models._base import save_npz
 
+        if binner is None:
+            binner = self.binner_
         arrays = {"n_trees": np.int64(len(trees))}
         for i, round_trees in enumerate(trees):
             per_class = (round_trees if self.cfg.loss == "softmax"
