@@ -8,11 +8,25 @@ import bench
 
 
 def test_bench_tpu_smoke():
-    gbs, tps, n_chips = bench.bench_tpu(n=512, f=4, b=256, depth=2,
-                                        trees=1)
+    gbs, tps, n_chips, fps = bench.bench_tpu(n=512, f=4, b=256, depth=2,
+                                             trees=1)
     assert np.isfinite(gbs) and gbs > 0
     assert np.isfinite(tps) and tps > 0
     assert n_chips >= 1
+    assert fps is None or fps > 0          # MFU numerator (best-effort)
+
+
+def test_bench_device_paths_smoke():
+    steps, fps = bench.bench_ffm_tpu(n=64, n_features=128, n_fields=2,
+                                     k=2, max_nnz=2, steps=1)
+    assert np.isfinite(steps) and steps > 0
+    assert fps is None or fps > 0
+    rate = bench.bench_device_map_chained(keys=64, chain=2)
+    assert np.isfinite(rate) and rate > 0
+    rows = bench.bench_libsvm_reader(rows=256, chunk_rows=128)
+    assert np.isfinite(rows) and rows > 0
+    e2e = bench.bench_ffm_stream_text(chunks=2, rows=64)
+    assert np.isfinite(e2e) and e2e > 0
 
 
 def test_bench_socket_smoke():

@@ -870,3 +870,23 @@ def test_train_raw_distributed_binning(rng):
                      np.stack([sk.finite] * 2),
                      cdf_stack=np.stack([sk.cdf] * 2))
     np.testing.assert_allclose(e0, b.edges, rtol=1e-6, atol=1e-6)
+
+
+def test_train_raw_rejects_incompatible_binner(rng):
+    """A FINER pre-fitted binner would emit bin ids the histogram
+    one-hot silently drops; mismatched missing-bucket conventions
+    silently reroute NaN — both must be errors."""
+    from ytk_mp4j_tpu.exceptions import Mp4jError
+    from ytk_mp4j_tpu.models.binning import QuantileBinner
+
+    X, y = _raw_problem(rng, n=100)
+    cfg = GBDTConfig(n_features=6, n_bins=16, depth=2, n_trees=1)
+    tr = GBDTTrainer(cfg, mesh=make_mesh(1))
+    with pytest.raises(Mp4jError, match="exceeds"):
+        tr.train_raw(X, y, binner=QuantileBinner(64).fit(X))
+    with pytest.raises(Mp4jError, match="missing_bucket"):
+        tr.train_raw(X, y, binner=QuantileBinner(
+            16, missing_bucket=True).fit(X))
+    # coarser is legal (load_model's rule)
+    trees, _ = tr.train_raw(X, y, binner=QuantileBinner(8).fit(X))
+    assert np.isfinite(tr.predict_raw(X, trees)).all()

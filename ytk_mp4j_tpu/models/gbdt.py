@@ -818,6 +818,23 @@ class GBDTTrainer(DataParallelTrainer):
         if binner is None:
             binner = QuantileBinner(n_bins=self.cfg.n_bins,
                                     missing_bucket=self.cfg.missing_bin)
+        # a finer binner would emit bin ids >= cfg.n_bins, which the
+        # histogram one-hot silently drops from every gradient sum —
+        # the same silent-misrouting class _check_bins_width guards;
+        # coarser is legal (load_model's rule). missing-bucket
+        # conventions must agree or NaN routing silently changes.
+        if binner.n_bins > self.cfg.n_bins:
+            raise Mp4jError(
+                f"binner.n_bins={binner.n_bins} exceeds "
+                f"cfg.n_bins={self.cfg.n_bins}: out-of-range bin ids "
+                "would silently vanish from the histograms (a coarser "
+                "binner is fine)")
+        if bool(binner.missing_bucket) != bool(self.cfg.missing_bin):
+            raise Mp4jError(
+                f"binner.missing_bucket={binner.missing_bucket} but "
+                f"cfg.missing_bin={self.cfg.missing_bin}: the reserved "
+                "bin-0 conventions must match or NaN routing silently "
+                "changes")
         if binner.edges is None:
             if comm is not None and comm.slave_num > 1:
                 binner.fit_distributed(X, comm, sample=bin_sample,

@@ -433,7 +433,8 @@ def _tile(c: int, dtype, interpret: bool, what: str):
 
 def ring_allreduce_kernel(x, operator: Operator = Operators.SUM,
                           axis_name="mp4j", interpret: bool = False,
-                          bidirectional: bool = False):
+                          bidirectional: bool = False,
+                          force_kernel: bool = False):
     """Allreduce of a per-member [L] array via explicit ICI RDMA.
 
     Any element-wise associative+commutative ``operator`` (the merge
@@ -447,10 +448,16 @@ def ring_allreduce_kernel(x, operator: Operator = Operators.SUM,
     carries (n-1)/n of HALF the buffer — ~half the unidirectional
     wall clock (~2x throughput) on real hardware. Same results either
     way.
+
+    ``force_kernel=True`` runs the pallas_call even on a 1-member axis
+    (normally an identity fast path): zero ring steps, but the Mosaic
+    codegen, VMEM slot allocation, semaphore allocation and the
+    collective_id entry barrier all execute — the real-chip hardware
+    smoke ``check/checktpu.py`` records when only one chip exists.
     """
     n = lax.axis_size(axis_name)
     _check_1d(x, "ring allreduce kernel")
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
     L = x.shape[0]
     parts = 2 * n if bidirectional else n
@@ -486,20 +493,22 @@ def _bidir_rows2(rows: int, what: str) -> int:
 
 def ring_reduce_scatter_kernel(x, operator: Operator = Operators.SUM,
                                axis_name="mp4j", interpret: bool = False,
-                               bidirectional: bool = False):
+                               bidirectional: bool = False,
+                               force_kernel: bool = False):
     """Member r ends with chunk r ([L/n]) of the element-wise reduction
     (the ``coll.reduce_scatter`` layout). L must be divisible by the
     axis size, and compiled chunks by ``min_chunk_elems`` (pad outside
     — the chunk boundaries are the caller's contract).
     ``bidirectional`` rings each chunk's halves in opposite directions
-    (chunks must split into two tile-aligned halves)."""
+    (chunks must split into two tile-aligned halves). ``force_kernel``:
+    see :func:`ring_allreduce_kernel`."""
     n = lax.axis_size(axis_name)
     _check_1d(x, "ring reduce-scatter kernel")
     if x.shape[0] % n:
         raise Mp4jError(
             f"ring reduce-scatter kernel needs a length divisible by "
             f"{n}, got shape {x.shape}")
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
     c = x.shape[0] // n
     rows, lanes = _tile(c, x.dtype, interpret,
@@ -517,15 +526,17 @@ def ring_reduce_scatter_kernel(x, operator: Operator = Operators.SUM,
 
 
 def ring_allgather_kernel(x, axis_name="mp4j", interpret: bool = False,
-                          bidirectional: bool = False):
+                          bidirectional: bool = False,
+                          force_kernel: bool = False):
     """Every member ends with [n * c]: member q's [c] shard at block q
     (the ``ring.ring_allgather`` layout). Compiled shards must be
     multiples of ``min_chunk_elems``. ``bidirectional`` forwards each
     shard's halves in opposite directions (shards must split into two
-    tile-aligned halves)."""
+    tile-aligned halves). ``force_kernel``: see
+    :func:`ring_allreduce_kernel`."""
     n = lax.axis_size(axis_name)
     _check_1d(x, "ring allgather kernel")
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
     c = x.shape[0]
     rows, lanes = _tile(c, x.dtype, interpret, "ring allgather kernel")
