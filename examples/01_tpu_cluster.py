@@ -38,6 +38,19 @@ with trace_collectives():
     maps = [{f"w:{r % 3}": np.ones(4, np.float32) * r} for r in range(n)]
     cluster.allreduce_map(maps, Operands.FLOAT, Operators.SUM)
 
+    # pipelined map allreduce: chain dispatches, resolve later — the
+    # deferred handles overlap host encodes with device work, so k
+    # chained calls pay ~one round-trip instead of k (the steady-state
+    # configs[2] rate; chained A/B in BASELINE.md)
+    step1 = [{r: 1.0} for r in range(n)]
+    step2 = [{r + 1: 2.0} for r in range(n)]
+    h1 = cluster.allreduce_map_async(step1, Operands.FLOAT,
+                                     Operators.SUM)
+    h2 = cluster.allreduce_map_async(step2, Operands.FLOAT,
+                                     Operators.SUM)
+    h1.result(), h2.result()                 # mutates in place, like
+    assert len(step1[0]) == n                # the sync call
+
     # user-defined operator: on the DEVICE path the reduction runs
     # inside jit, so write it with jnp (jnp also works on host numpy
     # inputs; an np-only fn would fail to trace on multi-device meshes)

@@ -8,12 +8,16 @@ import bench
 
 
 def test_bench_tpu_smoke():
-    gbs, tps, n_chips, fps = bench.bench_tpu(n=512, f=4, b=256, depth=2,
-                                             trees=1)
+    gbs, tps, n_chips, fps, hist_fps = bench.bench_tpu(
+        n=512, f=4, b=256, depth=2, trees=1)
     assert np.isfinite(gbs) and gbs > 0
     assert np.isfinite(tps) and tps > 0
     assert n_chips >= 1
     assert fps is None or fps > 0          # MFU numerator (best-effort)
+    assert np.isfinite(hist_fps) and hist_fps > 0
+    # analytic count: level 0 full + sibling-subtracted level 1
+    assert bench.gbdt_hist_mxu_flops(512, 4, 256, 2) == (
+        2.0 * 512 * 4 * (1 + 1) * 256 * 4)
 
 
 def test_bench_device_paths_smoke():

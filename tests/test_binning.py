@@ -697,6 +697,37 @@ def test_weighted_fit_distributed_matches_weighted_fit(rng):
                                atol=1e-5)
 
 
+def test_weighted_fit_distributed_multirank_socket(rng):
+    """Weighted fit_distributed over REAL socket slaves: per-rank
+    weighted shards pool to job-identical edges within the pooled
+    weighted-quantile tolerance."""
+    from helpers import run_slaves
+
+    B, R = 8, 3
+    X = rng.standard_normal((3_000, 2)).astype(np.float32)
+    w = rng.gamma(0.7, 1.0, 3_000)
+    cuts = [0, 600, 1_800, 3_000]
+
+    def job(slave, rank):
+        s = slice(cuts[rank], cuts[rank + 1])
+        return QuantileBinner(B).fit_distributed(
+            X[s], slave, sample=None, sample_weight=w[s]).edges
+
+    results = run_slaves(R, job)
+    for e in results[1:]:
+        np.testing.assert_array_equal(e, results[0])
+    qs = np.arange(1, B) / B
+    pooled = X[:, 0].astype(np.float64)
+    o = np.argsort(pooled)
+    cw = np.cumsum(w[o]) / w.sum()
+    for e, q in zip(results[0][0], qs):
+        lo = np.searchsorted(pooled[o], e, side="left")
+        hi = np.searchsorted(pooled[o], e, side="right")
+        fl = cw[lo - 1] if lo > 0 else 0.0
+        fr = cw[hi - 1] if hi > 0 else 0.0
+        assert max(0.0, max(fl - q, q - fr)) < 2.0 / B
+
+
 def test_weight_validation_errors(rng):
     X = rng.standard_normal((10, 2)).astype(np.float32)
     b = QuantileBinner(4)

@@ -388,6 +388,13 @@ def check_ffm(results: dict, devices, n: int, per: int = 1024):
     _compile("ffm/sparse_train_step_sharded", results,
              trs._build_step(per * cfg.max_nnz),
              sharded_avals, *batch_avals)
+    # round-5: fit_stream's double-buffered dispatch compiles THIS SAME
+    # program (the stream stages chunks into identical padded shapes),
+    # so the sharded+stream composition is covered by the row above;
+    # the sharded SERVE program (owner-routed row fetch, no full-table
+    # replica anywhere) is the remaining sharded surface
+    _compile("ffm/sharded_serve", results, trs._build_sharded_predict(),
+             sharded_avals, *batch_avals[:4])
 
 
 def main(argv=None) -> int:

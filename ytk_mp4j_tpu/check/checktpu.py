@@ -262,11 +262,10 @@ def check_ring_kernels_hw(t: Tally, n: int, devices=None):
     want_sum = expected_reduce(alls, "SUM")
     shards = stacked[:, : L // n]        # per-member allgather input
 
-    def smap(body, out_spec=None):
+    def smap(body):
         return jax.jit(partial(
             jax.shard_map, mesh=mesh, check_vma=False,
-            in_specs=P(axis),
-            out_specs=P(axis) if out_spec is None else out_spec)(body))
+            in_specs=P(axis), out_specs=P(axis))(body))
 
     for bidir in (False, True):
         tag = "bidir" if bidir else "uni"
