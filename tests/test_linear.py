@@ -200,3 +200,71 @@ def test_column_vector_labels_rejected(rng):
                            n_devices=1)
         with pytest.raises(Mp4jError, match="1-D"):
             tr.fit(x, np.zeros((10, 1)), n_steps=1)
+
+
+# ------------------------------------------------------------ streaming
+def test_fit_stream_matches_fit(rng):
+    """One full-batch chunk per epoch == fit(n_steps=E) exactly
+    (momentum state threads across chunks); serialized pipeline
+    (max_in_flight=0) matches the double-buffered default."""
+    x, y, _ = make_regression(rng, n=96, d=5)
+    cfg = LinearConfig(n_features=5, learning_rate=0.1, momentum=0.9,
+                       l2=1e-3)
+    tr = LinearTrainer(cfg, mesh=make_mesh(4))
+    p_f, l_f = tr.fit(x, y, n_steps=4)
+    tr2 = LinearTrainer(cfg, mesh=make_mesh(4))
+    p_s, l_s = tr2.fit_stream(((x, y) for _ in range(4)))
+    np.testing.assert_allclose(l_s, l_f, rtol=1e-6, atol=1e-8)
+    for a, b in zip(p_s, p_f):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-8)
+    tr3 = LinearTrainer(cfg, mesh=make_mesh(4))
+    _, l_s0 = tr3.fit_stream(((x, y) for _ in range(4)),
+                             max_in_flight=0)
+    np.testing.assert_allclose(l_s0, l_s, rtol=1e-7, atol=1e-9)
+
+
+def test_fit_stream_uneven_chunks_and_softmax(rng):
+    """Short final chunks pad with zero-weight rows; the softmax
+    family streams too; oversized chunks raise."""
+    x = rng.standard_normal((100, 4)).astype(np.float32)
+    y = rng.integers(0, 3, 100)
+    cfg = LinearConfig(n_features=4, loss="softmax", n_classes=3,
+                       learning_rate=0.3)
+    tr = LinearTrainer(cfg, mesh=make_mesh(4))
+    chunks = [(x[:64], y[:64]), (x[64:], y[64:])] * 2
+    params, losses = tr.fit_stream(iter(chunks), batch_rows=64)
+    assert losses.shape == (4,) and np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    with pytest.raises(Mp4jError, match="exceeds batch_rows"):
+        tr.fit_stream(iter([(x, y)]), batch_rows=64)
+
+
+def test_linear_stream_from_libsvm_text(rng):
+    """libsvm text -> dense_chunks -> fit_stream: the ytk-learn linear
+    consumer flow end-to-end (duplicate ids accumulate; padded slots
+    are inert; out-of-range ids raise)."""
+    from ytk_mp4j_tpu.utils.libsvm import dense_chunks, read_libsvm
+
+    x, y = make_classification(rng, n=128, d=6)
+    lines = []
+    for i in range(128):
+        toks = " ".join(f"{j}:{x[i, j]:.5f}" for j in range(6))
+        lines.append(f"{y[i]:.0f} {toks}")
+    cfg = LinearConfig(n_features=6, loss="logistic", learning_rate=0.5)
+    tr = LinearTrainer(cfg, mesh=make_mesh(4))
+    params = None
+    for _ in range(8):
+        params, losses = tr.fit_stream(
+            dense_chunks(read_libsvm(iter(lines), chunk_rows=64,
+                                     max_nnz=6), 6),
+            params=params, batch_rows=64)
+    acc = float(np.mean((tr.predict(params, x) > 0.5) == (y > 0.5)))
+    assert acc > 0.9, acc
+    # duplicate feature ids accumulate; slot-0 padding adds nothing
+    got = list(dense_chunks(read_libsvm(
+        iter(["1 2:1.5 2:0.5 0:3.0"]), chunk_rows=4, max_nnz=4), 4))
+    np.testing.assert_allclose(got[0][0][0], [3.0, 0.0, 2.0, 0.0])
+    with pytest.raises(Mp4jError, match="out of range"):
+        list(dense_chunks(read_libsvm(iter(["1 9:1.0"]), chunk_rows=4,
+                                      max_nnz=4), 6))

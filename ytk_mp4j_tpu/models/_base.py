@@ -155,6 +155,61 @@ class DataParallelTrainer:
             sw = np.pad(sw, (0, pad))
         return arrays, per, sw
 
+    def _stream_fit(self, batches, stage_chunk, dispatch,
+                    batch_rows: int | None, max_in_flight: int):
+        """The shared double-buffered streaming loop (FM and linear
+        fit_stream): dispatch step k asynchronously, then parse/stage
+        chunk k+1 while the device runs it, with at most
+        ``max_in_flight`` steps outstanding (the throttle blocks on the
+        (k - max_in_flight)-th loss; 0 serializes). Losses are fetched
+        once at the end — a per-chunk fetch costs one full host
+        round-trip each on remote-tunnel topologies, and both
+        jnp.stack-then-fetch and copy_to_host_async prefixes measured
+        SLOWER than the plain device_get (BASELINE.md round 5).
+
+        ``stage_chunk(chunk, batch_rows) -> (staged, batch_rows)``
+        does the host half (validate/pad/placement; resolves
+        batch_rows from the first chunk); ``dispatch(staged) -> loss``
+        runs the device half, carrying trainer state in its closure.
+        Returns the per-chunk loss array."""
+        if batch_rows is not None:
+            # the padded batch splits evenly over the mesh
+            batch_rows = -(-batch_rows // self.n_shards) * self.n_shards
+        pending: list = []
+        staged = None
+        for chunk in batches:
+            if staged is not None:  # overlap: device runs step k-1
+                pending.append(dispatch(staged))
+                if len(pending) > max_in_flight:
+                    # bounds device memory AND queued programs (jax has
+                    # no "wait for queue depth" primitive)
+                    jax.block_until_ready(pending[-1 - max_in_flight])
+            staged, batch_rows = stage_chunk(chunk, batch_rows)
+        if staged is not None:
+            pending.append(dispatch(staged))
+        if not pending:
+            return np.zeros(0, np.float32)
+        return np.asarray(jax.device_get(pending))
+
+    def _pad_stream_rows(self, arrays, batch_rows: int):
+        """Pad dim 0 of each chunk array up to ``batch_rows`` (raising
+        when the chunk is larger) and build the zero-on-padding sample
+        weights; returns (padded arrays, sw, per-shard rows)."""
+        from ytk_mp4j_tpu.exceptions import Mp4jError
+
+        N = arrays[0].shape[0]
+        if N > batch_rows:
+            raise Mp4jError(
+                f"chunk of {N} rows exceeds batch_rows={batch_rows}; "
+                "raise batch_rows or shrink the reader's chunk size")
+        pad = batch_rows - N
+        sw = np.ones(N, np.float32)
+        if pad:
+            arrays = [np.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+                      for a in arrays]
+            sw = np.pad(sw, (0, pad))
+        return arrays, sw, batch_rows // self.n_shards
+
     def _put_sharded(self, a: np.ndarray, per: int):
         """Reshape [n*per, ...] -> [n, per, ...] and place on the mesh.
 

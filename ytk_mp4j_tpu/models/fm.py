@@ -606,38 +606,32 @@ class FMTrainer(DataParallelTrainer):
         numerically identical to ``fit(n_steps=E)`` (tested in
         tests/test_fm.py). Returns (params, per-chunk losses).
 
-        The pipeline is DOUBLE-BUFFERED: step k is dispatched
-        asynchronously and chunk k+1 is parsed/padded/staged while the
-        device runs it; losses are fetched once at the end. At most
-        ``max_in_flight`` steps stay in flight (the dispatch loop
-        blocks on the (k - max_in_flight)-th loss), bounding device
+        The pipeline is DOUBLE-BUFFERED via the shared
+        :meth:`DataParallelTrainer._stream_fit` loop: step k is
+        dispatched asynchronously and chunk k+1 is parsed/padded/staged
+        while the device runs it; losses are fetched once at the end.
+        At most ``max_in_flight`` steps stay in flight, bounding device
         memory at ~max_in_flight staged batches. ``max_in_flight=0``
         reproduces the fully serialized round-4 behavior (the A/B
-        baseline in bench.py; overlap measured 1.4-1.9x on the
-        streaming bench, BASELINE.md round 5)."""
+        baseline in bench.py; overlap measured 1.24-1.69x per trial on
+        the streaming bench, BASELINE.md round 5)."""
         if params is None:
             params = self.init_params(seed)
-        params = self._place_params(params)
-        if batch_rows is not None:
-            # the padded batch splits evenly over the mesh
-            batch_rows = -(-batch_rows // self.n_shards) * self.n_shards
-        pending: list = []
-        staged = None
-        for chunk in batches:
-            if staged is not None:  # overlap: device runs step k-1
-                params = self._dispatch_stream_step(
-                    params, staged, pending, max_in_flight)
-            staged, batch_rows = self._stage_stream_chunk(
-                chunk, batch_rows)
-        if staged is not None:
-            params = self._dispatch_stream_step(
-                params, staged, pending, max_in_flight)
-        # plain device_get, by measurement: jnp.stack + one fetch
-        # recompiles per distinct chunk count (slower on the tunnel),
-        # and prefixing copy_to_host_async calls also measured slower
-        # (BASELINE.md round 5) — the runtime already overlaps these
-        # fetches with the steps still draining
-        return params, np.asarray(jax.device_get(pending))
+        state = [self._place_params(params)]
+
+        def dispatch(staged):
+            sharded, per_shard_slots = staged
+            # (re)build on padded-shape change: a stale smaller
+            # capacity would silently drop gradient rows
+            if self._step is None or self._step_key != per_shard_slots:
+                self._step = self._build_step(per_shard_slots)
+                self._step_key = per_shard_slots
+            state[0], loss = self._step(state[0], *sharded)
+            return loss
+
+        losses = self._stream_fit(batches, self._stage_stream_chunk,
+                                  dispatch, batch_rows, max_in_flight)
+        return state[0], losses
 
     def _stage_stream_chunk(self, chunk, batch_rows: int | None):
         """Host half of one stream step: validate, pad to ``batch_rows``
@@ -648,41 +642,14 @@ class FMTrainer(DataParallelTrainer):
         y = np.asarray(y, np.float32)
         feats, fields, vals, mask = self._stage_instances(
             feats, fields, vals)
-        N = feats.shape[0]
         if batch_rows is None:
-            batch_rows = -(-N // self.n_shards) * self.n_shards
-        if N > batch_rows:
-            raise Mp4jError(
-                f"chunk of {N} rows exceeds batch_rows="
-                f"{batch_rows}; raise batch_rows or shrink the "
-                "reader's chunk size")
-        pad = batch_rows - N
-        sw = np.ones(N, np.float32)
-        if pad:
-            rows = ((0, pad),)
-            feats, fields, vals, mask = (
-                np.pad(a, rows + ((0, 0),))
-                for a in (feats, fields, vals, mask))
-            y, sw = np.pad(y, rows), np.pad(sw, rows)
-        per = batch_rows // self.n_shards
+            batch_rows = (-(-feats.shape[0] // self.n_shards)
+                          * self.n_shards)
+        (feats, fields, vals, mask, y), sw, per = self._pad_stream_rows(
+            [feats, fields, vals, mask, y], batch_rows)
         sharded = tuple(self._put_sharded(a, per)
                         for a in (feats, fields, vals, mask, y, sw))
         return (sharded, per * self.cfg.max_nnz), batch_rows
-
-    def _dispatch_stream_step(self, params, staged, pending: list,
-                              max_in_flight: int):
-        """Device half: (re)build the step if the padded shape changed,
-        dispatch it asynchronously, and throttle the pipeline to
-        ``max_in_flight`` outstanding steps."""
-        sharded, per_shard_slots = staged
-        if self._step is None or self._step_key != per_shard_slots:
-            self._step = self._build_step(per_shard_slots)
-            self._step_key = per_shard_slots
-        params, loss = self._step(params, *sharded)
-        pending.append(loss)
-        if len(pending) > max_in_flight:
-            jax.block_until_ready(pending[-1 - max_in_flight])
-        return params
 
     def _stage_instances(self, feats, fields, vals):
         """The one staging path for padded-sparse instances: validate id

@@ -225,6 +225,57 @@ class LinearTrainer(DataParallelTrainer):
                 break
         return params, np.asarray(jax.device_get(losses))
 
+    def fit_stream(self, batches, params=None,
+                   batch_rows: int | None = None,
+                   max_in_flight: int = 2):
+        """Chunked (out-of-core) training: one optimizer step per
+        ``(x, y)`` chunk — ytk-learn's linear family trains from the
+        same streamed libsvm text as FFM
+        (``utils.libsvm.read_libsvm`` + ``utils.libsvm.dense_chunks``
+        adapts it to the dense [N, F] this model consumes). Chunks pad
+        to ``batch_rows`` (default: first chunk, rounded up to the
+        shard count) with zero-weight rows so ONE jitted program
+        serves the stream; momentum state threads across chunks; the
+        pipeline double-buffers exactly like
+        :meth:`FMTrainer.fit_stream` (``max_in_flight=0``
+        serializes). Feeding the full dataset as a single chunk E
+        times is numerically identical to ``fit(n_steps=E)`` (tested).
+        Returns (params, per-chunk losses)."""
+        if self._step is None:
+            self._step = self._build_step()
+        if params is None:
+            params = self.init_params()
+        params = self._place_replicated(params)
+        state = [params, jax.tree_util.tree_map(jnp.zeros_like, params)]
+
+        def dispatch(staged):
+            # the throttle inside _stream_fit also bounds the queued
+            # multi-collective programs — see the sync note in fit()
+            state[0], state[1], loss = self._step(state[0], state[1],
+                                                  *staged)
+            return loss
+
+        losses = self._stream_fit(batches, self._stage_stream_chunk,
+                                  dispatch, batch_rows, max_in_flight)
+        return state[0], losses
+
+    def _stage_stream_chunk(self, chunk, batch_rows: int | None):
+        """Host half of one stream step: validate, pad to
+        ``batch_rows`` (resolving it from the first chunk), start the
+        async device placement."""
+        x, y = chunk
+        x = np.asarray(x, np.float32)
+        y = self._stage_labels(y)
+        if x.ndim != 2 or x.shape[1] != self.cfg.n_features:
+            raise Mp4jError(
+                f"x must be [N, {self.cfg.n_features}], got {x.shape}")
+        if batch_rows is None:
+            batch_rows = -(-x.shape[0] // self.n_shards) * self.n_shards
+        (x, y), sw, per = self._pad_stream_rows([x, y], batch_rows)
+        staged = (self._put_sharded(x, per), self._put_sharded(y, per),
+                  self._put_sharded(sw, per))
+        return staged, batch_rows
+
     def _stage_labels(self, y) -> np.ndarray:
         """Labels must be a flat [N] vector — a column-vector y would
         broadcast through the loss to an [N, N] matrix and train
