@@ -396,14 +396,21 @@ def check_binning_dist(comm) -> int:
 
     Xr = shards[comm.rank]
     yr = (Xr[:, 0] > 0).astype(np.float32)
+    # WEIGHTED rows: the weights flow into the distributed sketch
+    # (weighted CDF mass over the allgather) AND the boosting
+    # gradients; rank-dependent data with job-identical edges is the
+    # invariant under test
+    wr = 1.0 + (np.arange(Xr.shape[0]) % 3).astype(np.float64)
     cfg = GBDTConfig(n_features=F, n_bins=B, depth=2, n_trees=2,
                      learning_rate=0.5)
     tr = GBDTTrainer(cfg, mesh=make_mesh(
         1, devices=jax.local_devices()[:1]))
-    trees, _ = tr.train_raw(Xr, yr, seed=4, comm=comm)
+    trees, _ = tr.train_raw(Xr, yr, seed=4, comm=comm,
+                            sample_weight=wr)
     # per-rank data -> per-rank trees; the BINNER must still be
-    # job-identical (the distributed sketch merge) and the merged
-    # edges must match the standalone fit_distributed above
+    # job-identical (the distributed sketch merge) and must equal a
+    # standalone WEIGHTED fit_distributed with the same inputs (below
+    # — weighted edges differ from the unweighted binner at the top)
     seg = tr.binner_.edges.ravel().astype(np.float32)
     buf2 = np.zeros(comm.slave_num * seg.size, np.float32)
     buf2[comm.rank * seg.size:(comm.rank + 1) * seg.size] = seg
@@ -412,8 +419,11 @@ def check_binning_dist(comm) -> int:
     if not all(np.array_equal(rows2[0], r) for r in rows2[1:]):
         comm.error("train_raw distributed binning DIFFERS across ranks")
         fails += 1
-    if not np.array_equal(tr.binner_.edges, binner.edges):
-        comm.error("train_raw binner != standalone fit_distributed")
+    standalone = QuantileBinner(B).fit_distributed(
+        Xr, comm, sample=1_000_000, seed=4, sample_weight=wr)
+    if not np.array_equal(tr.binner_.edges, standalone.edges):
+        comm.error("train_raw binner != standalone weighted "
+                   "fit_distributed")
         fails += 1
     if not np.isfinite(tr.predict_raw(X[:64], trees)).all():
         comm.error("train_raw predict_raw produced non-finite values")
