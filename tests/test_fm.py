@@ -498,3 +498,30 @@ def test_sharded_table_on_hier_mesh(rng):
     np.testing.assert_allclose(hier.full_table(p_h),
                                flat.full_table(p_f), rtol=1e-5,
                                atol=1e-7)
+
+
+def test_fm_sample_weight_equals_duplication(rng):
+    """Integer instance weights == row duplication for the FM/FFM
+    steps too (dense and sparse paths share _weighted_mean_grads), in
+    fit and in weighted stream chunks."""
+    feats, fields, vals, y = make_sparse_classification(rng, n=40)
+    k = rng.integers(1, 4, 40)
+    dup = lambda a: np.repeat(a, k, axis=0)  # noqa: E731
+    cfg = FMConfig(n_features=64, n_fields=4, k=4, max_nnz=4,
+                   model="ffm", learning_rate=0.3, init_scale=0.1)
+    l_w_sparse = None
+    for sparse in (False, True):
+        tw = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=sparse)
+        _, l_w = tw.fit(feats, fields, vals, y, n_steps=3, seed=2,
+                        sample_weight=k.astype(np.float32))
+        td = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=sparse)
+        _, l_d = td.fit(dup(feats), dup(fields), dup(vals), dup(y),
+                        n_steps=3, seed=2)
+        np.testing.assert_allclose(l_w, l_d, rtol=1e-4, atol=1e-6)
+        if sparse:
+            l_w_sparse = l_w
+    ts = FMTrainer(cfg, mesh=make_mesh(4), sparse_grads=True)
+    _, l_s = ts.fit_stream(
+        ((feats, fields, vals, y, k.astype(np.float32))
+         for _ in range(3)), seed=2)
+    np.testing.assert_allclose(l_s, l_w_sparse, rtol=1e-5, atol=1e-7)

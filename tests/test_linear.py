@@ -268,3 +268,24 @@ def test_linear_stream_from_libsvm_text(rng):
     with pytest.raises(Mp4jError, match="out of range"):
         list(dense_chunks(read_libsvm(iter(["1 9:1.0"]), chunk_rows=4,
                                       max_nnz=4), 6))
+
+
+def test_sample_weight_equals_duplication(rng):
+    """Integer instance weights must train EXACTLY like physically
+    duplicated rows (the weighted-mean loss/grad identity), in both
+    fit and fit_stream."""
+    x, y, _ = make_regression(rng, n=48, d=4)
+    k = rng.integers(1, 4, 48)
+    xd, yd = np.repeat(x, k, axis=0), np.repeat(y, k)
+    cfg = LinearConfig(n_features=4, learning_rate=0.2, momentum=0.5)
+    _, l_w = LinearTrainer(cfg, mesh=make_mesh(4)).fit(
+        x, y, n_steps=3, sample_weight=k.astype(np.float32))
+    _, l_d = LinearTrainer(cfg, mesh=make_mesh(4)).fit(
+        xd, yd, n_steps=3)
+    np.testing.assert_allclose(l_w, l_d, rtol=1e-5, atol=1e-7)
+    _, l_s = LinearTrainer(cfg, mesh=make_mesh(4)).fit_stream(
+        ((x, y, k.astype(np.float32)) for _ in range(3)))
+    np.testing.assert_allclose(l_s, l_w, rtol=1e-6, atol=1e-8)
+    with pytest.raises(Mp4jError, match="sample_weight"):
+        LinearTrainer(cfg, mesh=make_mesh(2)).fit(
+            x, y, n_steps=1, sample_weight=np.ones(7))

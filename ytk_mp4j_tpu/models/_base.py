@@ -210,6 +210,21 @@ class DataParallelTrainer:
             sw = np.pad(sw, (0, pad))
         return arrays, sw, batch_rows // self.n_shards
 
+    @staticmethod
+    def _stage_weights(sample_weight, N: int):
+        """Validate optional [N] instance weights (ytk-learn's
+        per-example weighting); returns 1.0 when absent so callers can
+        multiply into the padding sample-weight vector unconditionally."""
+        if sample_weight is None:
+            return np.float32(1.0)
+        from ytk_mp4j_tpu.exceptions import Mp4jError
+
+        sw = np.asarray(sample_weight, np.float32)
+        if sw.shape != (N,):
+            raise Mp4jError(
+                f"sample_weight must be [N={N}], got {sw.shape}")
+        return sw
+
     def _put_sharded(self, a: np.ndarray, per: int):
         """Reshape [n*per, ...] -> [n, per, ...] and place on the mesh.
 

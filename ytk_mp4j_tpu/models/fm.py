@@ -531,34 +531,43 @@ class FMTrainer(DataParallelTrainer):
                 or fields.max(initial=0) >= self.cfg.n_fields):
             raise Mp4jError("field id out of range")
 
-    def shard_data(self, feats, fields, vals, y):
+    def shard_data(self, feats, fields, vals, y, sample_weight=None):
         """Pad + shard padded-sparse instances.
 
         feats/fields: [N, K] int (K <= max_nnz; padded slots = any id
-        with value 0); vals: [N, K] float; y: [N].
-        """
+        with value 0); vals: [N, K] float; y: [N]. ``sample_weight``
+        ([N] f32, optional — ytk-learn's instance weights) scales each
+        example's loss/gradient contribution (the step normalizes by
+        the weight sum, so integer weights train exactly like row
+        duplication) and composes with the padding zeros."""
         y = np.asarray(y, np.float32)
         feats, fields, vals, mask = self._stage_instances(feats, fields,
                                                           vals)
+        N = feats.shape[0]
         (feats, fields, vals, mask, y), per, sw = self._pad_rows(
             [feats, fields, vals, mask, y])
+        sw[:N] *= self._stage_weights(sample_weight, N)
         put = lambda a: self._put_sharded(a, per)  # noqa: E731
         return (put(feats), put(fields), put(vals), put(mask), put(y),
                 put(sw))
 
     def fit(self, feats, fields, vals, y, n_steps: int = 100, params=None,
             seed: int = 0, eval_set=None,
-            early_stopping_rounds: int | None = None):
+            early_stopping_rounds: int | None = None,
+            sample_weight=None):
         """Full-batch training; returns (params, losses).
 
         ``eval_set=(feats_va, fields_va, vals_va, y_va)`` evaluates the
         held-out loss after every step (history in
         ``self.eval_history_``); ``early_stopping_rounds=k`` stops after
-        k non-improving steps and returns the best round's params.
+        k non-improving steps and returns the best round's params;
+        ``sample_weight`` ([N]) weights each example's loss/gradient
+        (integer weights == row duplication).
         """
         if early_stopping_rounds is not None and eval_set is None:
             raise Mp4jError("early_stopping_rounds requires an eval_set")
-        sharded = self.shard_data(feats, fields, vals, y)
+        sharded = self.shard_data(feats, fields, vals, y,
+                                  sample_weight=sample_weight)
         # the jitted step bakes in the sparse capacity, which depends on
         # the per-shard batch size — rebuild when that changes (a stale
         # smaller capacity would silently drop gradient rows)
@@ -595,7 +604,8 @@ class FMTrainer(DataParallelTrainer):
         ytk-learn consumes streamed libsvm-format text. ``batches`` is
         any iterator/generator of ``(feats, fields, vals, y)``
         minibatches (``utils.libsvm.read_libsvm`` streams them from
-        disk); one optimizer step runs per chunk.
+        disk) — or 5-tuples with per-chunk instance weights appended;
+        one optimizer step runs per chunk.
 
         Every chunk is padded to ``batch_rows`` total rows (default:
         the first chunk's size rounded up to the shard count) with
@@ -638,15 +648,18 @@ class FMTrainer(DataParallelTrainer):
         (resolving it from the first chunk), and start the async
         device placement. Returns ((sharded..., per_shard_slots),
         batch_rows)."""
-        feats, fields, vals, y = chunk
+        feats, fields, vals, y = chunk[:4]
+        weights = chunk[4] if len(chunk) > 4 else None
         y = np.asarray(y, np.float32)
         feats, fields, vals, mask = self._stage_instances(
             feats, fields, vals)
         if batch_rows is None:
             batch_rows = (-(-feats.shape[0] // self.n_shards)
                           * self.n_shards)
+        N = feats.shape[0]
         (feats, fields, vals, mask, y), sw, per = self._pad_stream_rows(
             [feats, fields, vals, mask, y], batch_rows)
+        sw[:N] *= self._stage_weights(weights, N)
         sharded = tuple(self._put_sharded(a, per)
                         for a in (feats, fields, vals, mask, y, sw))
         return (sharded, per * self.cfg.max_nnz), batch_rows

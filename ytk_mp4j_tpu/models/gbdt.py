@@ -708,12 +708,7 @@ class GBDTTrainer(DataParallelTrainer):
         self._check_bins_width(bins)
         N = bins.shape[0]
         (bins, y), per, w = self._pad_rows([bins, y])
-        if sample_weight is not None:
-            sw = np.asarray(sample_weight, np.float32)
-            if sw.shape != (N,):
-                raise Mp4jError(
-                    f"sample_weight must be [N={N}], got {sw.shape}")
-            w[:N] *= sw
+        w[:N] *= self._stage_weights(sample_weight, N)
         if self.cfg.loss == "softmax":
             preds = np.zeros((y.shape[0], self.cfg.n_classes), np.float32)
         else:

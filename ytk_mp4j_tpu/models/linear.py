@@ -160,32 +160,42 @@ class LinearTrainer(DataParallelTrainer):
 
         return jax.jit(step)
 
-    def shard_data(self, x: np.ndarray, y: np.ndarray):
+    def shard_data(self, x: np.ndarray, y: np.ndarray,
+                   sample_weight=None):
         """Pad + reshape to [n_shards, N/shard, ...]; padding rows carry
-        sample weight 0 so results match unsharded runs for any N."""
+        sample weight 0 so results match unsharded runs for any N.
+        ``sample_weight`` ([N], optional — ytk-learn's instance
+        weights) scales each example's loss/gradient (the step
+        normalizes by the weight sum: integer weights == row
+        duplication)."""
         x = np.asarray(x, np.float32)
         y = self._stage_labels(y)
         if x.ndim != 2 or x.shape[1] != self.cfg.n_features:
             raise Mp4jError(
                 f"x must be [N, {self.cfg.n_features}], got {x.shape}")
+        N = x.shape[0]
         (x, y), per, sw = self._pad_rows([x, y])
+        sw[:N] *= self._stage_weights(sample_weight, N)
         return (self._put_sharded(x, per), self._put_sharded(y, per),
                 self._put_sharded(sw, per))
 
     def fit(self, x: np.ndarray, y: np.ndarray, n_steps: int = 100,
             params=None, eval_set=None,
-            early_stopping_rounds: int | None = None):
+            early_stopping_rounds: int | None = None,
+            sample_weight=None):
         """Run ``n_steps`` full-batch steps; returns (params, losses).
 
         ``eval_set=(x_va, y_va)`` tracks held-out loss per step (history
         in ``self.eval_history_``); ``early_stopping_rounds=k`` stops
-        after k non-improving steps and returns the best round's params.
+        after k non-improving steps and returns the best round's
+        params; ``sample_weight`` weights examples (see
+        :meth:`shard_data`).
         """
         if early_stopping_rounds is not None and eval_set is None:
             raise Mp4jError("early_stopping_rounds requires an eval_set")
         if self._step is None:
             self._step = self._build_step()
-        dx, dy, dsw = self.shard_data(x, y)
+        dx, dy, dsw = self.shard_data(x, y, sample_weight=sample_weight)
         if params is None:
             params = self.init_params()
         # committed up front: an uncommitted first call would compile
@@ -229,7 +239,8 @@ class LinearTrainer(DataParallelTrainer):
                    batch_rows: int | None = None,
                    max_in_flight: int = 2):
         """Chunked (out-of-core) training: one optimizer step per
-        ``(x, y)`` chunk — ytk-learn's linear family trains from the
+        ``(x, y)`` chunk (or ``(x, y, w)`` with per-chunk instance
+        weights) — ytk-learn's linear family trains from the
         same streamed libsvm text as FFM
         (``utils.libsvm.read_libsvm`` + ``utils.libsvm.dense_chunks``
         adapts it to the dense [N, F] this model consumes). Chunks pad
@@ -263,7 +274,8 @@ class LinearTrainer(DataParallelTrainer):
         """Host half of one stream step: validate, pad to
         ``batch_rows`` (resolving it from the first chunk), start the
         async device placement."""
-        x, y = chunk
+        x, y = chunk[:2]
+        weights = chunk[2] if len(chunk) > 2 else None
         x = np.asarray(x, np.float32)
         y = self._stage_labels(y)
         if x.ndim != 2 or x.shape[1] != self.cfg.n_features:
@@ -271,7 +283,9 @@ class LinearTrainer(DataParallelTrainer):
                 f"x must be [N, {self.cfg.n_features}], got {x.shape}")
         if batch_rows is None:
             batch_rows = -(-x.shape[0] // self.n_shards) * self.n_shards
+        N = x.shape[0]
         (x, y), sw, per = self._pad_stream_rows([x, y], batch_rows)
+        sw[:N] *= self._stage_weights(weights, N)
         staged = (self._put_sharded(x, per), self._put_sharded(y, per),
                   self._put_sharded(sw, per))
         return staged, batch_rows
