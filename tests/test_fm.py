@@ -525,3 +525,33 @@ def test_fm_sample_weight_equals_duplication(rng):
         ((feats, fields, vals, y, k.astype(np.float32))
          for _ in range(3)), seed=2)
     np.testing.assert_allclose(l_s, l_w_sparse, rtol=1e-5, atol=1e-7)
+
+
+def test_read_libsvm_native_rounding_parity():
+    """Literals where single-rounding strtof diverges from the Python
+    float()->float32 double rounding must still parse byte-identically
+    on the native path (round-5 review catch: 1-ulp divergence on e.g.
+    0.0000180163488039397634566 before strtod_l + cast)."""
+    from ytk_mp4j_tpu.utils.libsvm import read_libsvm, _parse_chunk_slow
+
+    lines = ["1 3:0.0000180163488039397634566",
+             "0 3:0.0000049054617647925624624",
+             "1 3:0.0188519200310111045837402"]
+    a = list(read_libsvm(iter(lines), chunk_rows=8, max_nnz=4))[0]
+    b = _parse_chunk_slow(lines, [1, 2, 3], 4)
+    for x, z in zip(a, b):
+        np.testing.assert_array_equal(x, z)
+
+
+def test_trainer_weight_validation(rng):
+    """NaN / negative / all-zero instance weights must raise on the
+    trainer surfaces like they do on the binning surface — they would
+    otherwise corrupt the weighted-mean steps silently (round-5 review
+    catch)."""
+    feats, fields, vals, y = make_sparse_classification(rng, n=16)
+    tr = FMTrainer(FMConfig(n_features=64, n_fields=4, k=2, max_nnz=4,
+                            model="ffm"), mesh=make_mesh(2))
+    for bad in (np.full(16, np.nan), -np.ones(16), np.zeros(16)):
+        with pytest.raises(Mp4jError):
+            tr.fit(feats, fields, vals, y, n_steps=1,
+                   sample_weight=bad)

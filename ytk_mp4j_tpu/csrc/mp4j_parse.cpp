@@ -74,9 +74,14 @@ bool parse_f32(const char* b, const char* e, float* out) {
   memcpy(tmp, b, n);
   tmp[n] = '\0';
   char* endp = nullptr;
-  float v = strtof_l(tmp, &endp, c_locale());  // overflow -> +-inf,
-  if (endp != tmp + n) return false;           // like float()
-  *out = v;
+  // parse at DOUBLE precision then cast, matching the Python path's
+  // float() -> np.float32 double rounding exactly: strtof's single
+  // rounding diverges by 1 ulp on some literals (e.g.
+  // "0.0000180163488039397634566"), which would make fast-path and
+  // replay-path training bytes differ. Overflow -> +-inf, like float().
+  double v = strtod_l(tmp, &endp, c_locale());
+  if (endp != tmp + n) return false;
+  *out = (float)v;
   return true;
 }
 

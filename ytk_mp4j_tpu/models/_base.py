@@ -214,7 +214,12 @@ class DataParallelTrainer:
     def _stage_weights(sample_weight, N: int):
         """Validate optional [N] instance weights (ytk-learn's
         per-example weighting); returns 1.0 when absent so callers can
-        multiply into the padding sample-weight vector unconditionally."""
+        multiply into the padding sample-weight vector unconditionally.
+        The checks mirror binning._check_weights — NaN/negative weights
+        would corrupt the weighted-mean steps SILENTLY (NaN losses, or
+        sign-flipped gradients), and an all-zero vector trains nothing
+        while reporting loss 0. Individual zeros are fine (a zero
+        weight excludes the row, like padding)."""
         if sample_weight is None:
             return np.float32(1.0)
         from ytk_mp4j_tpu.exceptions import Mp4jError
@@ -223,6 +228,12 @@ class DataParallelTrainer:
         if sw.shape != (N,):
             raise Mp4jError(
                 f"sample_weight must be [N={N}], got {sw.shape}")
+        if not np.isfinite(sw).all() or (sw < 0).any():
+            raise Mp4jError(
+                "sample_weight must be finite and non-negative")
+        if N and not (sw > 0).any():
+            raise Mp4jError(
+                "sample_weight sums to zero: nothing to train on")
         return sw
 
     def _put_sharded(self, a: np.ndarray, per: int):

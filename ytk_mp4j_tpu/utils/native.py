@@ -72,7 +72,14 @@ def _load():
             return _lib
         try:
             lib = ctypes.CDLL(_build())
-        except (OSError, subprocess.CalledProcessError):
+            # probe the NEWEST symbol: a stale cached .so (an
+            # mtime-preserving sync of newer sources over an old build
+            # tree) would lack it, and missing symbols must mean
+            # "native unavailable", never an AttributeError crash in
+            # every consumer
+            lib.mp4j_parse_libsvm
+        except (OSError, subprocess.CalledProcessError,
+                AttributeError):
             HAVE_NATIVE = False
             return None
         lib.mp4j_reduce.restype = ctypes.c_int
