@@ -3,6 +3,7 @@ virtual mesh — dense psum vs device-native sparse path differentially."""
 
 import numpy as np
 import pytest
+from hypothesis import given, settings, strategies as st
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.models.fm import FMConfig, FMTrainer
@@ -289,30 +290,9 @@ def test_read_libsvm_errors():
         run(["1 1:1"], chunk_rows=0, max_nnz=4)
 
 
-def test_read_libsvm_native_differential(rng):
-    """The native chunk scanner (csrc/mp4j_parse.cpp) must parse
-    byte-identically to the per-line Python contract on random
-    well-formed chunks, and refused shapes must replay losslessly."""
-    from ytk_mp4j_tpu.utils.libsvm import read_libsvm, _parse_chunk_slow
-
-    for _ in range(10):
-        n = int(rng.integers(1, 40))
-        lines = []
-        for _i in range(n):
-            kk = int(rng.integers(0, 5))
-            if rng.random() < 0.5:
-                toks = " ".join(
-                    f"{rng.integers(0, 10**6)}:{rng.normal():.6g}"
-                    for _ in range(kk))
-            else:
-                toks = " ".join(
-                    f"{rng.integers(0, 50)}:{rng.integers(0, 10**6)}:"
-                    f"{rng.normal():.6g}" for _ in range(kk))
-            lines.append(f"{rng.normal():.4g} {toks}")
-        a = list(read_libsvm(iter(lines), chunk_rows=64, max_nnz=5))[0]
-        b = _parse_chunk_slow(lines, list(range(1, n + 1)), 5)
-        for x, z in zip(a, b):
-            np.testing.assert_array_equal(x, z)
+# (the native-vs-Python reader differential lives in
+# test_read_libsvm_fuzz_differential below — one hypothesis property,
+# byte-strict, native-gated)
 
 
 def test_read_libsvm_exotic_literals_and_overflow():
@@ -555,3 +535,57 @@ def test_trainer_weight_validation(rng):
         with pytest.raises(Mp4jError):
             tr.fit(feats, fields, vals, y, n_steps=1,
                    sample_weight=bad)
+
+
+@st.composite
+def _libsvm_lines(draw):
+    """Random well-formed libsvm/libffm lines the NATIVE scanner
+    accepts (plain numeric labels and values, ids within int32) —
+    exotic/malformed literals would route the whole chunk to the
+    Python replay and make the differential compare the replay against
+    itself. Refused-shape behavior is covered separately
+    (test_read_libsvm_exotic_literals_and_overflow,
+    test_read_libsvm_errors)."""
+    n = draw(st.integers(1, 12))
+    lines = []
+    for _ in range(n):
+        label = draw(st.one_of(
+            st.integers(-5, 5).map(str),
+            st.floats(-1e6, 1e6, allow_nan=False).map("{:.6g}".format)))
+        k = draw(st.integers(0, 4))
+        w = draw(st.sampled_from([2, 3]))
+        toks = []
+        for _s in range(k):
+            feat = draw(st.integers(0, 2 ** 31 - 1))
+            val = draw(st.one_of(
+                st.floats(-1e30, 1e30, allow_nan=False)
+                .map("{:.17g}".format),   # rounding-boundary widths
+                st.sampled_from(["0", "1e-40", "2.5e38", "-0.0"])))
+            if w == 2:
+                toks.append(f"{feat}:{val}")
+            else:
+                toks.append(f"{draw(st.integers(0, 50))}:{feat}:{val}")
+        lines.append(f"{label} " + " ".join(toks))
+    return lines
+
+
+@settings(max_examples=60, deadline=None)
+@given(_libsvm_lines())
+def test_read_libsvm_fuzz_differential(lines):
+    """Property: the native fast path parses BYTE-identically
+    (dtype + tobytes, so -0.0 vs +0.0 and 1-ulp rounding divergences
+    fail) to the per-line Python contract on arbitrary well-formed
+    chunks. Requires the native scanner — comparing the replay path
+    against itself would verify nothing."""
+    from ytk_mp4j_tpu.utils import native
+    from ytk_mp4j_tpu.utils.libsvm import read_libsvm, _parse_chunk_slow
+
+    native._load()
+    if not native.HAVE_NATIVE:
+        pytest.skip("native scanner unavailable (no toolchain)")
+    got = list(read_libsvm(iter(lines), chunk_rows=64, max_nnz=4))
+    want = _parse_chunk_slow(lines, list(range(1, len(lines) + 1)), 4)
+    assert len(got) == 1
+    for a, b in zip(got[0], want):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
