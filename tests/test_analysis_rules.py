@@ -1,0 +1,460 @@
+"""Rule-engine unit tests: every mp4j-lint rule has a known-bad snippet
+it must flag and a known-good snippet it must not, plus engine-level
+tests for suppressions, the baseline format, and parse failures."""
+
+import textwrap
+
+import pytest
+
+from ytk_mp4j_tpu.analysis import baseline as baseline_mod
+from ytk_mp4j_tpu.analysis.engine import Engine, parse_inline_suppressions
+from ytk_mp4j_tpu.analysis.report import Severity
+from ytk_mp4j_tpu.analysis.rules import ALL_RULES, get_rules
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+COMM_PATH = "ytk_mp4j_tpu/comm/snippet.py"
+
+
+def run_rule(rule_id, src, path=COMM_PATH, baseline=None):
+    engine = Engine(rules=get_rules([rule_id]), baseline=baseline)
+    result = engine.lint_source(textwrap.dedent(src), path)
+    assert not [f for f in result.findings if f.rule == "E001"], \
+        f"snippet failed to parse: {result.findings}"
+    return result
+
+
+# ----------------------------------------------------------------------
+# R1 — rank-conditional collective
+# ----------------------------------------------------------------------
+def test_r1_fires_on_one_armed_collective():
+    r = run_rule("R1", """
+        def step(comm, x):
+            if comm.rank == 0:
+                comm.broadcast_array(x)
+    """)
+    [f] = r.findings
+    assert f.rule == "R1" and f.line == 3
+    assert "broadcast_array" in f.message
+
+
+def test_r1_fires_on_unbalanced_elif():
+    r = run_rule("R1", """
+        def step(comm, x):
+            if comm.rank == 0:
+                comm.barrier()
+            elif comm.rank == 1:
+                comm.barrier()
+    """)
+    # the elif arm has no matching call for ranks >= 2
+    assert [f.line for f in r.findings] == [5]
+
+
+def test_r1_quiet_on_balanced_branches():
+    r = run_rule("R1", """
+        def step(comm, x, y):
+            if comm.rank == 0:
+                comm.broadcast_array(x)
+            else:
+                comm.broadcast_array(y)
+    """)
+    assert not r.findings
+
+
+def test_r1_quiet_on_point_to_point_and_nonrank():
+    r = run_rule("R1", """
+        def reduce(self, vr, mask, acc, operand):
+            if vr & mask:
+                self._send_segment(0, acc, operand)
+            if acc is None:
+                self.allreduce_array(acc)
+    """)
+    assert not r.findings
+
+
+def test_r1_ignores_collectives_in_nested_defs():
+    r = run_rule("R1", """
+        def step(comm):
+            if comm.rank == 0:
+                def later():
+                    comm.barrier()
+    """)
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R2 — unbounded socket ops
+# ----------------------------------------------------------------------
+def test_r2_fires_on_naked_recv():
+    r = run_rule("R2", """
+        class C:
+            def pull(self):
+                return self.sock.recv(1024)
+    """)
+    [f] = r.findings
+    assert f.rule == "R2" and "recv" in f.message
+    assert f.context == "C.pull"
+
+
+def test_r2_quiet_with_timeout_handler():
+    r = run_rule("R2", """
+        import socket
+        class C:
+            def pull(self):
+                try:
+                    return self.sock.recv(1024)
+                except socket.timeout:
+                    raise Mp4jError("dead peer")
+    """)
+    assert not r.findings
+
+
+def test_r2_quiet_after_settimeout_same_receiver():
+    r = run_rule("R2", """
+        class C:
+            def pull(self):
+                self.sock.settimeout(5.0)
+                return self.sock.recv(1024)
+    """)
+    assert not r.findings
+
+
+def test_r2_settimeout_is_receiver_aware():
+    r = run_rule("R2", """
+        class C:
+            def pull(self, ch):
+                self.server.settimeout(5.0)
+                return ch.recv()
+    """)
+    assert len(r.findings) == 1
+
+
+def test_r2_settimeout_none_does_not_count():
+    r = run_rule("R2", """
+        class C:
+            def pull(self):
+                self.sock.settimeout(None)
+                return self.sock.recv(1024)
+    """)
+    assert len(r.findings) == 1
+
+
+def test_r2_quiet_on_own_wrapper_delegation():
+    r = run_rule("R2", """
+        class Channel:
+            def recv_array(self):
+                return self.recv()
+    """)
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R3 — thread-group shared state outside the lock
+# ----------------------------------------------------------------------
+def test_r3_fires_on_unlocked_store():
+    r = run_rule("R3", """
+        class T:
+            def f(self):
+                self._g.result = 1
+    """)
+    [f] = r.findings
+    assert "result" in f.message
+
+
+def test_r3_fires_through_local_alias():
+    r = run_rule("R3", """
+        class T:
+            def f(self):
+                slots = self._g.slots
+                slots[0] = None
+    """)
+    [f] = r.findings
+    assert "slots" in f.message and f.line == 5
+
+
+def test_r3_fires_on_mutator_call():
+    r = run_rule("R3", """
+        class T:
+            def f(self, x):
+                self._g.slots.append(x)
+    """)
+    assert len(r.findings) == 1
+
+
+def test_r3_quiet_under_lock():
+    r = run_rule("R3", """
+        class T:
+            def f(self):
+                with self._g.lock:
+                    self._g.max_code = 2
+                    self._g.pending_closes -= 1
+    """)
+    assert not r.findings
+
+
+def test_r3_quiet_on_non_group_receiver():
+    r = run_rule("R3", """
+        class T:
+            def f(self):
+                self.result = 1
+                self.other.slots = []
+    """)
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R4 — operand mismatch between paired segment transfers
+# ----------------------------------------------------------------------
+def test_r4_fires_on_operand_mismatch():
+    r = run_rule("R4", """
+        class C:
+            def bcast(self, arr, operand):
+                if self.rank == 0:
+                    self._send_segment(1, arr, operand)
+                else:
+                    self._recv_segment_into(0, arr, 0, 8, Operands.DOUBLE)
+    """)
+    [f] = r.findings
+    assert "Operands.DOUBLE" in f.message and "operand" in f.message
+
+
+def test_r4_quiet_on_consistent_operand():
+    r = run_rule("R4", """
+        class C:
+            def bcast(self, arr, operand):
+                if self.rank == 0:
+                    self._send_segment(1, arr, operand)
+                else:
+                    self._recv_segment(0, 8, operand)
+    """)
+    assert not r.findings
+
+
+def test_r4_scopes_per_function():
+    # different collectives may use different operands — only intra-
+    # function disagreement is a paired-exchange mismatch
+    r = run_rule("R4", """
+        class C:
+            def a(self, arr, operand):
+                self._send_segment(1, arr, operand)
+            def b(self, arr):
+                self._recv_segment(0, 8, Operands.FLOAT)
+    """)
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R5 — swallowed exceptions
+# ----------------------------------------------------------------------
+def test_r5_fires_on_bare_except_anywhere():
+    r = run_rule("R5", """
+        def f():
+            try:
+                g()
+            except:
+                raise RuntimeError("x")
+    """, path="ytk_mp4j_tpu/models/snippet.py")
+    [f] = r.findings
+    assert "bare" in f.message
+
+
+def test_r5_fires_on_swallowed_broad_except_in_comm():
+    r = run_rule("R5", """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    [f] = r.findings
+    assert "swallows" in f.message
+
+
+def test_r5_quiet_on_narrow_or_handled():
+    r = run_rule("R5", """
+        def f():
+            try:
+                g()
+            except OSError:
+                pass
+            try:
+                g()
+            except Exception as e:
+                log(e)
+    """)
+    assert not r.findings
+
+
+def test_r5_broad_swallow_ok_outside_hot_paths():
+    r = run_rule("R5", """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, path="ytk_mp4j_tpu/models/snippet.py")
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R6 — aliased slot returned from a fan-out leader
+# ----------------------------------------------------------------------
+def test_r6_fires_on_raw_slot_return():
+    r = run_rule("R6", """
+        class T:
+            def allreduce(self):
+                def leader(slots):
+                    acc = slots[0]
+                    return acc
+    """)
+    [f] = r.findings
+    assert f.line == 6
+
+
+def test_r6_fires_on_conditional_slot_return():
+    r = run_rule("R6", """
+        class T:
+            def bcast(self, root):
+                def leader(slots):
+                    return slots[root] if root else slots[0]
+    """)
+    assert len(r.findings) == 1
+
+
+def test_r6_quiet_on_detached_returns():
+    r = run_rule("R6", """
+        class T:
+            def bcast(self):
+                def leader(slots):
+                    return self._detach(slots[0])
+            def gather(self):
+                def leader(slots):
+                    full = build(slots)
+                    return full
+    """)
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R7 — mutable defaults and module-level mutable state
+# ----------------------------------------------------------------------
+def test_r7_fires_on_mutable_default():
+    r = run_rule("R7", """
+        def f(x, acc=[], *, opts={}):
+            acc.append(x)
+    """, path="ytk_mp4j_tpu/models/snippet.py")   # anywhere, not just comm
+    assert sorted("acc" in f.message or "opts" in f.message
+                  for f in r.findings) == [True, True]
+
+
+def test_r7_fires_on_mutated_module_state_in_comm():
+    r = run_rule("R7", """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+    """)
+    [f] = r.findings
+    assert "_CACHE" in f.message and f.line == 2
+
+
+def test_r7_quiet_on_readonly_table_and_instance_state():
+    r = run_rule("R7", """
+        _TABLE = {1: "a", 2: "b"}
+
+        class C:
+            def __init__(self):
+                self.cache = {}
+
+            def get(self, k):
+                return _TABLE[k]
+
+            def put(self, k, v):
+                self.cache[k] = v
+    """)
+    assert not r.findings
+
+
+def test_r7_module_state_not_flagged_outside_comm_dirs():
+    r = run_rule("R7", """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+    """, path="ytk_mp4j_tpu/models/snippet.py")
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# engine: suppressions, baseline, parse errors
+# ----------------------------------------------------------------------
+def test_inline_suppression_same_line_and_line_above():
+    src = """
+        def f(comm, x):
+            if comm.rank == 0:  # mp4j-lint: disable=R1 (balanced elsewhere)
+                comm.barrier()
+            # mp4j-lint: disable=R1 (documented leader pattern)
+            if comm.rank == 1:
+                comm.barrier()
+            if comm.rank == 2:
+                comm.barrier()
+    """
+    r = run_rule("R1", src)
+    assert len(r.findings) == 1        # only the unsuppressed third branch
+    assert r.findings[0].line == 8
+    assert len(r.suppressed) == 2
+
+
+def test_inline_suppression_is_rule_specific():
+    r = run_rule("R1", """
+        def f(comm, x):
+            if comm.rank == 0:  # mp4j-lint: disable=R2
+                comm.barrier()
+    """)
+    assert len(r.findings) == 1
+
+
+def test_parse_directive_formats():
+    sup = parse_inline_suppressions(
+        "x = 1  # mp4j-lint: disable=R1,R3 (reason text)\n")
+    assert sup[1] == {"R1", "R3"}
+
+
+def test_baseline_match_context_and_contains():
+    bl = baseline_mod.parse(textwrap.dedent("""
+        # a comment
+        [[suppression]]
+        rule = "R3"
+        file = "ytk_mp4j_tpu/comm/snippet.py"
+        context = "T.f"
+        reason = "barrier-delimited"
+    """))
+    r = run_rule("R3", """
+        class T:
+            def f(self):
+                self._g.result = 1
+            def g(self):
+                self._g.result = 2
+    """, baseline=bl)
+    assert [f.context for f in r.findings] == ["T.g"]
+    assert [f.context for f in r.suppressed] == ["T.f"]
+    assert not bl.unused()
+
+
+def test_baseline_rejects_unsupported_syntax():
+    with pytest.raises(Mp4jError):
+        baseline_mod.parse("[[suppression]]\nrule = 42\n")
+    with pytest.raises(Mp4jError):
+        baseline_mod.parse('[[suppression]]\nrule = "R1"\n')  # missing file
+
+
+def test_syntax_error_reported_as_finding():
+    r = Engine(rules=get_rules()).lint_source("def f(:\n", "bad.py")
+    [f] = r.findings
+    assert f.rule == "E001" and f.severity == Severity.ERROR
+
+
+def test_rule_catalogue_complete():
+    ids = [cls.rule_id for cls in ALL_RULES]
+    assert ids == [f"R{i}" for i in range(1, 8)]
+    with pytest.raises(KeyError):
+        get_rules(["R99"])

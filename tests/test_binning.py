@@ -3,6 +3,7 @@ the continuous-features -> bins -> train -> predict consumer flow."""
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # property tests need hypothesis
 from hypothesis import given, settings, strategies as st
 
 from ytk_mp4j_tpu.exceptions import Mp4jError

@@ -9,6 +9,7 @@ case a full XLA compile)."""
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # property tests need hypothesis
 from hypothesis import given, settings, strategies as st
 
 from ytk_mp4j_tpu import meta

@@ -3,6 +3,7 @@ virtual mesh — dense psum vs device-native sparse path differentially."""
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # property tests need hypothesis
 from hypothesis import given, settings, strategies as st
 
 from ytk_mp4j_tpu.exceptions import Mp4jError

@@ -1,0 +1,72 @@
+"""Tier-1 gate: the comm stack must lint clean forever.
+
+Runs mp4j-lint (all rules, committed baseline) over the installed
+``ytk_mp4j_tpu`` package and fails on any unsuppressed finding — the
+static analogue of the differential tests: every future PR to comm/,
+ops/, models/ inherits the protocol checks by construction.
+
+Also proves the gate has teeth: a scratch file seeded with a deliberate
+rank-conditional collective must be reported by R1 at the right
+file:line.
+"""
+
+import os
+import textwrap
+
+import ytk_mp4j_tpu
+from ytk_mp4j_tpu.analysis import lint_paths
+from ytk_mp4j_tpu.analysis.cli import DEFAULT_BASELINE, main
+
+PKG_DIR = os.path.dirname(ytk_mp4j_tpu.__file__)
+
+
+def test_repo_lints_clean():
+    result = lint_paths([PKG_DIR])
+    assert result.ok, (
+        "unsuppressed mp4j-lint findings (fix them or add a reasoned "
+        "suppression):\n" + "\n".join(f.format() for f in result.findings))
+
+
+def test_cli_exits_zero_on_repo():
+    assert main([PKG_DIR]) == 0
+
+
+def test_committed_baseline_exists_and_is_fully_used():
+    assert os.path.exists(DEFAULT_BASELINE)
+    from ytk_mp4j_tpu.analysis import baseline as baseline_mod
+    bl = baseline_mod.load(DEFAULT_BASELINE)
+    assert bl.entries, "baseline should carry the accepted findings"
+    assert all(e.reason for e in bl.entries), \
+        "every baseline entry needs a recorded reason"
+    # every committed suppression must still match a real finding —
+    # stale entries would silently widen the accepted surface
+    from ytk_mp4j_tpu.analysis.engine import Engine
+    result = Engine(baseline=bl).lint_paths([PKG_DIR])
+    assert result.ok
+    assert not bl.unused(), \
+        f"stale baseline entries: {bl.unused()}"
+
+
+def test_seeded_rank_conditional_collective_is_caught(tmp_path):
+    scratch = tmp_path / "ytk_mp4j_tpu" / "comm" / "seeded.py"
+    scratch.parent.mkdir(parents=True)
+    scratch.write_text(textwrap.dedent("""
+        def broken_step(comm, grads):       # line 2
+            comm.allreduce_array(grads)     # line 3
+            if comm.rank == 0:              # line 4 <- R1 here
+                comm.barrier()
+    """))
+    result = lint_paths([str(tmp_path)])
+    r1 = [f for f in result.findings if f.rule == "R1"]
+    assert len(r1) == 1
+    assert r1[0].path.endswith("ytk_mp4j_tpu/comm/seeded.py")
+    assert r1[0].line == 4
+    assert r1[0].context == "broken_step"
+
+
+def test_cli_reports_seeded_finding(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(c):\n    if c.rank:\n        c.barrier()\n")
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "R1" in out and "bad.py:2" in out

@@ -11,6 +11,8 @@ cannot state about its thread interleavings)."""
 from functools import partial
 
 import numpy as np
+import pytest
+pytest.importorskip("hypothesis")  # property tests need hypothesis
 from hypothesis import given, settings, strategies as st
 
 import jax

@@ -6,6 +6,7 @@ pattern, driven by hypothesis)."""
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # property tests need hypothesis
 from hypothesis import given, settings, strategies as st
 
 from tests.helpers import run_slaves

@@ -1,0 +1,7 @@
+"""``python -m ytk_mp4j_tpu.analysis`` — the mp4j-lint CLI."""
+
+import sys
+
+from ytk_mp4j_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,107 @@
+"""``mp4j-lint`` — collective-protocol static analyzer CLI.
+
+Usage::
+
+    mp4j-lint [paths...]              # default: ytk_mp4j_tpu
+    python -m ytk_mp4j_tpu.analysis ytk_mp4j_tpu/
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 bad invocation or
+unreadable baseline. By default the committed baseline
+(``ytk_mp4j_tpu/analysis/baseline.toml``) is applied; ``--no-baseline``
+shows everything, ``--write-baseline`` accepts the current findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ytk_mp4j_tpu.analysis import baseline as baseline_mod
+from ytk_mp4j_tpu.analysis.engine import Engine
+from ytk_mp4j_tpu.analysis.report import render_json, render_text
+from ytk_mp4j_tpu.analysis.rules import ALL_RULES, get_rules
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mp4j-lint",
+        description=("static analyzer for distributed-correctness hazards "
+                     "in the mp4j comm stack"))
+    ap.add_argument("paths", nargs="*", default=["ytk_mp4j_tpu"],
+                    help="files or directories to lint "
+                         "(default: ytk_mp4j_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: the committed "
+                         "analysis/baseline.toml)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--select", default=None, metavar="R1,R2,...",
+                    help="run only these rule ids")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--write-baseline", metavar="PATH", default=None,
+                    help="write a baseline accepting the current "
+                         "unsuppressed findings, then exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.rule_id}  {cls.severity!s:7s} {cls.title}: "
+                  f"{cls.description}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    try:
+        rules = get_rules(select)
+    except KeyError as e:
+        print(f"mp4j-lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    bl = None
+    if args.write_baseline:
+        # regeneration must see EVERY finding, or entries the current
+        # baseline already suppresses would be silently dropped
+        args.no_baseline = True
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            bl = baseline_mod.load(args.baseline)
+        except (Mp4jError, OSError) as e:
+            print(f"mp4j-lint: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    result = Engine(rules=rules, baseline=bl).lint_paths(args.paths)
+
+    if args.write_baseline:
+        text = baseline_mod.render(result.findings,
+                                   reason="accepted by --write-baseline")
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"mp4j-lint: wrote {len(result.findings)} suppression(s) "
+              f"to {args.write_baseline}")
+        return 0
+
+    if args.format == "json":
+        print(render_json(result.findings, len(result.suppressed)))
+    else:
+        print(render_text(result.findings, len(result.suppressed)))
+        if bl is not None:
+            for e in bl.unused():
+                print(f"note: unused baseline suppression "
+                      f"({e.rule} {e.file} {e.context})", file=sys.stderr)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,238 @@
+"""AST rule engine for mp4j-lint.
+
+The engine parses each target file once, annotates the tree (parent
+links, a ``Class.func`` scope for every node), collects inline
+``# mp4j-lint: disable=Rn`` directives from the source, and runs every
+registered :class:`Rule` over the tree. Rules are ``ast.NodeVisitor``
+subclasses with scope tracking built in — a rule implements ``visit_*``
+methods and calls :meth:`Rule.report` to emit findings.
+
+Suppression comes in two layers:
+
+- inline: ``# mp4j-lint: disable=R3`` (comma-separated ids, optional
+  free-text reason in parentheses) on the finding's line, or on a
+  comment-only line immediately above it;
+- baseline: entries in ``baseline.toml`` matched by (rule, file suffix,
+  scope) — see :mod:`ytk_mp4j_tpu.analysis.baseline`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from ytk_mp4j_tpu.analysis.report import Finding, Severity
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*mp4j-lint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:\(|$)")
+
+
+def attr_chain(node: ast.AST) -> list[str] | None:
+    """``self._g.slots`` -> ``["self", "_g", "slots"]``; None when the
+    expression is not a pure dotted name (calls, subscripts, ...)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Terminal name of the called object: ``a.b.c(...)`` -> ``"c"``."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def receiver_chain(call: ast.Call) -> list[str] | None:
+    """Dotted receiver of a method call: ``self.sock.recv(...)`` ->
+    ``["self", "sock"]``; None for plain functions or computed bases."""
+    if isinstance(call.func, ast.Attribute):
+        return attr_chain(call.func.value)
+    return None
+
+
+def parse_inline_suppressions(source: str) -> dict[int, set[str]]:
+    """Map of 1-based line number -> suppressed rule ids on that line.
+
+    A directive on a comment-only line applies to the next line as well
+    (so long reasons can sit above the statement they annotate)."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _DIRECTIVE_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if line.lstrip().startswith("#"):    # standalone comment line
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Everything a rule needs about one file."""
+
+    path: str                       # posix-normalized display path
+    tree: ast.Module
+    source: str
+    suppressions: dict[int, set[str]]
+
+    def is_inline_suppressed(self, rule_id: str, line: int) -> bool:
+        on = self.suppressions.get(line, ())
+        return rule_id in on or "*" in on
+
+    def in_dirs(self, *parts: str) -> bool:
+        """True when the file lives under any of the given package
+        directories (e.g. ``ctx.in_dirs("comm", "transport")``)."""
+        segs = self.path.split("/")
+        return any(p in segs for p in parts)
+
+
+class Rule(ast.NodeVisitor):
+    """Base class for lint rules.
+
+    Subclasses set ``rule_id`` / ``severity`` / ``title`` /
+    ``description`` and implement ``visit_*`` methods. The base visitor
+    maintains ``self.scope`` (list of enclosing class/function names) —
+    rules that override ``visit_FunctionDef`` / ``visit_ClassDef`` must
+    call ``self.generic_visit_scoped(node)`` instead of
+    ``generic_visit`` to keep it accurate.
+    """
+
+    rule_id: str = "R?"
+    severity: Severity = Severity.WARNING
+    title: str = ""
+    description: str = ""
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        self.scope: list[str] = []
+        self.visit(ctx.tree)
+        return self.findings
+
+    # -- scope bookkeeping ---------------------------------------------
+    def generic_visit_scoped(self, node: ast.AST) -> None:
+        self.scope.append(getattr(node, "name", "<anon>"))
+        try:
+            self.generic_visit(node)
+        finally:
+            self.scope.pop()
+
+    def visit_FunctionDef(self, node):          # noqa: N802
+        self.generic_visit_scoped(node)
+
+    def visit_AsyncFunctionDef(self, node):     # noqa: N802
+        self.generic_visit_scoped(node)
+
+    def visit_ClassDef(self, node):             # noqa: N802
+        self.generic_visit_scoped(node)
+
+    def qualname(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    # -- emission -------------------------------------------------------
+    def report(self, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule=self.rule_id,
+            severity=self.severity,
+            path=self.ctx.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            context=self.qualname(),
+        ))
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]          # unsuppressed
+    suppressed: list[Finding]        # matched an inline or baseline entry
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+class Engine:
+    """Run a set of rules over files, applying suppressions."""
+
+    def __init__(self, rules=None, baseline=None):
+        if rules is None:
+            from ytk_mp4j_tpu.analysis.rules import get_rules
+            rules = get_rules()
+        self.rules = list(rules)
+        self.baseline = baseline     # analysis.baseline.Baseline or None
+
+    # -- file discovery -------------------------------------------------
+    @staticmethod
+    def collect_files(paths) -> list[str]:
+        out = []
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = sorted(
+                        d for d in dirnames if d != "__pycache__")
+                    out.extend(os.path.join(dirpath, f)
+                               for f in sorted(filenames)
+                               if f.endswith(".py"))
+            else:
+                out.append(p)
+        return out
+
+    # -- entry points ---------------------------------------------------
+    def lint_paths(self, paths) -> LintResult:
+        findings: list[Finding] = []
+        suppressed: list[Finding] = []
+        for path in self.collect_files(paths):
+            r = self.lint_file(path)
+            findings.extend(r.findings)
+            suppressed.extend(r.suppressed)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return LintResult(findings, suppressed)
+
+    def lint_file(self, path: str) -> LintResult:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            return LintResult([Finding(
+                "E001", Severity.ERROR, path.replace(os.sep, "/"),
+                0, 1, f"cannot read file: {e}")], [])
+        return self.lint_source(source, path)
+
+    def lint_source(self, source: str, path: str = "<string>") -> LintResult:
+        display = path.replace(os.sep, "/")
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            return LintResult([Finding(
+                "E001", Severity.ERROR, display,
+                e.lineno or 0, (e.offset or 0) or 1,
+                f"syntax error: {e.msg}")], [])
+        ctx = LintContext(
+            path=display,
+            tree=tree,
+            source=source,
+            suppressions=parse_inline_suppressions(source),
+        )
+        keep: list[Finding] = []
+        dropped: list[Finding] = []
+        for rule in self.rules:
+            for f in rule.run(ctx):
+                if ctx.is_inline_suppressed(f.rule, f.line):
+                    dropped.append(f)
+                elif self.baseline is not None and self.baseline.match(f):
+                    dropped.append(f)
+                else:
+                    keep.append(f)
+        return LintResult(keep, dropped)

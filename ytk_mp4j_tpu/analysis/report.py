@@ -1,0 +1,61 @@
+"""Findings and rendering for mp4j-lint.
+
+A :class:`Finding` is one rule violation pinned to ``file:line:col``
+with a severity and the enclosing scope (``Class.method``) — the scope
+is what baseline suppressions key on, so findings survive line drift
+from unrelated edits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error", not "Severity.ERROR"
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str            # "R1".."R7", or "E001" for parse failures
+    severity: Severity
+    path: str            # as given to the engine (normalized to posix)
+    line: int
+    col: int
+    message: str
+    context: str = "<module>"   # enclosing Class.func qualname
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.severity}: {self.message} "
+                f"[{self.context}]")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["severity"] = str(self.severity)
+        return d
+
+
+def render_text(findings, suppressed_count: int = 0) -> str:
+    lines = [f.format() for f in findings]
+    n = len(findings)
+    noun = "finding" if n == 1 else "findings"
+    tail = f"{n} {noun}"
+    if suppressed_count:
+        tail += f" ({suppressed_count} suppressed)"
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def render_json(findings, suppressed_count: int = 0) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "suppressed": suppressed_count,
+    }, indent=2, sort_keys=True)

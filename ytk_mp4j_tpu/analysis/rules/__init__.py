@@ -1,0 +1,52 @@
+"""Rule catalogue for mp4j-lint — one module per rule.
+
+| id | severity | hazard |
+|----|----------|--------|
+| R1 | error    | collective under a rank-dependent branch |
+| R2 | warning  | unbounded socket/Channel recv/accept/sendall |
+| R3 | error    | thread-group shared state outside the lock |
+| R4 | error    | operand mismatch between paired segment transfers |
+| R5 | error    | bare/swallowed exceptions in comm hot paths |
+| R6 | warning  | leader returns an aliased slot (no _detach) |
+| R7 | error    | mutable defaults / mutated module-level state |
+"""
+
+from __future__ import annotations
+
+from ytk_mp4j_tpu.analysis.rules.r1_rank_branch import (
+    R1RankConditionalCollective)
+from ytk_mp4j_tpu.analysis.rules.r2_socket_timeout import (
+    R2UnboundedSocketOp)
+from ytk_mp4j_tpu.analysis.rules.r3_lock_discipline import (
+    R3SharedStateOutsideLock)
+from ytk_mp4j_tpu.analysis.rules.r4_operand_pairing import (
+    R4OperandPairing)
+from ytk_mp4j_tpu.analysis.rules.r5_swallowed_exceptions import (
+    R5SwallowedException)
+from ytk_mp4j_tpu.analysis.rules.r6_aliased_result import (
+    R6AliasedLeaderResult)
+from ytk_mp4j_tpu.analysis.rules.r7_mutable_state import R7MutableState
+
+ALL_RULES = [
+    R1RankConditionalCollective,
+    R2UnboundedSocketOp,
+    R3SharedStateOutsideLock,
+    R4OperandPairing,
+    R5SwallowedException,
+    R6AliasedLeaderResult,
+    R7MutableState,
+]
+
+RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}
+
+
+def get_rules(select=None):
+    """Fresh rule instances; ``select`` is an iterable of rule ids."""
+    if select is None:
+        classes = ALL_RULES
+    else:
+        unknown = set(select) - set(RULES_BY_ID)
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+        classes = [RULES_BY_ID[s] for s in select]
+    return [cls() for cls in classes]

@@ -36,9 +36,15 @@ class Master:
     """Rank assignment, roster exchange, log sink, barrier, exit codes."""
 
     def __init__(self, slave_num: int, port: int = 0, host: str = "",
-                 log_stream=None, timeout: float | None = 120.0):
+                 log_stream=None, timeout: float | None = 120.0,
+                 handshake_timeout: float | None = 5.0):
+        """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
+        bounds each accepted connection's registration message, so one
+        stray dial-in stalls rendezvous briefly instead of consuming the
+        entire budget while real slaves queue behind it."""
         self.slave_num = slave_num
         self.timeout = timeout
+        self.handshake_timeout = handshake_timeout
         self.log_stream = log_stream if log_stream is not None else sys.stderr
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -98,12 +104,29 @@ class Master:
             except socket.timeout:
                 continue
             ch = Channel(sock)
-            kind, payload = ch.recv()
-            if kind != REGISTER:
+            # bound the registration handshake: a stray connection that
+            # never sends must neither hang rendezvous (no timeout) nor
+            # consume the whole budget while real slaves queue behind it
+            remaining = (None if deadline is None
+                         else max(0.1, deadline - time.time()))
+            bounds = [t for t in (remaining, self.handshake_timeout)
+                      if t is not None]
+            ch.set_timeout(min(bounds) if bounds else None)
+            try:
+                # anything a hostile/broken dial-in can do — reset,
+                # garbage frame, non-tuple payload, malformed REGISTER
+                # body, timeout — must not kill rendezvous for the
+                # real slaves, so the whole decode stays in this try
+                kind, payload = ch.recv()
+                ok = kind == REGISTER and isinstance(payload, dict)
+                listen_port = int(payload["listen_port"]) if ok else 0
+                host = str(payload.get("host") or addr[0]) if ok else ""
+            except Exception:
+                ok = False
+            if not ok:
                 ch.close()
                 continue
-            listen_port = payload["listen_port"]
-            host = payload.get("host") or addr[0]
+            ch.set_timeout(None)  # control plane is fail-stop from here
             pending.append((ch, (host, listen_port)))
         roster = [hp for _, hp in pending]
         for rank, (ch, _) in enumerate(pending):

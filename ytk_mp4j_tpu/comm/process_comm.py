@@ -55,10 +55,14 @@ class ProcessCommSlave(CommSlave):
                  listen_host: str = "127.0.0.1",
                  timeout: float | None = 120.0,
                  peer_timeout: float | None = None,
+                 handshake_timeout: float | None = 30.0,
                  native_transport: bool = True):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
+        ``handshake_timeout`` bounds the rank exchange on each inbound
+        peer connection so a stray/half-dead dial-in cannot wedge the
+        accept loop that every healthy peer depends on.
 
         ``native_transport`` enables the raw (unframed) data plane for
         numeric uncompressed operands — the C++ poll loop when the
@@ -70,6 +74,7 @@ class ProcessCommSlave(CommSlave):
         measures against."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
+        self._handshake_timeout = handshake_timeout
         self._native_transport = native_transport
         # own listen socket on an ephemeral port
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -79,14 +84,20 @@ class ProcessCommSlave(CommSlave):
         self._listen_port = self._server.getsockname()[1]
         self._listen_host = listen_host
 
-        # register with master; blocks until roster is complete
+        # register with master; blocks until roster is complete.
+        # ``timeout`` bounds the whole rendezvous exchange, not just the
+        # TCP connect: a wedged master surfaces as Mp4jError, not a hang.
         self._master = connect(master_host, master_port, timeout=timeout)
+        self._master.set_timeout(timeout)
         self._master.send_obj((master_mod.REGISTER, {
             "listen_port": self._listen_port, "host": listen_host}))
         reply = self._master.recv()
         self._rank = reply["rank"]
         self._roster = reply["roster"]
         self._n = len(self._roster)
+        # after rendezvous the master channel is fail-stop (barrier
+        # waits are unbounded by design, see barrier())
+        self._master.set_timeout(None)
 
         # peer channels: canonical rule — the HIGHER rank connects to the
         # lower rank's listen socket; one duplex channel per pair.
@@ -124,6 +135,9 @@ class ProcessCommSlave(CommSlave):
         gen = self._barrier_gen
         self._barrier_gen += 1
         self._master.send_obj((master_mod.BARRIER, {"gen": gen}))
+        # the release waits on the slowest rank indefinitely — the
+        # reference's fail-stop contract, not a missing timeout
+        # mp4j-lint: disable=R2 (fail-stop barrier wait)
         reply = self._master.recv()
         if reply != ("barrier_release", gen):
             raise Mp4jError(f"barrier protocol violation: {reply!r}")
@@ -154,14 +168,27 @@ class ProcessCommSlave(CommSlave):
                 return  # server closed
             try:
                 ch = Channel(sock)
+                # bound the rank exchange: a stray connection that never
+                # sends must not wedge the accept loop every healthy
+                # peer depends on
+                ch.set_timeout(self._handshake_timeout)
                 peer_rank = ch.recv()
             except Exception:
                 # a peer (or stray connection) died mid-handshake; the
                 # accept loop must survive to serve the healthy peers
                 sock.close()
                 continue
-            ch.set_timeout(self._peer_timeout)
             with self._peer_cv:
+                # only a well-formed, novel rank may claim a peer slot:
+                # a stray dial-in that does send a frame must not
+                # hijack (or orphan) a healthy peer's channel
+                if (not isinstance(peer_rank, int)
+                        or not 0 <= peer_rank < self._n
+                        or peer_rank == self._rank
+                        or peer_rank in self._peers):
+                    ch.close()
+                    continue
+                ch.set_timeout(self._peer_timeout)
                 self._peers[peer_rank] = ch
                 self._peer_cv.notify_all()
 
@@ -200,6 +227,9 @@ class ProcessCommSlave(CommSlave):
             ch.send_obj(data, compress=compress)
 
     def _recv(self, peer: int):
+        # peer channels carry ``peer_timeout`` from creation (_channel /
+        # _accept_loop); None is the reference's fail-stop default
+        # mp4j-lint: disable=R2 (peer_timeout is set at channel creation)
         return self._channel(peer).recv()
 
     def _sendrecv(self, send_peer: int, recv_peer: int, data,

@@ -119,6 +119,10 @@ class ThreadCommSlave(CommSlave):
         """Global barrier: threads sync, thread 0 joins the process-level
         barrier, threads sync again."""
         self.thread_barrier()
+        # leader pattern: only thread 0 joins the process barrier; the
+        # surrounding thread barriers keep every thread's schedule
+        # aligned, so the rank-conditional collective cannot diverge
+        # mp4j-lint: disable=R1 (leader collective bracketed by barriers)
         if self._tr == 0 and self._g.proc is not None:
             self._g.proc.barrier()
         self.thread_barrier()
@@ -183,6 +187,9 @@ class ThreadCommSlave(CommSlave):
         tr = self._tr
         detached = False
         if tr == 0:
+            # barrier-delimited: thread t writes only slot t, and reads
+            # slot t+k only after the round barrier below has published it
+            # mp4j-lint: disable=R3 (disjoint slot ownership per round)
             slots[0] = self._detach(slots[0])
             detached = True
         k = 1
@@ -193,6 +200,7 @@ class ThreadCommSlave(CommSlave):
                     acc = self._detach(acc)
                     detached = True
                 self._merge_into(operator, acc, slots[tr + k])
+                # mp4j-lint: disable=R3 (disjoint slot ownership per round)
                 slots[tr] = acc
             self.thread_barrier()
             k *= 2
@@ -202,11 +210,17 @@ class ThreadCommSlave(CommSlave):
         ``leader`` (merging + process collective), all threads collect.
         With ``tree_operator`` the deposits are pre-reduced into slot 0
         by the pairwise tree above and ``leader`` gets merged slots."""
+        # barrier-delimited: each thread writes only its own slot, and
+        # no slot is read before the barrier below publishes them all
+        # mp4j-lint: disable=R3 (own-slot write before the deposit barrier)
         self._g.slots[self._tr] = deposit()
         self.thread_barrier()
         if tree_operator is not None:
             self._tree_reduce_slots(tree_operator)
         if self._tr == 0:
+            # thread 0 alone writes result, between the deposit barrier
+            # and the publish barrier below — no concurrent reader exists
+            # mp4j-lint: disable=R3 (leader write between barriers)
             self._g.result = leader(self._g.slots)
         self.thread_barrier()
         out = collect(self._g.result)
@@ -266,6 +280,7 @@ class ThreadCommSlave(CommSlave):
             if self._g.proc is not None:
                 self._g.proc.allreduce_array(acc, operand, operator,
                                              algo=algo)
+            # mp4j-lint: disable=R6 (slot 0 detached by _tree_reduce_slots)
             return acc
 
         def collect(result):
@@ -290,6 +305,7 @@ class ThreadCommSlave(CommSlave):
             if self._g.proc is not None:
                 self._g.proc.reduce_array(acc, operand, operator,
                                           root=root_proc)
+            # mp4j-lint: disable=R6 (slot 0 detached by _tree_reduce_slots)
             return acc
 
         def collect(result):
@@ -434,6 +450,7 @@ class ThreadCommSlave(CommSlave):
                 self._g.proc.reduce_scatter_array(
                     acc, operand, operator,
                     ranges=self._coarse_ranges(ranges))
+            # mp4j-lint: disable=R6 (slot 0 detached by _tree_reduce_slots)
             return acc
 
         def collect(result):

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ytk_mp4j_tpu.utils.compat import shard_map
 
 from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm import keycodec

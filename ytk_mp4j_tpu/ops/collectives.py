@@ -54,6 +54,8 @@ from ytk_mp4j_tpu.operators import Operator, Operators
 # Override with MP4J_NATIVE_REDUCE=1 (always native) / =0 (always
 # fallback) or set_native_reduce(); unset/None means auto-probe.
 # ----------------------------------------------------------------------
+# both caches are process-wide by design (R7-baselined): the probe
+# verdict is a property of the platform, reset via set_native_reduce
 _PROBE_CACHE: dict[tuple[str, str], bool] = {}
 # (platform, kind) -> monotonic time of the last transient probe verdict
 _TRANSIENT_AT: dict[tuple[str, str], float] = {}
@@ -74,7 +76,7 @@ def _tracing() -> bool:
     try:
         from jax._src import core as _core
         return not _core.trace_state_clean()
-    except Exception:
+    except Exception:  # fall through to the next probe (R5-baselined)
         pass
     try:  # pragma: no cover - only if the internal API moves
         return not jax.core.trace_state_clean()

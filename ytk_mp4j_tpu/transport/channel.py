@@ -66,11 +66,20 @@ class Channel:
 
     # -- low level ------------------------------------------------------
     def _send_all(self, *bufs: bytes | memoryview):
-        for b in bufs:
-            self.sock.sendall(b)
+        # a socket timeout (set_timeout) applies to sends too: a peer
+        # that stops draining must surface as Mp4jError like a dead
+        # receiver does, not as a raw socket.timeout
+        try:
+            for b in bufs:
+                self.sock.sendall(b)
+        except socket.timeout:
+            raise Mp4jError(
+                "send timed out (peer dead or not draining?)") from None
 
     def set_timeout(self, timeout: float | None) -> None:
-        """Receive timeout. ``None`` (default) is the reference's
+        """Transfer timeout, both directions: receives AND sends (a
+        peer that stops draining stalls sendall the same way a dead
+        sender stalls recv). ``None`` (default) is the reference's
         fail-stop behavior — a dead peer blocks forever; a finite value
         turns that hang into a diagnosable Mp4jError."""
         self.sock.settimeout(timeout)
@@ -125,7 +134,11 @@ class Channel:
     # DataOutputStream fast path. Used by ProcessCommSlave's numeric
     # collectives (native poll loop when available, these when not).
     def send_raw(self, arr: np.ndarray) -> None:
-        self.sock.sendall(_raw_view(arr))
+        try:
+            self.sock.sendall(_raw_view(arr))
+        except socket.timeout:
+            raise Mp4jError(
+                "raw send timed out (peer dead or not draining?)") from None
 
     def recv_raw_into(self, arr: np.ndarray) -> None:
         view = memoryview(_raw_view(arr))

@@ -1,0 +1,86 @@
+"""jax API-surface compatibility shims.
+
+jax promoted ``shard_map`` out of ``jax.experimental`` (and renamed its
+replication check ``check_rep`` -> ``check_vma``) around 0.6. This
+codebase is written against the current spelling — ``jax.shard_map``
+with ``check_vma=`` — at every call site; on older jax, :func:`install`
+backfills that surface once so models/check/tests code stays on one
+spelling instead of each module carrying its own try/except.
+
+``install()`` runs from the package root ``__init__``, so any
+``import ytk_mp4j_tpu...`` makes ``jax.shard_map`` usable.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map as shard_map      # noqa: F401  (jax >= 0.6)
+    _NEEDS_BACKFILL = False
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _experimental
+
+    def shard_map(f, /, **kwargs):
+        # check_vma maps onto the old check_rep; sites that leave it
+        # unset get check_rep=False, because old jax has no replication
+        # rule for pallas_call (and several collectives) — the check is
+        # a diagnostic, correct programs run identically without it
+        kwargs["check_rep"] = kwargs.pop("check_vma", False)
+        return _experimental(f, **kwargs)
+
+    _NEEDS_BACKFILL = True
+
+
+def install() -> None:
+    """Backfill the current-jax API surface this codebase is written
+    against on older jax. Attributes are only added when absent —
+    current jax is left untouched.
+
+    - ``jax.shard_map`` — the promoted experimental entry point;
+    - ``jax.typeof`` — aval lookup (old avals carry no ``.vma``, which
+      callers already treat as "no varying-axes info");
+    - ``jax.lax.axis_size`` — static axis size from the axis env;
+    - ``jax.lax.pcast`` — identity: VMA annotations don't exist before
+      0.6, so there is nothing to cast (replication checking on old jax
+      is shard_map's check_rep, handled by the shard_map shim).
+    """
+    from jax import core, lax
+
+    if _NEEDS_BACKFILL and not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    if not hasattr(jax, "typeof"):
+        jax.typeof = core.get_aval
+    if not hasattr(lax, "axis_size"):
+        def _axis_size(axis_name):
+            size = core.axis_frame(axis_name)
+            # axis_frame returned the frame object on some 0.4.x
+            # releases and the bare size on others
+            return getattr(size, "size", size)
+        lax.axis_size = _axis_size
+    if not hasattr(lax, "pcast"):
+        lax.pcast = lambda x, axis_name=None, *, to=None: x
+    _install_pallas()
+
+
+def _install_pallas() -> None:
+    """``pltpu.CompilerParams`` was named ``TPUCompilerParams`` (with a
+    smaller field set) before jax 0.6: alias it, dropping fields the old
+    dataclass doesn't know (``has_side_effects`` — outputs of the
+    kernels here are always consumed, so DCE cannot strike them)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:       # pallas unavailable on this platform
+        return
+    if hasattr(pltpu, "CompilerParams") \
+            or not hasattr(pltpu, "TPUCompilerParams"):
+        return
+    import inspect
+
+    fields = set(inspect.signature(pltpu.TPUCompilerParams).parameters)
+
+    def CompilerParams(**kwargs):               # noqa: N802
+        return pltpu.TPUCompilerParams(
+            **{k: v for k, v in kwargs.items() if k in fields})
+
+    pltpu.CompilerParams = CompilerParams
