@@ -18,7 +18,8 @@ def slave_main():
     s = ProcessCommSlave("127.0.0.1", master.port, timeout=30.0)
     s.info(f"slave {s.rank}/{s.slave_num} up")
 
-    # the reference's recursive-halving allreduce (default algo="rhd")
+    # size-aware allreduce (default algo="auto": tree / recursive
+    # halving / pipelined ring by payload size; README Transport tuning)
     arr = np.full(1000, float(s.rank + 1))
     s.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
     assert arr[0] == sum(range(1, N + 1))

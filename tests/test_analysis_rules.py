@@ -455,6 +455,78 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 8)]
+    assert ids == [f"R{i}" for i in range(1, 9)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
+
+
+# ----------------------------------------------------------------------
+# R8 — chunk schedule derived from rank-local state
+# ----------------------------------------------------------------------
+def test_r8_fires_on_rank_dependent_chunk_loop():
+    r = run_rule("R8", """
+        def exchange(self, arr):
+            for lo, hi in chunk_ranges(arr.size - self.rank, 8, CHUNK):
+                self._exchange_raw(1, 1, arr[lo:hi], None)
+    """)
+    [f] = r.findings
+    assert f.rule == "R8" and f.line == 3
+    assert "rank" in f.message or "job-wide" in f.message
+
+
+def test_r8_fires_on_rank_dependent_chunk_while():
+    r = run_rule("R8", """
+        def drain(self, vr):
+            sent = 0
+            while sent < self.n_chunks - vr:
+                sent += 1
+    """)
+    [f] = r.findings
+    assert f.rule == "R8" and f.line == 4
+
+
+def test_r8_clean_on_size_derived_chunk_loop():
+    # the engine's real shape: schedule from (size, dtype, env knob)
+    r = run_rule("R8", """
+        def exchange(self, arr, operand):
+            for lo, hi in tuning.chunk_ranges(arr.size,
+                                              operand.dtype.itemsize,
+                                              self._chunk_bytes):
+                self._exchange_raw(1, 1, arr[lo:hi], None)
+    """)
+    assert not r.findings
+
+
+def test_r8_clean_on_rank_indexed_segment_loop():
+    # using the rank to pick WHICH segment moves is the normal ring /
+    # halving shape; only the chunk-loop header is schedule-bearing
+    r = run_rule("R8", """
+        def ring(self, arr, segs):
+            for s in range(self.n - 1):
+                ss, se = segs[(self.rank - 1 - s) % self.n]
+                self._send_chunk(arr[ss:se])
+    """)
+    assert not r.findings
+
+
+def test_r8_scoped_to_comm_transport():
+    src = """
+        def exchange(self, arr):
+            for lo, hi in chunk_ranges(arr.size - self.rank, 8, CHUNK):
+                pass
+    """
+    assert not run_rule("R8", src,
+                        path="ytk_mp4j_tpu/models/snippet.py").findings
+    assert run_rule("R8", src,
+                    path="ytk_mp4j_tpu/transport/snippet.py").findings
+
+
+def test_r8_inline_suppression():
+    r = run_rule("R8", """
+        def exchange(self, arr):
+            # mp4j-lint: disable=R8 (trip count proven equal on peers)
+            for lo, hi in chunk_ranges(arr.size - self.rank, 8, CHUNK):
+                pass
+    """)
+    assert not r.findings
+    assert len(r.suppressed) == 1

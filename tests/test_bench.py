@@ -48,3 +48,12 @@ def test_bench_socket_collective_smoke():
 def test_bench_socket_map_smoke():
     rate = bench.bench_socket_map(procs=2, keys=50, reps=1)
     assert np.isfinite(rate) and rate > 0
+
+
+def test_bench_socket_allreduce_sweep_smoke():
+    sweep = bench.bench_socket_allreduce_sweep(procs=2, reps=1)
+    assert sweep, "sweep must report at least one size"
+    for row in sweep.values():
+        assert set(row) == {"tree", "rhd", "ring", "auto"}
+        for rate in row.values():
+            assert np.isfinite(rate) and rate > 0

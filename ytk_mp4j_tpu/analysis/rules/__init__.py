@@ -9,6 +9,7 @@
 | R5 | error    | bare/swallowed exceptions in comm hot paths |
 | R6 | warning  | leader returns an aliased slot (no _detach) |
 | R7 | error    | mutable defaults / mutated module-level state |
+| R8 | error    | chunk schedule derived from rank-local state |
 """
 
 from __future__ import annotations
@@ -26,6 +27,8 @@ from ytk_mp4j_tpu.analysis.rules.r5_swallowed_exceptions import (
 from ytk_mp4j_tpu.analysis.rules.r6_aliased_result import (
     R6AliasedLeaderResult)
 from ytk_mp4j_tpu.analysis.rules.r7_mutable_state import R7MutableState
+from ytk_mp4j_tpu.analysis.rules.r8_chunk_schedule import (
+    R8RankLocalChunkSchedule)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -35,6 +38,7 @@ ALL_RULES = [
     R5SwallowedException,
     R6AliasedLeaderResult,
     R7MutableState,
+    R8RankLocalChunkSchedule,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

@@ -8,18 +8,23 @@ with in-place buffer semantics. ``info()/error()`` forward to the
 master's console; ``barrier()``/``close(code)`` coordinate through the
 master (SURVEY.md section 3e).
 
-Algorithms: allreduce defaults to the reference's MPICH-style
-Rabenseifner path — reduce-scatter by RECURSIVE HALVING + allgather by
-RECURSIVE DOUBLING, with non-power-of-2 rank counts folded in by a
-pre/post step (the "Kryo-socket recursive-halving path" of
-BASELINE.json; SURVEY.md section 3b) — with ring reduce-scatter /
-ring allgather available via ``algo="ring"`` (same asymptotic
-bandwidth, uniform for any rank count). Broadcast/reduce are binomial
-trees; rooted gather/scatter are direct sends.
+Algorithms: allreduce/reduce_scatter/allgather default to
+``algo="auto"`` — size-aware selection (``utils.tuning``) between the
+binomial tree (latency-bound small payloads), the reference's
+MPICH-style Rabenseifner path — reduce-scatter by RECURSIVE HALVING +
+allgather by RECURSIVE DOUBLING, with non-power-of-2 rank counts folded
+in by a pre/post step (the "Kryo-socket recursive-halving path" of
+BASELINE.json; SURVEY.md section 3b) — and the pipelined ring
+(bandwidth-bound large payloads). Each step's transfer is split into
+``MP4J_CHUNK_BYTES`` chunks so the merge of chunk k overlaps the wire
+transfer of chunk k+1 (see ``_chunked_exchange``). Broadcast/reduce
+are binomial trees; rooted gather/scatter are direct sends.
 
 The per-round element-wise merge (the reference's CPU hot loop, SURVEY.md
 section 3b step 2) runs through the native C++ kernel
-(``utils.native.reduce_into``).
+(``utils.native.reduce_into``); receive scratch comes from a per-dtype
+buffer pool, and ``stats()`` reports per-collective wire/reduce/
+serialize phase counters (``utils.stats``).
 
 This path is also the semantic oracle the TPU path is differentially
 tested against, and the baseline the >=10x TPU bandwidth claim is
@@ -30,6 +35,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -40,8 +46,59 @@ from ytk_mp4j_tpu.comm.context import CommSlave
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.transport import channel as channel_mod
 from ytk_mp4j_tpu.transport.channel import Channel, connect
-from ytk_mp4j_tpu.utils import native, trace
+from ytk_mp4j_tpu.utils import native, trace, tuning
+from ytk_mp4j_tpu.utils.stats import CommStats
+
+
+class _ScratchPool:
+    """Per-dtype reusable scratch buffers for collective steps.
+
+    ``take(dtype, n)`` returns a length-``n`` view of a pooled (or
+    fresh) contiguous buffer; ``give(view)`` returns the underlying
+    buffer for reuse. Reuse matters on the hot path: a fresh
+    ``np.empty`` per round re-pays mmap + first-touch page faults for
+    every MB received, a full extra memory pass.
+
+    Discipline: take/give pairs are owned by the collective's calling
+    thread (no locking — a slave runs one collective at a time); give
+    only what was taken, after the last read of it. The free list is
+    capped, so a one-off giant collective cannot pin more than a few
+    peak-sized buffers per dtype.
+    """
+
+    _MAX_FREE = 4
+
+    def __init__(self):
+        self._free: dict[np.dtype, list[np.ndarray]] = {}
+
+    def take(self, dtype, n: int) -> np.ndarray:
+        dt = np.dtype(dtype)
+        free = self._free.get(dt)
+        if free:
+            best = None
+            for i, b in enumerate(free):
+                if b.size >= n and (best is None
+                                    or b.size < free[best].size):
+                    best = i
+            if best is not None:
+                return free.pop(best)[:n]
+        return np.empty(max(n, 1), dtype=dt)[:n]
+
+    def give(self, arr: np.ndarray) -> None:
+        base = arr.base if isinstance(arr.base, np.ndarray) else arr
+        free = self._free.setdefault(base.dtype, [])
+        if len(free) < self._MAX_FREE:
+            free.append(base)
+            return
+        # full list: keep the PEAK-sized buffers (evict the smallest
+        # for a larger incomer) — a handful of small early collectives
+        # must not permanently defeat pooling for the MB-scale rounds
+        # the pool exists for
+        smallest = min(range(len(free)), key=lambda i: free[i].size)
+        if free[smallest].size < base.size:
+            free[smallest] = base
 
 
 class ProcessCommSlave(CommSlave):
@@ -76,9 +133,20 @@ class ProcessCommSlave(CommSlave):
         self._peer_timeout = peer_timeout
         self._handshake_timeout = handshake_timeout
         self._native_transport = native_transport
-        # own listen socket on an ephemeral port
+        # job-wide transport tuning (env-validated here, before any
+        # connection exists, so a typo'd knob fails the job cleanly)
+        # and pipeline state — all of it must exist BEFORE the accept
+        # thread starts: an early peer dial-in races __init__
+        self._chunk_bytes = tuning.chunk_bytes()
+        self._algo_small, self._algo_large = tuning.algo_thresholds()
+        self._scratch = _ScratchPool()
+        self._comm_stats = CommStats()
+        # own listen socket on an ephemeral port. Buffer-size knobs
+        # apply BEFORE listen(): accepted peer sockets inherit them,
+        # and the TCP window scale is fixed at the handshake.
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        channel_mod.apply_socket_buf_sizes(self._server)
         self._server.bind((listen_host, 0))
         self._server.listen(64)
         self._listen_port = self._server.getsockname()[1]
@@ -157,6 +225,14 @@ class ProcessCommSlave(CommSlave):
         self._server.close()
         self._pool.shutdown(wait=False)
 
+    def stats(self) -> dict[str, dict[str, float]]:
+        """Per-collective transport counters: ``{collective: {calls,
+        bytes_sent, bytes_recv, chunks, wire_seconds, reduce_seconds,
+        serialize_seconds}}`` (schema: :mod:`ytk_mp4j_tpu.utils.stats`).
+        Always on; phase seconds are busy times and may overlap in wall
+        time (pipelining is the point)."""
+        return self._comm_stats.snapshot()
+
     # ------------------------------------------------------------------
     # peer transport
     # ------------------------------------------------------------------
@@ -189,6 +265,7 @@ class ProcessCommSlave(CommSlave):
                     ch.close()
                     continue
                 ch.set_timeout(self._peer_timeout)
+                ch.stats = self._comm_stats  # peer channels book wire time
                 self._peers[peer_rank] = ch
                 self._peer_cv.notify_all()
 
@@ -209,6 +286,7 @@ class ProcessCommSlave(CommSlave):
                 ch = connect(host, port, timeout=self._timeout)
                 ch.send_obj(self._rank)
                 ch.set_timeout(self._peer_timeout)
+                ch.stats = self._comm_stats  # peer channels book wire time
                 self._peers[peer] = ch
                 self._peer_cv.notify_all()
                 return ch
@@ -267,28 +345,135 @@ class ProcessCommSlave(CommSlave):
         sides = " ".join(
             ([f"send->{send_peer}"] if sarr is not None else [])
             + ([f"recv<-{recv_peer}"] if rarr is not None else []))
+        t0 = time.perf_counter()
         try:
             done = native.sendrecv_raw(
                 (send_ch or recv_ch).sock.fileno(),
                 (recv_ch or send_ch).sock.fileno(),
                 sarr, rarr, self._peer_timeout)
-            if done:
-                return
-            # pure-Python fallback: helper thread sends while we receive
-            fut = (self._pool.submit(send_ch.send_raw, sarr)
-                   if sarr is not None else None)
-            if rarr is not None:
-                recv_ch.recv_raw_into(rarr)
-            if fut is not None:
-                fut.result()
+            if not done:
+                # pure-Python fallback: helper thread sends while we
+                # receive
+                fut = (self._pool.submit(send_ch.send_raw, sarr)
+                       if sarr is not None else None)
+                if rarr is not None:
+                    recv_ch.recv_raw_into(rarr)
+                if fut is not None:
+                    fut.result()
         except Exception as e:
             # also catches the fallback's raw socket errors (BrokenPipe,
             # socket.timeout from the helper-thread send) so the "dead
             # peer becomes Mp4jError" contract holds on every path
             raise Mp4jError(f"raw exchange ({sides}) failed: {e}") from None
+        self._comm_stats.add_wire(
+            0 if sarr is None else sarr.nbytes,
+            0 if rarr is None else rarr.nbytes,
+            time.perf_counter() - t0, chunks=1)
 
     def _recv_buf(self, operand: Operand, n: int) -> np.ndarray:
-        return np.empty(n, dtype=operand.dtype)
+        """A pooled scratch buffer (give back via ``_give_buf`` after
+        the last read — see :class:`_ScratchPool`)."""
+        return self._scratch.take(operand.dtype, n)
+
+    def _give_buf(self, buf: np.ndarray) -> None:
+        self._scratch.give(buf)
+
+    # ------------------------------------------------------------------
+    # pipelined chunked engine
+    #
+    # Each per-step segment splits into MP4J_CHUNK_BYTES chunks:
+    # full-duplex exchange of chunk k, then merge of chunk k, repeated.
+    # The double buffer is the KERNEL socket buffer: while we merge
+    # chunk k, the peer's chunk k+1 is already streaming into our
+    # receive buffer (and our own chunk k+1 drains from the send
+    # buffer), so the wire transfer of k+1 overlaps the reduce of k
+    # without any thread handoff — and the merge runs on cache-hot
+    # bytes instead of re-reading the whole segment cold. Measured on
+    # the bench host at MB-scale segments: ~1.6x over the monolithic
+    # exchange; an explicit worker-thread double buffer was measured
+    # SLOWER there (per-chunk future/GIL handoff beats the overlap on
+    # a single core), hence the sequential loop.
+    #
+    # The chunk schedule is a pure function of the job-wide call
+    # parameters (segment size, dtype, MP4J_CHUNK_BYTES) — never of
+    # rank-local state (mp4j-lint R8) — so ranks always agree on it;
+    # chunks merge in ascending offset order, which preserves the
+    # unchunked per-element merge order bit-for-bit.
+    # ------------------------------------------------------------------
+    def _chunked_exchange(self, send_peer: int, recv_peer: int,
+                          sarr: np.ndarray | None,
+                          rarr: np.ndarray | None, on_chunk=None) -> None:
+        """Raw full-duplex exchange in pipeline chunks; ``on_chunk(lo,
+        hi)`` runs after ``rarr[lo:hi]`` has arrived, while the next
+        chunk is in flight in the kernel buffers."""
+        itemsize = (rarr if rarr is not None else sarr).dtype.itemsize
+        n_send = 0 if sarr is None else sarr.size
+        n_recv = 0 if rarr is None else rarr.size
+        sch = tuning.chunk_ranges(n_send, itemsize, self._chunk_bytes)
+        rch = tuning.chunk_ranges(n_recv, itemsize, self._chunk_bytes)
+        steps = max(len(sch), len(rch))
+        if steps <= 1:
+            self._exchange_raw(send_peer, recv_peer, sarr, rarr)
+            if on_chunk is not None and n_recv:
+                on_chunk(0, n_recv)
+            return
+        if sarr is not None:
+            sarr = np.ascontiguousarray(sarr)
+        for k in range(steps):
+            sc = sarr[sch[k][0]:sch[k][1]] if k < len(sch) else None
+            rc = rarr[rch[k][0]:rch[k][1]] if k < len(rch) else None
+            self._exchange_raw(send_peer, recv_peer, sc, rc)
+            if rc is not None and on_chunk is not None:
+                on_chunk(*rch[k])
+
+    def _reduce_into(self, operator: Operator, acc: np.ndarray,
+                     src: np.ndarray) -> None:
+        """``acc = op(acc, src)`` via the native kernel, booking
+        reduce-phase time."""
+        t0 = time.perf_counter()
+        native.reduce_into(operator, acc, src)
+        self._comm_stats.add("reduce_seconds", time.perf_counter() - t0)
+
+    def _recv_reduce(self, peer: int, acc: np.ndarray, operator: Operator,
+                     operand: Operand) -> None:
+        """Receive a segment the size of ``acc`` and merge it in,
+        chunk-by-chunk (merge of chunk k overlaps the wire transfer of
+        chunk k+1); raw or framed per the job-wide wire decision."""
+        rbuf = self._recv_buf(operand, acc.size)
+        try:
+            def merge(lo, hi):
+                self._reduce_into(operator, acc[lo:hi], rbuf[lo:hi])
+
+            if self._raw_ok(operand):
+                self._chunked_exchange(peer, peer, None, rbuf,
+                                       on_chunk=merge)
+            else:
+                self._channel(peer).recv_array_into(rbuf, on_chunk=merge)
+        finally:
+            self._give_buf(rbuf)
+
+    def _exchange_reduce(self, peer: int, send_view: np.ndarray,
+                         acc: np.ndarray, operator: Operator,
+                         operand: Operand) -> None:
+        """Full-duplex partner exchange: ship ``send_view`` while
+        receiving ``acc.size`` elements, merging arrivals into ``acc``
+        chunk-by-chunk (the halving-round hot path)."""
+        rbuf = self._recv_buf(operand, acc.size)
+        try:
+            def merge(lo, hi):
+                self._reduce_into(operator, acc[lo:hi], rbuf[lo:hi])
+
+            if self._raw_ok(operand):
+                self._chunked_exchange(peer, peer, send_view, rbuf,
+                                       on_chunk=merge)
+            else:
+                fut = self._pool.submit(
+                    self._send, peer, np.ascontiguousarray(send_view),
+                    operand.compress)
+                self._channel(peer).recv_array_into(rbuf, on_chunk=merge)
+                fut.result()
+        finally:
+            self._give_buf(rbuf)
 
     def _send_segment(self, peer: int, chunk, operand: Operand) -> None:
         """One-directional segment send for the tree/rooted collectives:
@@ -300,22 +485,11 @@ class ProcessCommSlave(CommSlave):
                        if isinstance(chunk, np.ndarray) else chunk,
                        compress=operand.compress)
 
-    def _recv_segment(self, peer: int, n: int, operand: Operand):
-        """Counterpart of :meth:`_send_segment`: returns the received
-        ``n``-element array (raw path) or framed payload. For receives
-        whose destination view already exists, prefer
-        :meth:`_recv_segment_into` (no temp buffer)."""
-        if self._raw_ok(operand):
-            buf = self._recv_buf(operand, n)
-            self._exchange_raw(peer, peer, None, buf)
-            return buf
-        return self._recv(peer)
-
     def _recv_segment_into(self, peer: int, arr, s: int, e: int,
                            operand: Operand) -> None:
         """Receive a segment directly into ``arr[s:e]`` — in place on
-        the raw path (no temp buffer/copy); framed and list containers
-        assign through the container.
+        the raw path AND the framed ndarray path (no temp buffer or
+        copy); list containers assign through the container.
 
         The raw/framed decision must mirror :meth:`_send_segment`
         exactly — both are pure functions of ``_raw_ok(operand)`` — or
@@ -327,21 +501,34 @@ class ProcessCommSlave(CommSlave):
             assert isinstance(arr, np.ndarray), \
                 "numeric operand implies ndarray container (check_array)"
             self._exchange_raw_into(peer, peer, None, arr[s:e], operand)
+        elif operand.is_numeric and isinstance(arr, np.ndarray):
+            # framed numeric: stream the array frame straight into the
+            # destination view (decompressing chunk-wise if compressed)
+            view = arr[s:e]
+            if view.flags.c_contiguous and view.flags.writeable:
+                self._channel(peer).recv_array_into(view)
+            else:
+                arr[s:e] = self._recv(peer)
         else:
             arr[s:e] = self._recv(peer)
 
     def _exchange_raw_into(self, send_peer: int, recv_peer: int,
                            sarr: np.ndarray | None, rview: np.ndarray,
                            operand: Operand) -> np.ndarray:
-        """Raw exchange receiving into ``rview`` (via a temp when the
-        view is not directly receivable — contiguity is a LOCAL detail
-        and must not influence the shared raw/framed decision)."""
+        """Raw exchange receiving into ``rview`` (via a pooled temp when
+        the view is not directly receivable — contiguity is a LOCAL
+        detail and must not influence the shared raw/framed decision)."""
         direct = rview.flags.c_contiguous and rview.flags.writeable
-        rbuf = rview if direct else self._recv_buf(operand, rview.size)
-        self._exchange_raw(send_peer, recv_peer, sarr, rbuf)
-        if not direct:
+        if direct:
+            self._exchange_raw(send_peer, recv_peer, sarr, rview)
+            return rview
+        rbuf = self._recv_buf(operand, rview.size)
+        try:
+            self._exchange_raw(send_peer, recv_peer, sarr, rbuf)
             rview[:] = rbuf
-        return rbuf
+        finally:
+            self._give_buf(rbuf)
+        return rview
 
     # ------------------------------------------------------------------
     # dense-array helpers
@@ -372,27 +559,41 @@ class ProcessCommSlave(CommSlave):
     def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
                         operator: Operator = Operators.SUM,
                         from_: int = 0, to: int | None = None,
-                        algo: str = "rhd"):
+                        algo: str = "auto"):
         """Allreduce over ``arr[from_:to]``, in place on every rank.
 
-        ``algo="rhd"`` (default, the reference's path): reduce-scatter by
+        ``algo="auto"`` (default) picks by payload size — a pure
+        function of the job-wide call parameters (bytes, rank count,
+        ``MP4J_ALGO_*_BYTES`` thresholds), so every rank derives the
+        same schedule: binomial ``"tree"`` (reduce+broadcast) for
+        latency-bound small payloads, ``"rhd"`` for the middle,
+        pipelined ``"ring"`` for bandwidth-bound large payloads.
+
+        ``algo="rhd"`` (the reference's path): reduce-scatter by
         recursive halving + allgather by recursive doubling over the
         largest power-of-2 rank group, extra ranks folded in by a
         pre/post exchange. ``algo="ring"``: ring reduce-scatter + ring
-        allgather.
+        allgather. Both pipeline each step in ``MP4J_CHUNK_BYTES``
+        chunks (merge of chunk k overlaps the wire transfer of k+1).
 
         Non-numeric (STRING/OBJECT list) operands take the rank-ordered
-        binomial tree instead: halving/ring merge order varies per chunk,
-        which is only equivalent for commutative operators; list
-        reductions (e.g. concatenation) deserve deterministic rank order
-        and are latency- not bandwidth-bound anyway.
+        binomial tree always: halving/ring merge order varies per
+        segment, which is only equivalent for commutative operators;
+        list reductions (e.g. concatenation) deserve deterministic rank
+        order and are latency- not bandwidth-bound anyway.
         """
-        if algo not in ("rhd", "ring"):
+        if algo not in ("auto", "rhd", "ring", "tree"):
             raise Mp4jError(f"unknown allreduce algo {algo!r}")
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
             return arr
         if not operand.is_numeric:
+            algo = "tree"
+        elif algo == "auto":
+            algo = tuning.select_allreduce_algo(
+                (hi - lo) * operand.dtype.itemsize, self._n,
+                self._algo_small, self._algo_large)
+        if algo == "tree":
             self.reduce_array(arr, operand, operator, root=0,
                               from_=from_, to=to)
             return self.broadcast_array(arr, operand, root=0,
@@ -436,15 +637,10 @@ class ProcessCommSlave(CommSlave):
             else:
                 self._send(r - p, np.ascontiguousarray(arr[lo:hi]),
                            compress=operand.compress)
-                arr[lo:hi] = self._recv(r - p)
+                self._recv_segment_into(r - p, arr, lo, hi, operand)
             return arr
         if r < extra:  # fold partner: merge the extra rank's data
-            if raw:
-                recv = self._recv_buf(operand, hi - lo)
-                self._exchange_raw(r + p, r + p, None, recv)
-            else:
-                recv = self._recv(r + p)
-            native.reduce_into(operator, arr[lo:hi], np.asarray(recv))
+            self._recv_reduce(r + p, arr[lo:hi], operator, operand)
 
         vr = r
         segs = meta.partition_range(lo, hi, p)
@@ -452,7 +648,7 @@ class ProcessCommSlave(CommSlave):
         def span(a, b):  # byte range of segment window [a, b)
             return segs[a][0], segs[b - 1][1]
 
-        # reduce-scatter: recursive halving
+        # reduce-scatter: recursive halving (pipelined chunked merge)
         dist = p >> 1
         while dist >= 1:
             partner = vr ^ dist
@@ -465,17 +661,12 @@ class ProcessCommSlave(CommSlave):
                 give = (block0 + dist, block0 + 2 * dist)
             gs, ge = span(*give)
             ks, ke = span(*keep)
-            if raw:
-                recv = self._recv_buf(operand, ke - ks)
-                self._exchange_raw(partner, partner, arr[gs:ge], recv)
-            else:
-                recv = self._sendrecv(partner, partner,
-                                      np.ascontiguousarray(arr[gs:ge]),
-                                      compress=operand.compress)
-            native.reduce_into(operator, arr[ks:ke], np.asarray(recv))
+            self._exchange_reduce(partner, arr[gs:ge], arr[ks:ke],
+                                  operator, operand)
             dist >>= 1
 
-        # allgather: recursive doubling
+        # allgather: recursive doubling (no merge to overlap; the raw
+        # exchange is already full-duplex and lands in place)
         dist = 1
         while dist < p:
             partner = vr ^ dist
@@ -487,10 +678,11 @@ class ProcessCommSlave(CommSlave):
                 self._exchange_raw_into(partner, partner, arr[ms:me],
                                         arr[ts:te], operand)
             else:
-                recv = self._sendrecv(partner, partner,
-                                      np.ascontiguousarray(arr[ms:me]),
-                                      compress=operand.compress)
-                arr[ts:te] = recv
+                fut = self._pool.submit(
+                    self._send, partner, np.ascontiguousarray(arr[ms:me]),
+                    operand.compress)
+                self._recv_segment_into(partner, arr, ts, te, operand)
+                fut.result()
             dist *= 2
 
         if r < extra:  # unfold: ship the finished range back
@@ -501,20 +693,48 @@ class ProcessCommSlave(CommSlave):
                            compress=operand.compress)
         return arr
 
+    @staticmethod
+    def _ranges_span(ranges):
+        """(lo, hi, contiguous): whether the per-rank ranges tile
+        ``[lo, hi)`` without gaps — a pure function of the call's
+        ``ranges`` argument, so every rank answers identically."""
+        lo, hi = ranges[0][0], ranges[-1][1]
+        contiguous = all(ranges[i][1] == ranges[i + 1][0]
+                         for i in range(len(ranges) - 1))
+        return lo, hi, contiguous
+
     def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,
-                             operator: Operator = Operators.SUM, ranges=None):
-        """Rank r ends with segment ``ranges[r]`` of the reduction."""
+                             operator: Operator = Operators.SUM,
+                             ranges=None, algo: str = "auto"):
+        """Rank r ends with segment ``ranges[r]`` of the reduction.
+
+        ``algo="auto"`` (default): rank-ordered binomial tree
+        (reduce + scatter) below the latency threshold, pipelined ring
+        otherwise — the same job-wide size rule as allreduce. ``"ring"``
+        / ``"tree"`` force a path; non-numeric operands always take the
+        tree (deterministic rank order, see allreduce_array)."""
+        if algo not in ("auto", "ring", "tree"):
+            raise Mp4jError(f"unknown reduce_scatter algo {algo!r}")
         arr, lo, hi = self._norm_range(arr, operand, 0, None)
         if ranges is None:
             ranges = meta.partition_range(0, len(arr), self._n)
         if self._n == 1:
             return arr
         if not operand.is_numeric:
-            # rank-ordered tree + scatter (see allreduce_array). Rank 0's
-            # buffer is the tree root, so its positions OUTSIDE its owned
-            # range must be restored afterwards — every backend promises
-            # "other positions unchanged".
-            orig = list(arr) if self._rank == 0 else None
+            algo = "tree"
+        elif algo == "auto":
+            algo = tuning.select_partitioned_algo(
+                len(arr) * operand.dtype.itemsize, self._n,
+                self._algo_small, self._algo_large)
+        if algo == "tree":
+            # rank-ordered tree + scatter (see allreduce_array). Rank
+            # 0's buffer is the tree root, so its positions OUTSIDE its
+            # owned range must be restored afterwards — every backend
+            # promises "other positions unchanged".
+            orig = None
+            if self._rank == 0:
+                orig = (arr.copy() if isinstance(arr, np.ndarray)
+                        else list(arr))
             self.reduce_array(arr, operand, operator, root=0)
             self.scatter_array(arr, operand, root=0, ranges=ranges)
             if self._rank == 0:
@@ -526,70 +746,117 @@ class ProcessCommSlave(CommSlave):
         return arr
 
     def allgather_array(self, arr, operand: Operand = Operands.FLOAT,
-                        ranges=None):
-        """Each rank owns ``arr[ranges[rank]]``; all segments everywhere."""
+                        ranges=None, algo: str = "auto"):
+        """Each rank owns ``arr[ranges[rank]]``; all segments everywhere.
+
+        ``algo="auto"`` (default): rooted binomial tree
+        (gather + broadcast) below the latency threshold when the
+        ranges tile a contiguous span, pipelined ring otherwise.
+        ``"tree"`` requires contiguous ranges (the broadcast covers the
+        tiled span exactly); ``"ring"`` accepts any ranges."""
+        if algo not in ("auto", "ring", "tree"):
+            raise Mp4jError(f"unknown allgather algo {algo!r}")
         arr, _, _ = self._norm_range(arr, operand, 0, None)
         if ranges is None:
             ranges = meta.partition_range(0, len(arr), self._n)
         if self._n == 1:
             return arr
+        lo, hi, contiguous = self._ranges_span(ranges)
+        if algo == "auto":
+            if not contiguous or not operand.is_numeric:
+                algo = "ring"
+            else:
+                algo = tuning.select_partitioned_algo(
+                    (hi - lo) * operand.dtype.itemsize, self._n,
+                    self._algo_small, self._algo_large)
+        if algo == "tree":
+            if not contiguous:
+                raise Mp4jError(
+                    "allgather algo='tree' needs contiguous ranges")
+            self.gather_array(arr, operand, root=0, ranges=ranges)
+            return self.broadcast_array(arr, operand, root=0,
+                                        from_=lo, to=hi)
         self._ring_allgather(arr, ranges, operand)
         return arr
 
     def _ring_reduce_scatter(self, arr, segs, operand, operator):
         """After n-1 ring steps, rank r holds segment r fully reduced.
 
-        Step s: send chunk (r-1-s) mod n (the chunk merged last step),
-        receive chunk (r-2-s) mod n from the left, merge with the local
-        contribution (native hot loop).
-        """
+        Step s: send segment (r-1-s) mod n (the one merged last step),
+        receive segment (r-2-s) mod n from the left, merge with the
+        local contribution — pipelined: the merge of chunk k runs while
+        chunk k+1 is on the wire. Receive buffers rotate through the
+        scratch pool (the carry stays live as next step's send source,
+        so two pooled buffers alternate)."""
         n, r = self._n, self._rank
-        raw = self._raw_ok(operand) and isinstance(arr, np.ndarray)
+        numeric = isinstance(arr, np.ndarray)
+        raw = self._raw_ok(operand) and numeric
         right, left = (r + 1) % n, (r - 1) % n
-        carry = None  # accumulated chunk in flight
+        carry = None       # accumulated segment in flight
+        carry_buf = None   # pooled buffer backing the carry
         for s in range(n - 1):
             send_idx = (r - 1 - s) % n
             ss, se = segs[send_idx]
             out = carry if carry is not None else arr[ss:se]
             ri_s, ri_e = segs[(r - 2 - s) % n]
-            if raw:
-                recv = self._recv_buf(operand, ri_e - ri_s)
-                self._exchange_raw(right, left, out, recv)
-            else:
-                recv = self._sendrecv(right, left, np.ascontiguousarray(out)
-                                      if isinstance(out, np.ndarray) else out,
-                                      compress=operand.compress)
             local = arr[ri_s:ri_e]
-            if isinstance(local, np.ndarray):
-                if not raw:
-                    recv = np.asarray(recv).copy()
-                native.reduce_into(operator, recv, local)
-                carry = recv
+            if numeric:
+                rbuf = self._recv_buf(operand, ri_e - ri_s)
+
+                def merge(a, b, rbuf=rbuf, local=local):
+                    self._reduce_into(operator, rbuf[a:b], local[a:b])
+
+                if raw:
+                    self._chunked_exchange(right, left, out, rbuf,
+                                           on_chunk=merge)
+                else:
+                    fut = self._pool.submit(
+                        self._send, right, np.ascontiguousarray(out),
+                        operand.compress)
+                    self._channel(left).recv_array_into(rbuf,
+                                                        on_chunk=merge)
+                    fut.result()
+                # the previous carry finished its last duty (this
+                # step's send) — recycle its buffer
+                if carry_buf is not None:
+                    self._give_buf(carry_buf)
+                carry = carry_buf = rbuf
             else:
-                carry = [operator.np_fn(a, b) for a, b in zip(recv, local)]
+                recv = self._sendrecv(right, left, out,
+                                      compress=operand.compress)
+                carry = [operator.np_fn(a, b)
+                         for a, b in zip(recv, local)]
         # carry is now my fully-reduced segment (index r)
         ms, me = segs[r]
         arr[ms:me] = carry
+        if carry_buf is not None:
+            self._give_buf(carry_buf)
         return arr
 
     def _ring_allgather(self, arr, segs, operand: Operand):
-        """After n-1 ring steps every rank holds all segments."""
+        """After n-1 ring steps every rank holds all segments (no merge
+        to overlap; raw exchanges are full-duplex and land in place,
+        framed receives stream straight into the destination view)."""
         n, r = self._n, self._rank
-        raw = self._raw_ok(operand) and isinstance(arr, np.ndarray)
+        numeric = isinstance(arr, np.ndarray)
+        raw = self._raw_ok(operand) and numeric
         right, left = (r + 1) % n, (r - 1) % n
         for s in range(n - 1):
             ss, se = segs[(r - s) % n]
-            chunk = arr[ss:se]
+            seg = arr[ss:se]
             rs, re = segs[(r - 1 - s) % n]
             if raw:
-                self._exchange_raw_into(right, left, chunk, arr[rs:re],
+                self._exchange_raw_into(right, left, seg, arr[rs:re],
                                         operand)
+            elif numeric and operand.is_numeric:
+                fut = self._pool.submit(
+                    self._send, right, np.ascontiguousarray(seg),
+                    operand.compress)
+                self._recv_segment_into(left, arr, rs, re, operand)
+                fut.result()
             else:
-                recv = self._sendrecv(
-                    right, left,
-                    np.ascontiguousarray(chunk)
-                    if isinstance(chunk, np.ndarray) else chunk,
-                    compress=operand.compress)
+                recv = self._sendrecv(right, left, seg,
+                                      compress=operand.compress)
                 arr[rs:re] = recv
         return arr
 
@@ -603,7 +870,8 @@ class ProcessCommSlave(CommSlave):
             return arr
         vr = (self._rank - root) % self._n
         acc = arr[lo:hi]
-        if isinstance(acc, np.ndarray):
+        numeric = isinstance(acc, np.ndarray)
+        if numeric:
             acc = acc.copy()
         else:
             acc = list(acc)
@@ -617,8 +885,12 @@ class ProcessCommSlave(CommSlave):
                 src_vr = vr + mask
                 if src_vr < self._n:
                     peer = (src_vr + root) % self._n
-                    recv = self._recv_segment(peer, hi - lo, operand)
-                    acc = self._merge(operator, operand, acc, recv)
+                    if numeric:
+                        # pipelined: merge chunk k while k+1 arrives
+                        self._recv_reduce(peer, acc, operator, operand)
+                    else:
+                        recv = self._recv(peer)
+                        acc = self._merge(operator, operand, acc, recv)
             mask <<= 1
         if self._rank == root:
             arr[lo:hi] = acc

@@ -24,6 +24,7 @@ equivalent is ``TpuCommCluster(mesh=make_hier_mesh(inter, intra))``.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -34,6 +35,7 @@ from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.utils import native, trace
+from ytk_mp4j_tpu.utils.stats import CommStats, merge_snapshots
 
 
 class _ThreadGroup:
@@ -42,6 +44,9 @@ class _ThreadGroup:
     def __init__(self, thread_num: int, proc: ProcessCommSlave | None):
         self.thread_num = thread_num
         self.proc = proc
+        # intra-process counters (shared-memory merges); the process
+        # slave keeps its own wire counters — stats() sums both
+        self.comm_stats = CommStats()
         self.barrier = threading.Barrier(thread_num)
         self.slots: list = [None] * thread_num
         self.result = None
@@ -68,6 +73,9 @@ class ThreadCommSlave(CommSlave):
     def __init__(self, group: _ThreadGroup, thread_rank: int):
         self._g = group
         self._tr = thread_rank
+        # trace.traced scopes this around every collective call so
+        # intra-process merge time attributes to the right collective
+        self._comm_stats = group.comm_stats
 
     # ------------------------------------------------------------------
     @classmethod
@@ -140,6 +148,15 @@ class ThreadCommSlave(CommSlave):
                 self._g.proc.error(f"[t{self._tr}] {msg}")
         else:
             super().error(msg)
+
+    def stats(self) -> dict[str, dict[str, float]]:
+        """Per-collective transport counters: the group's intra-process
+        merge counters summed with the shared process slave's wire
+        counters (schema: :mod:`ytk_mp4j_tpu.utils.stats`)."""
+        snaps = [self._g.comm_stats.snapshot()]
+        if self._g.proc is not None:
+            snaps.append(self._g.proc.stats())
+        return merge_snapshots(*snaps)
 
     def close(self, code: int = 0) -> None:
         """Close the process-level connection (idempotent; safe to call
@@ -236,10 +253,12 @@ class ThreadCommSlave(CommSlave):
         return [(ranges[p * T][0], ranges[p * T + T - 1][1])
                 for p in range(self._g.proc_num)]
 
-    @staticmethod
-    def _merge_into(operator, acc, src):
+    def _merge_into(self, operator, acc, src):
         if isinstance(acc, np.ndarray):
+            t0 = time.perf_counter()
             native.reduce_into(operator, acc, src)
+            self._g.comm_stats.add("reduce_seconds",
+                                   time.perf_counter() - t0)
         else:
             for i in range(len(acc)):
                 acc[i] = operator.np_fn(acc[i], src[i])
@@ -264,11 +283,12 @@ class ThreadCommSlave(CommSlave):
     def allreduce_array(self, arr, operand: Operand = Operands.FLOAT,
                         operator: Operator = Operators.SUM,
                         from_: int = 0, to: int | None = None,
-                        algo: str = "rhd"):
+                        algo: str = "auto"):
         """Intra-process tree into thread 0, process allreduce, fan out.
 
-        ``algo`` selects the process-level algorithm (recursive
-        halving/doubling or ring), as on ProcessCommSlave."""
+        ``algo`` selects the process-level algorithm, as on
+        ProcessCommSlave: ``"auto"`` (default, size-aware selection),
+        ``"tree"``, ``"rhd"``, or ``"ring"``."""
         hi = to if to is not None else len(arr)
         lo = from_
 
@@ -343,7 +363,9 @@ class ThreadCommSlave(CommSlave):
         return self._fan_in_out(deposit, leader, collect)
 
     def allgather_array(self, arr, operand: Operand = Operands.FLOAT,
-                        ranges=None):
+                        ranges=None, algo: str = "auto"):
+        """``algo`` selects the process-level schedule ("auto"/"ring"/
+        "tree"), as on ProcessCommSlave."""
         N = self.slave_num
         if ranges is None:
             ranges = meta.partition_range(0, len(arr), N)
@@ -363,7 +385,8 @@ class ThreadCommSlave(CommSlave):
                 full[s:e] = seg
             if self._g.proc is not None:
                 self._g.proc.allgather_array(
-                    full, operand, ranges=self._coarse_ranges(ranges))
+                    full, operand, ranges=self._coarse_ranges(ranges),
+                    algo=algo)
             return full
 
         def collect(result):
@@ -436,7 +459,9 @@ class ThreadCommSlave(CommSlave):
 
     def reduce_scatter_array(self, arr, operand: Operand = Operands.FLOAT,
                              operator: Operator = Operators.SUM,
-                             ranges=None):
+                             ranges=None, algo: str = "auto"):
+        """``algo`` selects the process-level schedule ("auto"/"ring"/
+        "tree"), as on ProcessCommSlave."""
         N = self.slave_num
         if ranges is None:
             ranges = meta.partition_range(0, len(arr), N)
@@ -449,7 +474,7 @@ class ThreadCommSlave(CommSlave):
             if self._g.proc is not None:
                 self._g.proc.reduce_scatter_array(
                     acc, operand, operator,
-                    ranges=self._coarse_ranges(ranges))
+                    ranges=self._coarse_ranges(ranges), algo=algo)
             # mp4j-lint: disable=R6 (slot 0 detached by _tree_reduce_slots)
             return acc
 

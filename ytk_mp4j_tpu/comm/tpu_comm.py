@@ -174,21 +174,28 @@ class TpuCommCluster:
         return jax.device_put(stacked, self._row_sharding)
 
     # -- algorithm selection (reference parity: ProcessCommSlave's
-    # algo="rhd"/"ring"). "xla": one fused XLA collective (default —
-    # the compiler schedules ICI DMA). "ring": hand-scheduled ppermute
-    # ring (ops.ring). "rdma": the Pallas RDMA ring kernel
-    # (ops.ring_kernel) — the explicit-transport path; interpreted on
-    # non-TPU meshes, compiled (barrier + credit backpressure) on TPU.
-    _ALGOS = ("xla", "ring", "rdma")
+    # algo="auto"/"tree"/"rhd"/"ring"). "xla": one fused XLA collective
+    # (default — the compiler schedules ICI DMA). "ring":
+    # hand-scheduled ppermute ring (ops.ring). "rdma": the Pallas RDMA
+    # ring kernel (ops.ring_kernel) — the explicit-transport path;
+    # interpreted on non-TPU meshes, compiled (barrier + credit
+    # backpressure) on TPU. "auto" — the host backends' size-aware
+    # default — is accepted for dispatch consistency and resolves to
+    # "xla": on device the compiler already schedules per topology, so
+    # the fused collective IS the auto choice.
+    _ALGOS = ("auto", "xla", "ring", "rdma")
 
-    def _check_algo(self, algo: str):
+    def _check_algo(self, algo: str) -> str:
         if algo not in self._ALGOS:
             raise Mp4jError(f"algo must be one of {self._ALGOS}, "
                             f"got {algo!r}")
+        if algo == "auto":
+            return "xla"
         if algo != "xla" and isinstance(self.axis_name, tuple):
             raise Mp4jError(
                 f"algo={algo!r} rings over a single ICI axis; "
                 "hierarchical meshes use the default 'xla' path")
+        return algo
 
     def _interpret_kernels(self) -> bool:
         """Pallas kernels compile only on TPU meshes; interpret them on
@@ -225,7 +232,7 @@ class TpuCommCluster:
         collective (default), the ppermute ring, or the Pallas RDMA
         ring kernel — all wire-identical in results."""
         self._check_operand(operand)
-        self._check_algo(algo)
+        algo = self._check_algo(algo)
         arrs, lo, hi = self._norm_arrays(arrs, operand, from_, to)
         if hi == lo:
             return arrs
@@ -366,7 +373,7 @@ class TpuCommCluster:
         block, all_gather on device, return the [n, B] result."""
         if arrs[0].ndim != 1:
             raise Mp4jError("segment collectives require 1-D arrays")
-        self._check_algo(algo)
+        algo = self._check_algo(algo)
         ranges = self._norm_ranges(arrs, ranges)
         B = self._max_block(ranges)
         if algo == "rdma":
@@ -457,7 +464,7 @@ class TpuCommCluster:
         ``ranges[r]`` of the element-wise reduction (other positions
         unchanged). ``algo`` selects the schedule (see ``_ALGOS``)."""
         self._check_operand(operand)
-        self._check_algo(algo)
+        algo = self._check_algo(algo)
         arrs, _, _ = self._norm_arrays(arrs, operand, 0, None)
         if arrs[0].ndim != 1:
             raise Mp4jError("segment collectives require 1-D arrays")

@@ -12,9 +12,21 @@ Kryo for objects and raw ``DataOutputStream`` writes for primitive arrays
 - either kind may be zlib-compressed on the wire (``compress=True`` on
   send; the receiver auto-detects by frame tag). Compression is
   per-operand (``Operands.compressed(...)``): a bandwidth/CPU trade the
-  caller makes for highly-compressible payloads.
+  caller makes for highly-compressible payloads. Compressed ARRAYS
+  stream in ``MP4J_CHUNK_BYTES`` pieces (``TAG_ARRAY_ZC``) so the
+  sender's zlib work on chunk k+1 overlaps the wire transfer of chunk
+  k, and the receiver decompresses chunk k while k+1 is in flight.
 
-Frame layout: ``u8 tag | u64 payload_len | payload``.
+Frame layout: ``u8 tag | u64 payload_len | payload``. For
+``TAG_ARRAY_ZC`` the declared payload covers only the dtype/shape
+header; a self-delimiting chunk stream follows (``u32 clen | cbytes``
+repeated, terminated by ``u32 0``) so compressed sizes never need to be
+known up front.
+
+Env knobs applied at channel setup (see :mod:`ytk_mp4j_tpu.utils.tuning`
+— JOB-wide settings, every rank must agree): ``MP4J_SO_SNDBUF`` /
+``MP4J_SO_RCVBUF`` size the kernel socket buffers (unset keeps kernel
+defaults); ``MP4J_CHUNK_BYTES`` sizes the streaming-compression chunks.
 """
 
 from __future__ import annotations
@@ -22,20 +34,24 @@ from __future__ import annotations
 import pickle
 import socket
 import struct
+import time
 import zlib
 
 import numpy as np
 
+from ytk_mp4j_tpu.utils import tuning
 from ytk_mp4j_tpu.exceptions import Mp4jError
 
 TAG_OBJ = 0
 TAG_ARRAY = 1
 TAG_OBJ_Z = 2      # zlib-compressed pickle
 TAG_ARRAY_Z = 3    # header pickle | zlib-compressed raw buffer
+TAG_ARRAY_ZC = 4   # header pickle | streamed compressed chunks
 
 _ZLEVEL = 1  # fast; the trade is wire bytes vs CPU, not ratio records
 
 _HDR = struct.Struct("<BQ")
+_U32 = struct.Struct("<I")
 
 
 def _dtype_token(dt: np.dtype) -> str:
@@ -54,27 +70,67 @@ def _raw_view(arr: np.ndarray):
         return arr.view(np.uint8)
 
 
+def apply_socket_buf_sizes(sock: socket.socket) -> None:
+    """Apply ``MP4J_SO_SNDBUF`` / ``MP4J_SO_RCVBUF`` (validated; unset
+    keeps the kernel's autotuned defaults). Must run BEFORE
+    ``connect()`` on dialing sockets and before ``listen()`` on server
+    sockets (accepted sockets inherit): TCP fixes the window-scale
+    factor at the SYN/SYN-ACK from the buffer size at that moment, so
+    a post-handshake resize cannot widen the advertised window."""
+    for env, opt in (("MP4J_SO_SNDBUF", socket.SO_SNDBUF),
+                     ("MP4J_SO_RCVBUF", socket.SO_RCVBUF)):
+        size = tuning.env_bytes(env, 0, minimum=0)
+        if size > 0:
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, opt, size)
+            except OSError as e:
+                raise Mp4jError(f"{env}={size} rejected by the "
+                                f"kernel: {e}") from None
+
+
 class Channel:
-    """A framed, blocking, bidirectional message channel over a socket."""
+    """A framed, blocking, bidirectional message channel over a socket.
+
+    ``stats`` (optional, set by the owning slave on peer channels) is a
+    :class:`ytk_mp4j_tpu.utils.stats.CommStats`; when present the
+    channel books wire seconds/bytes and serialize (pickle/zlib)
+    seconds into the current collective's bucket.
+    """
+
+    # class-level defaults so partially-constructed channels (tests
+    # build bare instances around socket stand-ins) still frame
+    stats = None
+    _chunk_bytes = tuning.DEFAULT_CHUNK_BYTES
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
+        self.stats = None
+        self._chunk_bytes = tuning.chunk_bytes()
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass  # non-TCP transport (e.g. a UNIX socketpair)
+        # also applied here for non-TCP/odd transports; for TCP the
+        # load-bearing application happens BEFORE connect()/listen()
+        # (see apply_socket_buf_sizes) — the window scale is fixed at
+        # the handshake, so a post-connect resize cannot widen it
+        apply_socket_buf_sizes(sock)
 
     # -- low level ------------------------------------------------------
     def _send_all(self, *bufs: bytes | memoryview):
         # a socket timeout (set_timeout) applies to sends too: a peer
         # that stops draining must surface as Mp4jError like a dead
         # receiver does, not as a raw socket.timeout
+        t0 = time.perf_counter() if self.stats is not None else 0.0
         try:
             for b in bufs:
                 self.sock.sendall(b)
         except socket.timeout:
             raise Mp4jError(
                 "send timed out (peer dead or not draining?)") from None
+        if self.stats is not None:
+            self.stats.add_wire(sum(len(b) for b in bufs), 0,
+                                time.perf_counter() - t0, chunks=0)
 
     def set_timeout(self, timeout: float | None) -> None:
         """Transfer timeout, both directions: receives AND sends (a
@@ -84,9 +140,11 @@ class Channel:
         turns that hang into a diagnosable Mp4jError."""
         self.sock.settimeout(timeout)
 
-    def _recv_exact(self, n: int) -> bytearray:
-        out = bytearray(n)
-        view = memoryview(out)
+    def _recv_into(self, view: memoryview) -> None:
+        """Fill ``view`` from the socket (timeout-aware, fail-stop on a
+        closed peer); the building block of every framed receive."""
+        n = len(view)
+        t0 = time.perf_counter() if self.stats is not None else 0.0
         got = 0
         while got < n:
             try:
@@ -98,35 +156,77 @@ class Channel:
             if r == 0:
                 raise Mp4jError("peer closed connection mid-message")
             got += r
+        if self.stats is not None:
+            self.stats.add_wire(0, n, time.perf_counter() - t0, chunks=0)
+
+    def _recv_exact(self, n: int) -> bytearray:
+        out = bytearray(n)
+        self._recv_into(memoryview(out))
         return out
+
+    def _recv_payload(self, n: int) -> np.ndarray:
+        """Large-payload receive buffer: ``np.empty`` skips bytearray's
+        zero-fill pass (a whole extra memory write per received MB)."""
+        out = np.empty(n, np.uint8)
+        self._recv_into(memoryview(out))
+        return out
+
+    def _add_serialize(self, t0: float) -> None:
+        if self.stats is not None:
+            self.stats.add("serialize_seconds", time.perf_counter() - t0)
 
     # -- objects --------------------------------------------------------
     def send_obj(self, obj, compress: bool = False) -> None:
+        t0 = time.perf_counter()
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         tag = TAG_OBJ
         if compress:
             payload = zlib.compress(payload, _ZLEVEL)
             tag = TAG_OBJ_Z
+        self._add_serialize(t0)
         self._send_all(_HDR.pack(tag, len(payload)), payload)
 
     # -- arrays (fast path) --------------------------------------------
     def send_array(self, arr: np.ndarray, compress: bool = False) -> None:
+        t0 = time.perf_counter()
         arr = np.ascontiguousarray(arr)
         header = pickle.dumps((_dtype_token(arr.dtype), arr.shape))
+        self._add_serialize(t0)
         if compress:
-            body: bytes | memoryview = zlib.compress(_raw_view(arr), _ZLEVEL)
-            tag = TAG_ARRAY_Z
-            nbody = len(body)
-        else:
-            body = _raw_view(arr)
-            tag = TAG_ARRAY
-            nbody = arr.nbytes
+            return self._send_array_zc(arr, header)
         self._send_all(
-            _HDR.pack(tag, len(header) + 4 + nbody),
+            _HDR.pack(TAG_ARRAY, len(header) + 4 + arr.nbytes),
             struct.pack("<I", len(header)),
             header,
-            body,
+            _raw_view(arr),
         )
+
+    def _send_array_zc(self, arr: np.ndarray, header: bytes) -> None:
+        """Streamed compressed array send (TAG_ARRAY_ZC): compress in
+        ``MP4J_CHUNK_BYTES`` pieces and put each on the wire as soon as
+        it exists, so zlib work on chunk k+1 overlaps the kernel's
+        transmission of chunk k (and the peer's inflate of chunk k).
+        The declared frame payload covers only the header; the chunk
+        stream is self-delimiting (u32 length prefixes, 0 terminator),
+        so the total compressed size never needs to be known up front.
+        """
+        self._send_all(_HDR.pack(TAG_ARRAY_ZC, len(header) + 4),
+                       struct.pack("<I", len(header)), header)
+        comp = zlib.compressobj(_ZLEVEL)
+        view = memoryview(_raw_view(arr)).cast("B")
+        step = self._chunk_bytes
+        for off in range(0, len(view), step):
+            t0 = time.perf_counter()
+            piece = comp.compress(view[off:off + step])
+            self._add_serialize(t0)
+            if piece:
+                self._send_all(_U32.pack(len(piece)), piece)
+        t0 = time.perf_counter()
+        piece = comp.flush()
+        self._add_serialize(t0)
+        if piece:
+            self._send_all(_U32.pack(len(piece)), piece)
+        self._send_all(_U32.pack(0))
 
     # -- raw (unframed) fast path ----------------------------------------
     # Sizes never travel on the wire: both peers derive them from the
@@ -156,30 +256,159 @@ class Channel:
             got += r
 
     # -- unified receive ------------------------------------------------
+    @staticmethod
+    def _decode_dtype(dtype_str) -> np.dtype:
+        try:
+            return np.dtype(dtype_str)
+        except TypeError:
+            import ml_dtypes  # noqa: F401 - registers extension names
+
+            return np.dtype(dtype_str)
+
+    def _recv_zc_into(self, view: memoryview, itemsize: int = 1,
+                      on_chunk=None) -> None:
+        """Drain a TAG_ARRAY_ZC chunk stream, inflating into ``view``
+        as compressed pieces arrive (decompress of chunk k overlaps the
+        sender's compress+send of chunk k+1). ``on_chunk(lo, hi)``
+        reports progress on ``itemsize``-aligned element boundaries so
+        a merge callback only ever sees whole elements."""
+        decomp = zlib.decompressobj()
+        done = 0          # bytes written
+        reported = 0      # elements handed to on_chunk
+        chunks = 0
+
+        def _write(piece: bytes):
+            nonlocal done
+            if done + len(piece) > len(view):
+                raise Mp4jError(
+                    "compressed stream inflates past the declared "
+                    "array size (wire protocol violation)")
+            view[done:done + len(piece)] = piece
+            done += len(piece)
+
+        def _report():
+            nonlocal reported
+            ready = done // itemsize
+            if on_chunk is not None and ready > reported:
+                on_chunk(reported, ready)
+                reported = ready
+
+        while True:
+            (clen,) = _U32.unpack(bytes(self._recv_exact(4)))
+            if clen == 0:
+                break
+            piece = self._recv_payload(clen)
+            t0 = time.perf_counter()
+            _write(decomp.decompress(piece))
+            self._add_serialize(t0)
+            chunks += 1
+            _report()
+        t0 = time.perf_counter()
+        _write(decomp.flush())
+        self._add_serialize(t0)
+        if done != len(view):
+            raise Mp4jError(
+                f"compressed stream ended {len(view) - done} bytes "
+                "short of the declared array size")
+        if self.stats is not None and chunks:
+            self.stats.add("chunks", chunks)
+        _report()
+
     def recv(self):
         hdr = self._recv_exact(_HDR.size)
         tag, ln = _HDR.unpack(bytes(hdr))
         if tag in (TAG_OBJ, TAG_OBJ_Z):
             payload = self._recv_exact(ln)
+            t0 = time.perf_counter()
             if tag == TAG_OBJ_Z:
                 payload = zlib.decompress(payload)
-            return pickle.loads(payload)
-        if tag in (TAG_ARRAY, TAG_ARRAY_Z):
+            out = pickle.loads(payload)
+            self._add_serialize(t0)
+            return out
+        if tag in (TAG_ARRAY, TAG_ARRAY_Z, TAG_ARRAY_ZC):
             (hlen,) = struct.unpack("<I", bytes(self._recv_exact(4)))
             dtype_str, shape = pickle.loads(self._recv_exact(hlen))
-            buf = self._recv_exact(ln - 4 - hlen)
+            dt = self._decode_dtype(dtype_str)
+            if tag == TAG_ARRAY_ZC:
+                arr = np.empty(shape, dtype=dt)
+                self._recv_zc_into(memoryview(_raw_view(arr)).cast("B"))
+                return arr
+            buf = self._recv_payload(ln - 4 - hlen)
             if tag == TAG_ARRAY_Z:
+                t0 = time.perf_counter()
                 # bytearray keeps the received array writable, like the
                 # uncompressed path's recv_into buffer
                 buf = bytearray(zlib.decompress(buf))
-            try:
-                dt = np.dtype(dtype_str)
-            except TypeError:
-                import ml_dtypes  # noqa: F401 - registers extension names
-
-                dt = np.dtype(dtype_str)
+                self._add_serialize(t0)
             return np.frombuffer(buf, dtype=dt).reshape(shape)
         raise Mp4jError(f"unknown frame tag {tag}")
+
+    def recv_array_into(self, out: np.ndarray, on_chunk=None) -> None:
+        """Receive one array frame directly into ``out`` (a contiguous
+        writable array of the exact dtype/size the sender framed — both
+        ends derive it from the collective's segment metadata, so any
+        mismatch is a wire-protocol violation, not a recoverable
+        condition).
+
+        ``on_chunk(lo, hi)`` (element range) fires as each
+        ``MP4J_CHUNK_BYTES`` piece lands, so the caller's merge of
+        chunk k runs cache-hot and overlaps the wire transfer of chunk
+        k+1 — the framed path's half of the pipelined collective
+        engine. Uncompressed frames are received in chunked pieces;
+        compressed frames inflate piece-by-piece and report progress on
+        element boundaries.
+        """
+        hdr = self._recv_exact(_HDR.size)
+        tag, ln = _HDR.unpack(bytes(hdr))
+        if tag not in (TAG_ARRAY, TAG_ARRAY_Z, TAG_ARRAY_ZC):
+            raise Mp4jError(
+                f"expected an array frame, got tag {tag} (operand "
+                "disagreement between sender and receiver?)")
+        (hlen,) = struct.unpack("<I", bytes(self._recv_exact(4)))
+        dtype_str, shape = pickle.loads(self._recv_exact(hlen))
+        dt = self._decode_dtype(dtype_str)
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if dt != out.dtype or size != out.size:
+            raise Mp4jError(
+                f"array frame {dt}[{size}] does not match the expected "
+                f"{out.dtype}[{out.size}] (segment metadata drift)")
+        view = memoryview(_raw_view(out)).cast("B")
+        itemsize = out.dtype.itemsize
+        if tag == TAG_ARRAY:
+            nbody = ln - 4 - hlen
+            if nbody != len(view):
+                raise Mp4jError(
+                    f"array frame carries {nbody} bytes for a "
+                    f"{len(view)}-byte destination")
+            chunks = 0
+            for lo, hi in tuning.chunk_ranges(out.size, itemsize,
+                                              self._chunk_bytes):
+                self._recv_into(view[lo * itemsize:hi * itemsize])
+                chunks += 1
+                if on_chunk is not None:
+                    on_chunk(lo, hi)
+            if self.stats is not None and chunks:
+                self.stats.add("chunks", chunks)
+            return
+        if tag == TAG_ARRAY_Z:
+            buf = self._recv_payload(ln - 4 - hlen)
+            t0 = time.perf_counter()
+            raw = zlib.decompress(buf)
+            if len(raw) != len(view):
+                raise Mp4jError(
+                    f"compressed frame inflates to {len(raw)} bytes "
+                    f"for a {len(view)}-byte destination (wire "
+                    "protocol violation)")
+            view[:] = raw
+            self._add_serialize(t0)
+            if self.stats is not None:
+                self.stats.add("chunks", 1)
+            if on_chunk is not None and out.size:
+                on_chunk(0, out.size)
+            return
+        # TAG_ARRAY_ZC: shared streamed-inflate path (same protocol
+        # enforcement as the generic recv)
+        self._recv_zc_into(view, itemsize=itemsize, on_chunk=on_chunk)
 
     def recv_array(self) -> np.ndarray:
         out = self.recv()
@@ -196,6 +425,19 @@ class Channel:
 
 
 def connect(host: str, port: int, timeout: float | None = None) -> Channel:
-    sock = socket.create_connection((host, port), timeout=timeout)
-    sock.settimeout(None)
-    return Channel(sock)
+    # buffer sizes must be in place before the TCP handshake (window
+    # scale negotiation) — so no create_connection() shortcut here
+    err: Exception | None = None
+    for family, socktype, proto, _, addr in socket.getaddrinfo(
+            host, port, type=socket.SOCK_STREAM):
+        sock = socket.socket(family, socktype, proto)
+        try:
+            apply_socket_buf_sizes(sock)
+            sock.settimeout(timeout)
+            sock.connect(addr)
+            sock.settimeout(None)
+            return Channel(sock)
+        except OSError as e:
+            sock.close()
+            err = e
+    raise Mp4jError(f"cannot connect to {host}:{port}: {err}")

@@ -1,0 +1,140 @@
+"""Per-collective transport statistics.
+
+Unlike :mod:`ytk_mp4j_tpu.utils.trace` (opt-in wall-time tracing of
+whole collective calls), this layer is ALWAYS ON and counts what the
+data plane actually did, per collective family: wire bytes moved in
+each direction, wire/reduce/serialize busy-time, chunk count, and call
+count. The counters are cheap (a locked dict update per chunk/phase,
+not per element) and are the measurement substrate every perf PR is
+judged against — ``comm.stats()`` on the process and thread backends
+returns a snapshot.
+
+Attribution: :func:`ytk_mp4j_tpu.utils.trace.traced` (which already
+wraps every backend collective) calls :meth:`CommStats.begin` /
+:meth:`CommStats.end` around the OUTERMOST collective call on a
+thread, so phase events recorded deeper in the stack (channel sends,
+native exchanges, merge kernels — possibly on helper threads) land on
+the collective that caused them. Events outside any collective land on
+``"<untracked>"``.
+
+Schema of one snapshot entry (all keys always present)::
+
+    {"calls": int, "bytes_sent": int, "bytes_recv": int,
+     "chunks": int, "wire_seconds": float, "reduce_seconds": float,
+     "serialize_seconds": float}
+
+Phase seconds are BUSY times and may overlap in wall time (the whole
+point of the pipelined engine is that wire and reduce overlap), so
+their sum can exceed the collective's wall time.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
+_COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks")
+
+
+def _zero() -> dict[str, float]:
+    entry: dict[str, float] = {k: 0 for k in _COUNTERS}
+    entry.update({k: 0.0 for k in _PHASES})
+    return entry
+
+
+class CommStats:
+    """Per-backend collective counters (see module docstring).
+
+    ``begin``/``end`` nest per THREAD (only the outermost names the
+    bucket); the add methods may be called from any thread — helper
+    threads inherit the bucket that was current when the work was
+    handed to them via the ``bucket()`` handle.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._agg: dict[str, dict[str, float]] = {}
+        self._tl = threading.local()
+        # helper-thread fallback: pool workers doing wire work on a
+        # collective's behalf have no thread-local scope, so the
+        # outermost begin also publishes the name here. Concurrent
+        # outermost scopes only happen on the thread backend, where the
+        # barrier-aligned schedule guarantees they share one name.
+        self._shared_name: str | None = None
+        self._shared_depth = 0
+
+    # -- attribution ---------------------------------------------------
+    def begin(self, name: str) -> bool:
+        """Enter a collective scope; returns True when this is the
+        outermost scope on the calling thread (the caller must pass
+        that flag back to :meth:`end`)."""
+        depth = getattr(self._tl, "depth", 0)
+        self._tl.depth = depth + 1
+        if depth == 0:
+            self._tl.name = name
+            with self._lock:
+                self._bucket_locked(name)["calls"] += 1
+                self._shared_name = name
+                self._shared_depth += 1
+            return True
+        return False
+
+    def end(self, outermost: bool) -> None:
+        self._tl.depth = getattr(self._tl, "depth", 1) - 1
+        if outermost:
+            self._tl.name = None
+            with self._lock:
+                self._shared_depth -= 1
+                if self._shared_depth <= 0:
+                    self._shared_name = None
+
+    def bucket(self) -> str:
+        """The current attribution bucket: this thread's collective
+        scope, else the slave's active collective (helper threads),
+        else ``"<untracked>"``."""
+        name = getattr(self._tl, "name", None)
+        if name is not None:
+            return name
+        return self._shared_name or "<untracked>"
+
+    # -- recording -----------------------------------------------------
+    def _bucket_locked(self, name: str) -> dict[str, float]:
+        entry = self._agg.get(name)
+        if entry is None:
+            entry = self._agg[name] = _zero()
+        return entry
+
+    def add(self, key: str, value: float, bucket: str | None = None) -> None:
+        with self._lock:
+            self._bucket_locked(bucket or self.bucket())[key] += value
+
+    def add_wire(self, bytes_sent: int, bytes_recv: int, seconds: float,
+                 chunks: int = 1, bucket: str | None = None) -> None:
+        with self._lock:
+            e = self._bucket_locked(bucket or self.bucket())
+            e["bytes_sent"] += bytes_sent
+            e["bytes_recv"] += bytes_recv
+            e["wire_seconds"] += seconds
+            e["chunks"] += chunks
+
+    # -- reading -------------------------------------------------------
+    def snapshot(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._agg.items()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._agg.clear()
+
+
+def merge_snapshots(*snaps: dict[str, dict[str, float]]
+                    ) -> dict[str, dict[str, float]]:
+    """Key-wise sum of snapshots (the thread backend combines its
+    intra-process counters with the shared process slave's)."""
+    out: dict[str, dict[str, float]] = {}
+    for snap in snaps:
+        for name, entry in snap.items():
+            acc = out.setdefault(name, _zero())
+            for k, v in entry.items():
+                acc[k] = acc.get(k, 0) + v
+    return out

@@ -94,22 +94,35 @@ def traced(fn):
     and record the payload size of its first data argument. Only the
     OUTERMOST traced call on a thread records — collectives implemented
     by composing other collectives (e.g. allreduce_map = reduce_map +
-    broadcast_map) must not double-count or emit phantom rows."""
+    broadcast_map) must not double-count or emit phantom rows.
+
+    Independently of the trace on/off switch, the wrapper scopes the
+    backend's always-on :class:`~ytk_mp4j_tpu.utils.stats.CommStats`
+    (when the instance carries one as ``_comm_stats``) so wire/reduce/
+    serialize phase events recorded deeper in the stack attribute to
+    the collective that caused them."""
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
-        if not _enabled or getattr(_in_collective, "depth", 0) > 0:
-            return fn(self, *args, **kwargs)
-        nbytes = _payload_bytes(args[0]) if args else 0
-        _in_collective.depth = 1
-        t0 = time.perf_counter()
+        stats = getattr(self, "_comm_stats", None)
+        outermost = (stats.begin(fn.__name__)
+                     if stats is not None else False)
         try:
-            out = fn(self, *args, **kwargs)
+            if not _enabled or getattr(_in_collective, "depth", 0) > 0:
+                return fn(self, *args, **kwargs)
+            nbytes = _payload_bytes(args[0]) if args else 0
+            _in_collective.depth = 1
+            t0 = time.perf_counter()
+            try:
+                out = fn(self, *args, **kwargs)
+            finally:
+                _in_collective.depth = 0
+            record(f"{type(self).__name__}.{fn.__name__}",
+                   time.perf_counter() - t0, nbytes)
+            return out
         finally:
-            _in_collective.depth = 0
-        record(f"{type(self).__name__}.{fn.__name__}",
-               time.perf_counter() - t0, nbytes)
-        return out
+            if stats is not None:
+                stats.end(outermost)
 
     return wrapper
 

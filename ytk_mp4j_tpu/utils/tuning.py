@@ -1,0 +1,125 @@
+"""Transport tuning knobs + size-aware collective algorithm selection.
+
+Everything in this module is a PURE FUNCTION of job-wide call
+parameters (payload size, rank count, env-configured thresholds) —
+mp4j-lint R1/R8 territory: two ranks evaluating the same collective
+call must derive the identical algorithm and chunk schedule, or they
+would disagree about the wire protocol and deadlock. The env knobs are
+therefore JOB-wide configuration: every rank of a job must run with the
+same values (exactly like ``native_transport``).
+
+Knobs (all validated where they are consumed; garbage raises
+``Mp4jError`` at slave/channel setup, not mid-collective):
+
+- ``MP4J_CHUNK_BYTES`` — pipeline chunk size for the chunked
+  collective engine (default 1 MiB, measured on the bench host: the
+  scratch-buffer pool already keeps receive pages warm, so sub-MiB
+  chunks pay per-exchange poll/syscall overhead without buying more
+  cache locality; 1 MiB leaves typical segments monolithic while
+  bounding the merge granularity of multi-MB segments and sizing the
+  streaming-compression pieces).
+- ``MP4J_ALGO_SMALL_BYTES`` / ``MP4J_ALGO_LARGE_BYTES`` — the
+  ``algo="auto"`` thresholds: payloads <= small take the binomial tree
+  (latency-bound regime), payloads >= large take the pipelined ring
+  (bandwidth-bound regime), in between recursive halving/doubling.
+  Defaults are grounded in ``bench.py``'s ``socket_allreduce_sweep``
+  (see BENCH JSON ``extra``).
+- ``MP4J_SO_SNDBUF`` / ``MP4J_SO_RCVBUF`` — socket buffer sizes applied
+  at channel setup (``transport/channel.py``); unset keeps the kernel
+  defaults.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+
+DEFAULT_CHUNK_BYTES = 1024 * 1024
+# Sweep-grounded (bench.py socket_allreduce_sweep on the bench host,
+# BENCH JSON extra): the binomial tree wins the latency-bound regime up
+# to ~256 KiB (~1.5x over RHD at 64 KiB); RHD wins the middle; from
+# ~4 MiB the pipelined ring's uniform per-step segments edge out RHD's
+# large first-round exchange (~1.15x at 8 MiB). Hosts with different
+# core counts / NICs tune via env.
+DEFAULT_ALGO_SMALL_BYTES = 256 * 1024
+DEFAULT_ALGO_LARGE_BYTES = 4 * 1024 * 1024
+
+
+def env_bytes(name: str, default: int, minimum: int = 1) -> int:
+    """A byte-count knob from the environment, validated: an unset or
+    empty var yields ``default``; anything else must parse as an int
+    >= ``minimum`` (suffix-free; ``262144``, not ``256k``) or the
+    caller's setup fails with a diagnosable Mp4jError instead of a
+    mid-collective surprise."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise Mp4jError(
+            f"{name}={raw!r} is not an integer byte count") from None
+    if val < minimum:
+        raise Mp4jError(f"{name}={val} must be >= {minimum}")
+    return val
+
+
+def chunk_bytes() -> int:
+    return env_bytes("MP4J_CHUNK_BYTES", DEFAULT_CHUNK_BYTES, minimum=64)
+
+
+def algo_thresholds() -> tuple[int, int]:
+    """(small, large) byte thresholds for ``algo="auto"``; validated
+    jointly: small must not exceed large or the medium regime would be
+    empty in a surprising order-dependent way."""
+    small = env_bytes("MP4J_ALGO_SMALL_BYTES", DEFAULT_ALGO_SMALL_BYTES,
+                      minimum=0)
+    large = env_bytes("MP4J_ALGO_LARGE_BYTES", DEFAULT_ALGO_LARGE_BYTES,
+                      minimum=0)
+    if small > large:
+        raise Mp4jError(
+            f"MP4J_ALGO_SMALL_BYTES={small} exceeds "
+            f"MP4J_ALGO_LARGE_BYTES={large}")
+    return small, large
+
+
+def select_allreduce_algo(nbytes: int, n: int, small: int,
+                          large: int) -> str:
+    """The ``algo="auto"`` rule for allreduce: binomial tree for
+    latency-bound small payloads, recursive halving/doubling for the
+    middle, pipelined ring for bandwidth-bound large payloads. A pure
+    function of (payload bytes, rank count, thresholds) — never of any
+    rank-local state."""
+    if n <= 2:
+        # at n=2 RHD degenerates to the single optimal pairwise
+        # exchange; tree/ring only add rounds
+        return "rhd"
+    if nbytes <= small:
+        return "tree"
+    if nbytes >= large:
+        return "ring"
+    return "rhd"
+
+
+def select_partitioned_algo(nbytes: int, n: int, small: int,
+                            large: int) -> str:
+    """``algo="auto"`` for reduce_scatter / allgather: rooted binomial
+    tree composition below the latency threshold, ring otherwise (the
+    ring is both the medium and large choice — it is bandwidth-optimal
+    and these collectives have no halving/doubling variant)."""
+    if nbytes <= small and n > 2:
+        return "tree"
+    return "ring"
+
+
+def chunk_ranges(total: int, itemsize: int,
+                 chunk_bytes_: int) -> list[tuple[int, int]]:
+    """Element ranges ``[(s, e), ...]`` splitting ``total`` elements
+    into pipeline chunks of ~``chunk_bytes_`` bytes. Pure function of
+    its arguments (mp4j-lint R8: a chunk schedule must never depend on
+    rank-local state). ``total == 0`` yields no chunks."""
+    if total <= 0:
+        return []
+    per = max(1, chunk_bytes_ // max(1, itemsize))
+    return [(s, min(s + per, total)) for s in range(0, total, per)]
