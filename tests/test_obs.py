@@ -1,0 +1,286 @@
+"""Observability layer (ISSUE 3): span ring + Chrome-trace export,
+master telemetry aggregation, cross-rank skew, hang diagnosis, the
+barrier watchdog, the upgraded log sink, and the mp4j-scope CLI."""
+
+import io
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.obs import spans, telemetry
+from ytk_mp4j_tpu.obs.cli import main as scope_main
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.utils import trace
+
+
+def run_job(n, fn, master_kwargs=None, slave_kwargs=None, join=30.0):
+    """Master + n slave threads with log capture; returns
+    (results, errors, log_text, master). Unlike helpers.run_slaves it
+    does NOT assert success — hang tests expect slave errors."""
+    log = io.StringIO()
+    master = Master(n, timeout=join, log_stream=log,
+                    **(master_kwargs or {})).serve_in_thread()
+    results, errors = [None] * n, []
+
+    def worker():
+        slave = None
+        try:
+            slave = ProcessCommSlave("127.0.0.1", master.port,
+                                     timeout=join,
+                                     **(slave_kwargs or {}))
+            results[slave.rank] = fn(slave, slave.rank)
+            slave.close(0)
+        except Exception as e:
+            errors.append((slave.rank if slave is not None else -1, e))
+            if slave is not None:
+                try:
+                    slave.close(1)
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(join)
+        assert not t.is_alive(), "slave thread hung past the deadline"
+    master.join(join)
+    return results, errors, log.getvalue(), master
+
+
+# ----------------------------------------------------------------------
+# span timelines / Chrome-trace export
+# ----------------------------------------------------------------------
+def _validate_chrome_trace(doc):
+    """The trace-event JSON schema gate: required keys on every event,
+    monotone ts per (pid, tid) track."""
+    assert isinstance(doc, dict) and isinstance(doc["traceEvents"], list)
+    tracks = {}
+    for ev in doc["traceEvents"]:
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            assert key in ev, f"event missing {key!r}: {ev}"
+        assert ev["ph"] == "X" and ev["dur"] >= 0
+        track = (ev["pid"], ev["tid"])
+        assert ev["ts"] >= tracks.get(track, float("-inf")), \
+            f"ts not monotone on track {track}"
+        tracks[track] = ev["ts"]
+    return doc["traceEvents"]
+
+
+def test_export_chrome_trace_socket_job(tmp_path, monkeypatch):
+    """Acceptance: a 4-rank socket job exports valid trace-event JSON
+    with chunk-level wire/reduce phase spans for allreduce_array."""
+    monkeypatch.setenv("MP4J_CHUNK_BYTES", "8192")  # 8 KiB -> chunking
+    from helpers import run_slaves
+
+    spans.clear()
+
+    def fn(slave, r):
+        arr = np.full(16384, float(r))  # 128 KiB float64
+        # rhd: every rank both exchanges and merges, so every rank's
+        # timeline gets wire AND reduce spans (the tree's leaf ranks
+        # only send)
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM,
+                              algo="rhd")
+        return float(arr[0])
+
+    run_slaves(4, fn)
+    path = tmp_path / "trace.json"
+    n = trace.export_chrome_trace(str(path))
+    assert n > 0
+    events = _validate_chrome_trace(json.loads(path.read_text()))
+
+    # one timeline track per rank (pid = mp4j rank)
+    assert {e["pid"] for e in events} == {0, 1, 2, 3}
+    # collective spans carry the per-slave sequence number
+    colls = [e for e in events
+             if e["cat"] == "collective" and e["name"] == "allreduce_array"]
+    assert len(colls) == 4 and all(e["args"]["seq"] >= 1 for e in colls)
+    # chunk-level phase spans attributed to the collective: several
+    # wire AND reduce spans per rank (128 KiB over 8 KiB chunks)
+    for pid in range(4):
+        wires = [e for e in events if e["pid"] == pid
+                 and e["name"] == "wire"
+                 and e["args"]["collective"] == "allreduce_array"]
+        reduces = [e for e in events if e["pid"] == pid
+                   and e["name"] == "reduce"
+                   and e["args"]["collective"] == "allreduce_array"]
+        assert len(wires) >= 2, "expected chunk-level wire spans"
+        assert len(reduces) >= 2, "expected chunk-level reduce spans"
+
+
+def test_span_ring_is_bounded():
+    spans.configure(8)
+    try:
+        for i in range(100):
+            spans.record(f"s{i}", "phase", float(i), 0.001, 0)
+        snap = spans.snapshot()
+        assert len(snap) == 8
+        assert snap[0][0] == "s92"  # oldest fell off
+    finally:
+        from ytk_mp4j_tpu.utils import tuning
+        spans.configure(tuning.span_ring_capacity())
+
+
+def test_scope_merge_cli(tmp_path, capsys):
+    a = tmp_path / "r0.json"
+    b = tmp_path / "r1.json"
+    a.write_text(json.dumps({"traceEvents": [
+        {"name": "wire", "cat": "phase", "ph": "X", "ts": 5.0,
+         "dur": 1.0, "pid": 0, "tid": 0}]}))
+    b.write_text(json.dumps({"traceEvents": [
+        {"name": "wire", "cat": "phase", "ph": "X", "ts": 1.0,
+         "dur": 1.0, "pid": 1, "tid": 0}]}))
+    out = tmp_path / "merged.json"
+    assert scope_main(["merge", "-o", str(out), str(a), str(b)]) == 0
+    events = _validate_chrome_trace(json.loads(out.read_text()))
+    assert [e["pid"] for e in events] == [1, 0]  # re-sorted by ts
+    assert "merged 2 events" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# master telemetry: heartbeats, skew, diagnosis
+# ----------------------------------------------------------------------
+def test_injected_hang_produces_master_diagnosis(monkeypatch):
+    """Acceptance: one rank skips an allreduce -> the master names the
+    stuck rank, its last collective, and its sequence-number lag,
+    within the bounded peer timeout."""
+    monkeypatch.setenv("MP4J_HEARTBEAT_SECS", "0.1")
+
+    def fn(slave, r):
+        arr = np.ones(64)
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        if r == 2:
+            time.sleep(3.0)   # skip the second allreduce entirely
+            return None
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        return None
+
+    _, errors, log, _ = run_job(3, fn,
+                                slave_kwargs={"peer_timeout": 1.0})
+    # the healthy ranks' bounded waits expired (no new deadlock path)
+    assert len(errors) == 2
+    assert all(isinstance(e, Mp4jError) for _, e in errors)
+    assert {r for r, _ in errors} == {0, 1}
+    # ... and the master printed the cluster diagnosis
+    assert "cluster diagnosis" in log
+    assert re.search(r"rank 2: seq 1 \(lag 1\).*'allreduce_array'", log)
+    assert "likely stuck rank(s): 2" in log
+    # debounced: both healthy ranks report, the full per-rank dump is
+    # logged once and the repeat collapses to a single line
+    assert log.count("cluster diagnosis") == 1
+    assert "full diagnosis already logged above" in log
+
+
+def test_cluster_stats_skew(monkeypatch):
+    monkeypatch.setenv("MP4J_HEARTBEAT_SECS", "0.1")
+
+    def fn(slave, r):
+        arr = np.ones(4096)
+        for _ in range(3):
+            slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        slave.barrier()
+        return None
+
+    _, errors, _, master = run_job(2, fn)
+    assert not errors
+    skew = master.cluster_stats()
+    assert "allreduce_array" in skew
+    s = skew["allreduce_array"]
+    assert s["ranks"] == 2 and s["calls"] == 3
+    assert s["bytes"] > 0
+    assert 0 <= s["busy_min"] <= s["busy_median"] <= s["busy_max"]
+    assert set(s["stragglers"]) <= {0, 1}
+    # the live table renders
+    assert "allreduce_array" in master.format_cluster_stats()
+
+
+def test_barrier_watchdog_diagnoses_stall(monkeypatch):
+    monkeypatch.setenv("MP4J_HEARTBEAT_SECS", "0.1")
+
+    def fn(slave, r):
+        if r == 1:
+            time.sleep(1.5)   # rank 0 waits at the barrier alone
+        slave.barrier()
+        return None
+
+    _, errors, log, _ = run_job(
+        2, fn, master_kwargs={"stall_timeout": 0.5})
+    assert not errors          # watchdog logs, barrier still completes
+    assert "stalled" in log and "waiting on ranks [1]" in log
+    assert "cluster diagnosis" in log
+
+
+def test_scope_report_cli(tmp_path, capsys):
+    def snap(wire, nbytes):
+        return {"allreduce_array": {
+            "calls": 2, "bytes_sent": nbytes, "bytes_recv": nbytes,
+            "chunks": 4, "wire_seconds": wire, "reduce_seconds": 0.1,
+            "serialize_seconds": 0.0}}
+
+    a = tmp_path / "s0.json"
+    b = tmp_path / "s1.json"
+    a.write_text(json.dumps(snap(0.2, 1000)))
+    b.write_text(json.dumps({"rank": 1, "stats": snap(0.9, 1000)}))
+    assert scope_main(["report", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "allreduce_array" in out and "stragglers" in out
+
+    assert scope_main(["report", "--json", str(a), str(b)]) == 0
+    skew = json.loads(capsys.readouterr().out)
+    assert skew["allreduce_array"]["stragglers"] == [1]  # rank 1 slower
+    assert skew["allreduce_array"]["busy_max"] == 1.0
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    assert scope_main(["report", str(bad)]) == 2
+
+
+def test_render_diagnosis_no_telemetry():
+    lines = telemetry.render_diagnosis({}, 4)
+    assert any("no telemetry" in ln for ln in lines)
+
+
+def test_render_diagnosis_never_heard_rank():
+    table = {0: {"seq": 5, "current": None, "last": "barrier",
+                 "phase": "wire", "current_secs": 0.0, "age": 0.2}}
+    lines = "\n".join(telemetry.render_diagnosis(table, 2))
+    assert "rank 1: NO heartbeat ever received" in lines
+    assert "likely stuck rank(s): 1" in lines
+
+
+# ----------------------------------------------------------------------
+# log sink (satellite: timestamps, fixed-width prefix, level filter)
+# ----------------------------------------------------------------------
+def test_log_sink_format_and_level_filter(monkeypatch):
+    monkeypatch.setenv("MP4J_LOG_LEVEL", "WARN")
+    log = io.StringIO()
+    m = Master(12, log_stream=log)
+    try:
+        m._log(3, "INFO", "dropped")
+        m._log(3, "WARN", "kept")
+        m._log("M", "ERROR", "master line")
+    finally:
+        m._server.close()
+    out = log.getvalue()
+    assert "dropped" not in out and "kept" in out
+    # ISO-8601 timestamp + fixed-width [rank/size LEVEL] prefix
+    assert re.search(
+        r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3} "
+        r"\[ 3/12 WARN \] kept$", out, re.M)
+    assert re.search(r"\[ M/12 ERROR\] master line$", out, re.M)
+
+
+def test_log_level_env_validated(monkeypatch):
+    monkeypatch.setenv("MP4J_LOG_LEVEL", "LOUD")
+    with pytest.raises(Mp4jError):
+        Master(1)

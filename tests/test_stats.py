@@ -1,0 +1,106 @@
+"""CommStats attribution edges (utils.stats): the <untracked> bucket,
+snapshot merging, the helper-thread fallback, and the telemetry
+progress/sequence-number record the heartbeats ship."""
+
+import threading
+
+from ytk_mp4j_tpu.utils.stats import CommStats, merge_snapshots
+
+
+def test_untracked_bucket_outside_any_collective():
+    cs = CommStats()
+    cs.add_wire(100, 50, 0.25)
+    cs.add("reduce_seconds", 0.5)
+    snap = cs.snapshot()
+    assert set(snap) == {"<untracked>"}
+    e = snap["<untracked>"]
+    assert e["bytes_sent"] == 100 and e["bytes_recv"] == 50
+    assert e["wire_seconds"] == 0.25 and e["reduce_seconds"] == 0.5
+    assert e["calls"] == 0  # nothing ever entered a collective scope
+
+
+def test_helper_thread_fallback_requires_open_scope():
+    """A helper thread inherits the slave's active collective via the
+    shared name; with no scope open (_shared_name unset) it must land
+    on <untracked>, and again after the scope closes."""
+    cs = CommStats()
+    seen = []
+
+    def helper():
+        seen.append(cs.bucket())
+
+    t = threading.Thread(target=helper)
+    t.start()
+    t.join()
+    assert seen == ["<untracked>"]
+
+    outer = cs.begin("allreduce_array")
+    assert outer  # outermost
+    t = threading.Thread(target=lambda: seen.append(cs.bucket()))
+    t.start()
+    t.join()
+    assert seen[-1] == "allreduce_array"
+    cs.end(outer)
+    t = threading.Thread(target=lambda: seen.append(cs.bucket()))
+    t.start()
+    t.join()
+    assert seen[-1] == "<untracked>"
+
+
+def test_nested_scopes_and_sequence_numbers():
+    cs = CommStats()
+    s1 = cs.begin("allreduce_map")
+    assert s1 == 1
+    nested = cs.begin("reduce_map")     # composed collective
+    assert nested == 0                  # not outermost: no seq bump
+    assert cs.bucket() == "allreduce_map"
+    cs.add("serialize_seconds", 0.1)
+    cs.end(nested)
+    cs.end(s1)
+    s2 = cs.begin("barrier")
+    assert s2 == 2                      # monotonically increasing
+    cs.end(s2)
+    snap = cs.snapshot()
+    # phase work inside the nested call attributed to the OUTER call
+    assert snap["allreduce_map"]["serialize_seconds"] == 0.1
+    assert "reduce_map" not in snap
+    assert snap["allreduce_map"]["calls"] == 1
+    assert snap["barrier"]["calls"] == 1
+
+
+def test_progress_record_transitions():
+    cs = CommStats()
+    p = cs.progress()
+    assert p == {"seq": 0, "current": None, "last": None, "phase": None,
+                 "current_secs": 0.0}
+    tok = cs.begin("allreduce_array")
+    cs.add_wire(10, 10, 0.01)
+    p = cs.progress()
+    assert p["seq"] == 1 and p["current"] == "allreduce_array"
+    assert p["phase"] == "wire" and p["current_secs"] >= 0.0
+    cs.end(tok)
+    p = cs.progress()
+    assert p["current"] is None and p["last"] == "allreduce_array"
+
+
+def test_merge_snapshots_disjoint_and_overlapping():
+    a = CommStats()
+    tok = a.begin("allreduce_array")
+    a.add_wire(100, 100, 0.5, chunks=2)
+    a.end(tok)
+    b = CommStats()
+    tok = b.begin("allreduce_array")
+    b.add_wire(10, 10, 0.1, chunks=1)
+    b.end(tok)
+    tok = b.begin("barrier")
+    b.end(tok)
+
+    merged = merge_snapshots(a.snapshot(), b.snapshot())
+    assert set(merged) == {"allreduce_array", "barrier"}
+    e = merged["allreduce_array"]
+    assert e["calls"] == 2 and e["chunks"] == 3
+    assert e["bytes_sent"] == 110 and abs(e["wire_seconds"] - 0.6) < 1e-12
+    # disjoint key keeps the full schema, zero-filled elsewhere
+    assert merged["barrier"]["calls"] == 1
+    assert merged["barrier"]["wire_seconds"] == 0.0
+    assert merge_snapshots() == {}

@@ -105,6 +105,46 @@ def test_profiler_scope_cannot_nest(tmp_path):
     assert trace.events() == []
 
 
+def test_summary_percentiles():
+    """One straggling call must be visible behind a healthy mean."""
+    trace.clear()
+    with trace_collectives():
+        for sec in (0.01,) * 9 + (1.0,):
+            trace.record("x.allreduce", sec, 100)
+    a = trace.summary()["x.allreduce"]
+    assert a["calls"] == 10
+    assert a["p50"] == 0.01
+    assert a["p95"] == 1.0
+    assert a["max"] == 1.0
+    header, *rows = trace.format_summary().splitlines()
+    assert "p50ms" in header and "p95ms" in header and "maxms" in header
+    assert "1000.000" in rows[0]  # the 1 s straggler, in ms
+    trace.clear()
+
+
+def test_payload_bytes_dedup_and_scalars():
+    """Views sharing one base buffer count once per distinct base;
+    non-numeric scalars count 0, not a phantom 8."""
+    base = np.zeros(100, np.float64)
+    # two views of the same buffer in one dict: counted once
+    assert trace._payload_bytes(
+        {"a": base[:50], "b": base[50:]}) == base[:50].nbytes
+    # the same array twice in a list: counted once
+    assert trace._payload_bytes([base, base]) == base.nbytes
+    # distinct buffers still sum
+    other = np.zeros(10, np.float32)
+    assert trace._payload_bytes([base, other]) == base.nbytes + other.nbytes
+    # a bare top-level array is its own size (no container, no dedup)
+    assert trace._payload_bytes(base[:10]) == 80
+    # scalars: numeric 8, non-numeric 0
+    assert trace._payload_bytes(3) == 8
+    assert trace._payload_bytes(np.float32(1.0)) == 4  # true scalar nbytes
+    assert trace._payload_bytes(None) == 0
+    assert trace._payload_bytes(np.str_("abc")) == 0
+    assert trace._payload_bytes({"k": None}) == 0
+    assert trace._payload_bytes(object()) == 0
+
+
 def test_nested_scopes():
     trace.clear()
     cluster = TpuCommCluster(2)

@@ -12,6 +12,18 @@ CLI: ``python -m ytk_mp4j_tpu.comm.master --port P --slaves N``.
 Failure model matches the reference: fail-stop, fixed slave count, no
 elastic recovery (SURVEY.md section 5) — but rendezvous has an optional
 timeout as a cheap diagnosability win over indefinite hangs.
+
+Observability (ISSUE 3): slaves piggyback periodic TELEMETRY heartbeats
+(``{progress, stats}``, schema in obs.telemetry) on the control
+channel; the master keeps a per-rank table, serves cross-rank skew via
+:meth:`Master.cluster_stats`, and turns the paper's worst failure mode
+— a silent mismatched-schedule deadlock — into a runtime report: a
+slave whose bounded collective wait expires ships a DIAGNOSE, and a
+barrier generation stalled past ``stall_timeout`` trips the watchdog;
+either way the master logs which ranks trail the cluster's max
+collective sequence number, where each laggard last was, and how stale
+its heartbeat is. Heartbeats ride the control plane only — they can
+never block a data-plane exchange.
 """
 
 from __future__ import annotations
@@ -23,29 +35,45 @@ import threading
 import time
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.obs import telemetry as telemetry_mod
 from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.utils import tuning
 
 # control-plane message kinds (slave -> master)
 REGISTER = "register"
 LOG = "log"
 BARRIER = "barrier"
 CLOSE = "close"
+TELEMETRY = "telemetry"   # periodic heartbeat: {progress, stats}
+DIAGNOSE = "diagnose"     # a slave's bounded wait expired; report it
 
 
 class Master:
-    """Rank assignment, roster exchange, log sink, barrier, exit codes."""
+    """Rank assignment, roster exchange, log sink, barrier, exit codes,
+    plus the cluster telemetry table (heartbeats, skew, hang diagnosis)."""
 
     def __init__(self, slave_num: int, port: int = 0, host: str = "",
                  log_stream=None, timeout: float | None = 120.0,
-                 handshake_timeout: float | None = 5.0):
+                 handshake_timeout: float | None = 5.0,
+                 stall_timeout: float | None = 60.0):
         """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
         bounds each accepted connection's registration message, so one
         stray dial-in stalls rendezvous briefly instead of consuming the
-        entire budget while real slaves queue behind it."""
+        entire budget while real slaves queue behind it.
+        ``stall_timeout`` arms the barrier watchdog: a barrier
+        generation with some ranks still missing after this many
+        seconds gets a hang diagnosis logged (once per generation);
+        ``None`` disables the watchdog. The watchdog only LOGS — the
+        barrier itself stays fail-stop, per the reference contract."""
         self.slave_num = slave_num
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
+        self.stall_timeout = stall_timeout
         self.log_stream = log_stream if log_stream is not None else sys.stderr
+        # log sink config: validated once at construction (a typo'd
+        # MP4J_LOG_LEVEL fails the job here, not silently mid-run)
+        self._min_level = tuning.LOG_LEVELS[tuning.log_level()]
+        self._rank_width = max(1, len(str(max(slave_num - 1, 0))))
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host or "0.0.0.0", port))
@@ -54,7 +82,13 @@ class Master:
         self._channels: list[Channel] = []      # by rank after rendezvous
         self._exit_codes: dict[int, int] = {}
         self._barrier_waiting: dict[int, list[int]] = {}  # gen -> ranks
+        self._barrier_since: dict[int, float] = {}        # gen -> mono ts
+        self._diagnosed_gens: set[int] = set()
+        self._diag_incident_seq: int | None = None  # debounce key
+        # rank -> last heartbeat: progress fields + stats + arrival time
+        self._telemetry: dict[int, dict] = {}
         self._lock = threading.Lock()
+        self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.final_code: int | None = None
 
@@ -69,8 +103,18 @@ class Master:
                                  daemon=True, name=f"master-slave{rank}")
             t.start()
             threads.append(t)
-        for t in threads:
-            t.join()
+        watchdog = None
+        if self.stall_timeout is not None:
+            watchdog = threading.Thread(target=self._watchdog_loop,
+                                        daemon=True, name="mp4j-watchdog")
+            watchdog.start()
+        try:
+            for t in threads:
+                t.join()
+        finally:
+            self._stop.set()
+        if watchdog is not None:
+            watchdog.join(2.0)
         self._server.close()
         codes = [self._exit_codes.get(r, 1) for r in range(self.slave_num)]
         self.final_code = max(codes) if codes else 0
@@ -96,9 +140,11 @@ class Master:
         self._server.settimeout(1.0)
         while len(pending) < self.slave_num:
             if deadline is not None and time.time() > deadline:
+                got = [hp for _, hp in pending]
                 raise Mp4jError(
                     f"rendezvous timeout: {len(pending)}/{self.slave_num} "
-                    "slaves registered")
+                    f"slaves registered (heard from: {got or 'none'} — "
+                    "the missing slaves never dialed in)")
             try:
                 sock, addr = self._server.accept()
             except socket.timeout:
@@ -141,6 +187,10 @@ class Master:
                     self._log(rank, payload["level"], payload["msg"])
                 elif kind == BARRIER:
                     self._barrier(rank, payload["gen"], ch)
+                elif kind == TELEMETRY:
+                    self._record_telemetry(rank, payload)
+                elif kind == DIAGNOSE:
+                    self._handle_diagnose(rank, payload)
                 elif kind == CLOSE:
                     with self._lock:
                         self._exit_codes[rank] = payload["code"]
@@ -156,15 +206,118 @@ class Master:
             with self._lock:
                 self._exit_codes.setdefault(rank, 1)
 
-    def _log(self, rank: int, level: str, msg: str):
-        ts = time.strftime("%H:%M:%S")
-        print(f"[{ts}][rank {rank}/{self.slave_num}][{level}] {msg}",
+    def _log(self, rank, level: str, msg: str):
+        """Centralized log sink: ISO-8601 timestamps and a fixed-width
+        ``[rank/size LEVEL]`` prefix so interleaved multi-rank logs are
+        sortable and greppable; lines below ``MP4J_LOG_LEVEL`` are
+        dropped. ``rank`` may be the string ``"M"`` for master-origin
+        lines (watchdog, rendezvous)."""
+        if tuning.LOG_LEVELS.get(level, tuning.LOG_LEVELS["INFO"]) \
+                < self._min_level:
+            return
+        now = time.time()
+        ts = (time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(now))
+              + f".{int(now % 1 * 1000):03d}")
+        who = f"{rank!s:>{self._rank_width}}"
+        print(f"{ts} [{who}/{self.slave_num} {level:<5}] {msg}",
               file=self.log_stream, flush=True)
+
+    # -- telemetry ------------------------------------------------------
+    def _record_telemetry(self, rank: int, payload: dict) -> None:
+        progress = payload.get("progress") or {}
+        with self._lock:
+            self._telemetry[rank] = {
+                "seq": int(progress.get("seq", 0)),
+                "current": progress.get("current"),
+                "last": progress.get("last"),
+                "phase": progress.get("phase"),
+                "current_secs": float(progress.get("current_secs", 0.0)),
+                "stats": payload.get("stats") or {},
+                "mono": time.monotonic(),
+            }
+
+    def _handle_diagnose(self, rank: int, payload: dict) -> None:
+        """A slave's bounded collective wait expired: refresh its table
+        entry from the report itself (fresher than its last heartbeat),
+        then log the cluster-wide diagnosis — ONCE per incident. When
+        one rank stalls, every other rank's bounded wait expires in the
+        same window; without the debounce (keyed on the cluster's max
+        sequence number) a 256-rank job would bury the one useful
+        report under ~N full per-rank dumps."""
+        self._record_telemetry(rank, payload)
+        self._log(rank, "ERROR",
+                  f"collective '{payload.get('collective')}' failed: "
+                  f"{payload.get('error')}")
+        with self._lock:
+            incident = max((t["seq"] for t in self._telemetry.values()),
+                           default=0)
+            repeat = incident == self._diag_incident_seq
+            self._diag_incident_seq = incident
+        if repeat:
+            self._log("M", "WARN",
+                      f"rank {rank} reports the same incident (max seq "
+                      f"{incident}) — full diagnosis already logged above")
+            return
+        for line in self.diagnose():
+            self._log("M", "WARN", line)
+
+    def diagnose(self) -> list[str]:
+        """Render the hang/straggler diagnosis from the heartbeat
+        table (obs.telemetry.render_diagnosis)."""
+        now = time.monotonic()
+        with self._lock:
+            table = {r: {**{k: t[k] for k in
+                            ("seq", "current", "last", "phase",
+                             "current_secs")},
+                         "age": now - t["mono"]}
+                     for r, t in self._telemetry.items()}
+        return telemetry_mod.render_diagnosis(table, self.slave_num)
+
+    def cluster_stats(self) -> dict[str, dict]:
+        """Cross-rank skew per collective family from the latest
+        heartbeat stats snapshots (schema:
+        obs.telemetry.cluster_skew)."""
+        with self._lock:
+            per_rank = {r: t["stats"] for r, t in self._telemetry.items()
+                        if t.get("stats")}
+        return telemetry_mod.cluster_skew(per_rank)
+
+    def format_cluster_stats(self) -> str:
+        """The ``mp4j-scope report`` table, live from the master."""
+        return telemetry_mod.format_skew(self.cluster_stats())
+
+    def _watchdog_loop(self):
+        """Diagnose stalled barriers: a generation some ranks reached
+        ``stall_timeout`` seconds ago while others never arrived is the
+        mismatched-schedule deadlock signature — log the diagnosis once
+        per generation. Logging only; the barrier stays fail-stop."""
+        tick = min(1.0, max(0.05, self.stall_timeout / 4))
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            stalled = []
+            with self._lock:
+                for gen, since in self._barrier_since.items():
+                    if (gen in self._barrier_waiting
+                            and gen not in self._diagnosed_gens
+                            and now - since > self.stall_timeout):
+                        self._diagnosed_gens.add(gen)
+                        stalled.append(
+                            (gen, list(self._barrier_waiting[gen]),
+                             now - since))
+            for gen, ranks, age in stalled:
+                missing = sorted(set(range(self.slave_num)) - set(ranks))
+                self._log("M", "WARN",
+                          f"barrier gen {gen} stalled for {age:.1f}s: "
+                          f"ranks {sorted(ranks)} waiting on ranks "
+                          f"{missing}")
+                for line in self.diagnose():
+                    self._log("M", "WARN", line)
 
     def _barrier(self, rank: int, gen: int, ch: Channel):
         release = False
         with self._lock:
             waiting = self._barrier_waiting.setdefault(gen, [])
+            self._barrier_since.setdefault(gen, time.monotonic())
             waiting.append(rank)
             if len(waiting) == self.slave_num:
                 release = True
@@ -174,6 +327,7 @@ class Master:
                 c.send_obj(("barrier_release", gen))
             with self._lock:
                 del self._barrier_waiting[gen]
+                self._barrier_since.pop(gen, None)
 
 
 def main(argv=None) -> int:

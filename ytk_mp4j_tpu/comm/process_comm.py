@@ -166,6 +166,11 @@ class ProcessCommSlave(CommSlave):
         # after rendezvous the master channel is fail-stop (barrier
         # waits are unbounded by design, see barrier())
         self._master.set_timeout(None)
+        # all further master-channel sends share one lock: the
+        # heartbeat thread interleaving frame bytes with a barrier or
+        # log send would corrupt the control plane
+        self._master_lock = threading.Lock()
+        self._comm_stats.rank = self._rank  # tags spans + heartbeats
 
         # peer channels: canonical rule — the HIGHER rank connects to the
         # lower rank's listen socket; one duplex channel per pair.
@@ -181,6 +186,20 @@ class ProcessCommSlave(CommSlave):
             max_workers=1, thread_name_prefix=f"mp4j-send-r{self._rank}")
         self._barrier_gen = 0
         self._closed = False
+        # telemetry heartbeat (control plane only — never touches the
+        # peer data channels, so it cannot block a collective): ships
+        # {progress, stats} to the master every MP4J_HEARTBEAT_SECS
+        # (0 disables), feeding the cluster skew table and giving hang
+        # diagnosis a last-known position for THIS rank even when it is
+        # the one that stalls
+        self._hb_stop = threading.Event()
+        self._hb_secs = tuning.heartbeat_secs()
+        self._hb_thread: threading.Thread | None = None
+        if self._hb_secs > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"mp4j-hb-r{self._rank}")
+            self._hb_thread.start()
 
     # ------------------------------------------------------------------
     # identity / control plane
@@ -193,16 +212,24 @@ class ProcessCommSlave(CommSlave):
     def slave_num(self) -> int:
         return self._n
 
+    def _master_send(self, obj) -> None:
+        """Serialized master-channel send (shared by the caller's
+        control messages and the heartbeat thread)."""
+        with self._master_lock:
+            if self._closed:
+                raise Mp4jError("slave is closed")
+            self._master.send_obj(obj)
+
     def info(self, msg: str) -> None:
-        self._master.send_obj((master_mod.LOG, {"level": "INFO", "msg": msg}))
+        self._master_send((master_mod.LOG, {"level": "INFO", "msg": msg}))
 
     def error(self, msg: str) -> None:
-        self._master.send_obj((master_mod.LOG, {"level": "ERROR", "msg": msg}))
+        self._master_send((master_mod.LOG, {"level": "ERROR", "msg": msg}))
 
     def barrier(self) -> None:
         gen = self._barrier_gen
         self._barrier_gen += 1
-        self._master.send_obj((master_mod.BARRIER, {"gen": gen}))
+        self._master_send((master_mod.BARRIER, {"gen": gen}))
         # the release waits on the slowest rank indefinitely — the
         # reference's fail-stop contract, not a missing timeout
         # mp4j-lint: disable=R2 (fail-stop barrier wait)
@@ -210,11 +237,50 @@ class ProcessCommSlave(CommSlave):
         if reply != ("barrier_release", gen):
             raise Mp4jError(f"barrier protocol violation: {reply!r}")
 
+    # -- telemetry (control plane only) --------------------------------
+    def _telemetry_payload(self) -> dict:
+        return {"progress": self._comm_stats.progress(),
+                "stats": self._comm_stats.snapshot()}
+
+    def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                self._master_send(
+                    (master_mod.TELEMETRY, self._telemetry_payload()))
+            except (Mp4jError, OSError):
+                return  # closed or master gone; telemetry is best-effort
+            if self._hb_stop.wait(self._hb_secs):
+                return
+
+    def _on_collective_error(self, name: str, exc: BaseException) -> None:
+        """Fired by trace.traced when an outermost collective raises:
+        best-effort DIAGNOSE to the master, which logs the cluster-wide
+        hang diagnosis (who is behind the max sequence number, where,
+        how stale) instead of leaving a bare per-rank Mp4jError."""
+        try:
+            self._master_send((master_mod.DIAGNOSE, {
+                "collective": name, "error": repr(exc)[:300],
+                "progress": self._comm_stats.progress(),
+                "stats": self._comm_stats.snapshot()}))
+        except (Mp4jError, OSError):
+            pass  # diagnosis is best-effort; the original exc surfaces
+
     def close(self, code: int = 0) -> None:
         if self._closed:
             return
-        self._closed = True
-        self._master.send_obj((master_mod.CLOSE, {"code": code}))
+        self._hb_stop.set()
+        with self._master_lock:
+            if self._closed:
+                return
+            # final telemetry flush so the master's skew table covers
+            # the whole run, then the close handshake
+            try:
+                self._master.send_obj(
+                    (master_mod.TELEMETRY, self._telemetry_payload()))
+            except (Mp4jError, OSError):
+                pass  # master may already be gone; close proceeds
+            self._closed = True
+            self._master.send_obj((master_mod.CLOSE, {"code": code}))
         try:
             self._master.recv()  # "closed" ack
         except Mp4jError:
@@ -232,6 +298,13 @@ class ProcessCommSlave(CommSlave):
         Always on; phase seconds are busy times and may overlap in wall
         time (pipelining is the point)."""
         return self._comm_stats.snapshot()
+
+    def progress(self) -> dict:
+        """This rank's telemetry progress record — the per-slave
+        collective sequence number plus current/last collective and
+        phase (schema: :mod:`ytk_mp4j_tpu.obs.telemetry`). The same
+        record the heartbeat ships to the master."""
+        return self._comm_stats.progress()
 
     # ------------------------------------------------------------------
     # peer transport
@@ -266,6 +339,7 @@ class ProcessCommSlave(CommSlave):
                     continue
                 ch.set_timeout(self._peer_timeout)
                 ch.stats = self._comm_stats  # peer channels book wire time
+                ch.peer_rank = peer_rank     # tags wire spans
                 self._peers[peer_rank] = ch
                 self._peer_cv.notify_all()
 
@@ -287,6 +361,7 @@ class ProcessCommSlave(CommSlave):
                 ch.send_obj(self._rank)
                 ch.set_timeout(self._peer_timeout)
                 ch.stats = self._comm_stats  # peer channels book wire time
+                ch.peer_rank = peer          # tags wire spans
                 self._peers[peer] = ch
                 self._peer_cv.notify_all()
                 return ch
@@ -368,7 +443,8 @@ class ProcessCommSlave(CommSlave):
         self._comm_stats.add_wire(
             0 if sarr is None else sarr.nbytes,
             0 if rarr is None else rarr.nbytes,
-            time.perf_counter() - t0, chunks=1)
+            time.perf_counter() - t0, chunks=1,
+            peer=recv_peer if rarr is not None else send_peer)
 
     def _recv_buf(self, operand: Operand, n: int) -> np.ndarray:
         """A pooled scratch buffer (give back via ``_give_buf`` after

@@ -93,6 +93,10 @@ class ThreadCommSlave(CommSlave):
                 raise Mp4jError("master_port required with master_host")
             proc = ProcessCommSlave(master_host, master_port, **proc_kwargs)
         g = _ThreadGroup(thread_num, proc)
+        # intra-process spans (shared-memory merges) land on the
+        # process rank's timeline track; per-thread tids distinguish
+        # the threads within it
+        g.comm_stats.rank = proc.rank if proc is not None else 0
         return [cls(g, t) for t in range(thread_num)]
 
     # ------------------------------------------------------------------
@@ -157,6 +161,20 @@ class ThreadCommSlave(CommSlave):
         if self._g.proc is not None:
             snaps.append(self._g.proc.stats())
         return merge_snapshots(*snaps)
+
+    def progress(self) -> dict:
+        """The group's telemetry progress record (schema:
+        obs.telemetry). ``seq`` counts outermost collective calls
+        across ALL threads of the group — a per-group, still
+        monotonically increasing, sequence number."""
+        return self._g.comm_stats.progress()
+
+    def _on_collective_error(self, name: str, exc: BaseException) -> None:
+        """Forward a failed collective to the process slave's DIAGNOSE
+        path so the master's hang diagnosis also covers hybrid jobs."""
+        if self._g.proc is not None:
+            self._g.proc._on_collective_error(
+                f"{name}[t{self._tr}]", exc)
 
     def close(self, code: int = 0) -> None:
         """Close the process-level connection (idempotent; safe to call

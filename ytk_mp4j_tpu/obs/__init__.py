@@ -1,0 +1,18 @@
+"""mp4j-scope — cluster-wide observability (ISSUE 3).
+
+Three layers on top of the PR-2 measurement substrate:
+
+- :mod:`ytk_mp4j_tpu.obs.spans` — a bounded in-process span ring fed by
+  the always-on :class:`~ytk_mp4j_tpu.utils.stats.CommStats` phase
+  counters and the ``trace.traced`` collective wrappers; exported as
+  Chrome-trace/Perfetto JSON (``trace.export_chrome_trace``).
+- :mod:`ytk_mp4j_tpu.obs.telemetry` — pure functions over per-rank
+  telemetry: heartbeat progress records, cross-rank skew aggregation
+  (``cluster_skew``) and hang diagnosis rendering
+  (``render_diagnosis``). The master (``comm/master.py``) is the stateful
+  consumer; this module deliberately imports nothing from ``comm`` so
+  the CLI and the master share one implementation without a cycle.
+- :mod:`ytk_mp4j_tpu.obs.cli` — the ``mp4j-scope`` CLI: merge per-rank
+  Chrome-trace files into one timeline; render the cross-rank skew
+  table from per-rank ``comm.stats()`` JSON dumps.
+"""

@@ -1,0 +1,7 @@
+"""``python -m ytk_mp4j_tpu.obs`` — the mp4j-scope CLI."""
+
+import sys
+
+from ytk_mp4j_tpu.obs.cli import main
+
+sys.exit(main())

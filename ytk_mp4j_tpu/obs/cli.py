@@ -1,0 +1,87 @@
+"""``mp4j-scope`` — cluster telemetry CLI.
+
+Usage::
+
+    mp4j-scope merge -o merged.json rank0.json rank1.json ...
+    mp4j-scope report [--json] stats0.json stats1.json ...
+    python -m ytk_mp4j_tpu.obs report ...
+
+``merge`` combines per-rank Chrome-trace exports
+(``trace.export_chrome_trace`` output, one file per rank) into a single
+timeline loadable in ``chrome://tracing`` / Perfetto — ranks keep
+distinct ``pid`` tracks.
+
+``report`` renders the cross-rank skew table (per-collective
+min/median/max busy time, bytes, straggler ranks) from per-rank
+``comm.stats()`` JSON dumps. Each input file holds either one rank's
+snapshot (``{collective: {...}}``, rank taken from the argument order)
+or an explicit ``{"rank": N, "stats": {...}}`` wrapper.
+
+Exit codes: 0 ok, 2 bad invocation / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ytk_mp4j_tpu.obs import spans, telemetry
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mp4j-scope",
+        description="cluster-wide mp4j telemetry: timeline merge + "
+                    "cross-rank skew report")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    mg = sub.add_parser("merge", help="merge per-rank Chrome-trace "
+                                      "files into one timeline")
+    mg.add_argument("-o", "--out", required=True,
+                    help="output trace-event JSON path")
+    mg.add_argument("traces", nargs="+", help="per-rank trace files")
+
+    rp = sub.add_parser("report", help="cross-rank skew table from "
+                                       "per-rank comm.stats() dumps")
+    rp.add_argument("--json", action="store_true",
+                    help="emit the skew as JSON instead of a table")
+    rp.add_argument("stats", nargs="+", help="per-rank stats JSON files")
+    return ap
+
+
+def _load_rank_stats(paths: list[str]) -> dict[int, dict]:
+    per_rank: dict[int, dict] = {}
+    for i, p in enumerate(paths):
+        with open(p, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if isinstance(doc, dict) and "stats" in doc and "rank" in doc:
+            per_rank[int(doc["rank"])] = doc["stats"]
+        elif isinstance(doc, dict):
+            per_rank[i] = doc
+        else:
+            raise ValueError(f"{p}: not a stats snapshot")
+    return per_rank
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        if args.cmd == "merge":
+            n = spans.merge_chrome_traces(args.out, args.traces)
+            print(f"mp4j-scope: merged {n} events from "
+                  f"{len(args.traces)} file(s) into {args.out}")
+            return 0
+        skew = telemetry.cluster_skew(_load_rank_stats(args.stats))
+        if args.json:
+            print(json.dumps(skew, sort_keys=True))
+        else:
+            print(telemetry.format_skew(skew))
+        return 0
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"mp4j-scope: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

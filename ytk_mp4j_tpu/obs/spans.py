@@ -1,0 +1,154 @@
+"""Bounded span ring + Chrome-trace export.
+
+Every phase event the always-on :class:`~ytk_mp4j_tpu.utils.stats.
+CommStats` books (wire/reduce/serialize, at chunk granularity) and
+every outermost collective call the ``trace.traced`` wrapper times is
+also appended here as a *span*: ``(name, category, start, duration,
+rank, thread, args)``. The ring is bounded (``MP4J_SPAN_RING`` entries,
+default 65536; 0 disables) so a long job keeps a sliding window of the
+most recent activity at a fixed memory cost, and appending is one
+O(1) ``deque.append`` — cheap enough to stay default-on.
+
+:func:`export_chrome_trace` renders the ring as trace-event JSON
+(``{"traceEvents": [...]}``, complete-event ``"ph": "X"`` records with
+``ts``/``dur`` in microseconds, ``pid`` = mp4j rank, ``tid`` = a small
+per-process thread id), loadable in ``chrome://tracing`` or Perfetto.
+Multi-process jobs export one file per rank; ``mp4j-scope merge``
+combines them into a single timeline (ranks keep distinct pids).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any
+
+from ytk_mp4j_tpu.utils import tuning
+
+_lock = threading.Lock()
+# Trace timebase: spans are recorded in perf_counter time (cheap,
+# monotone) but EXPORTED anchored to the wall clock — perf_counter
+# epochs are per-process, so independently launched ranks would
+# otherwise shift by their launch skew in a merged timeline. Residual
+# cross-host skew is whatever NTP leaves (ms-scale), fine for eyeballs.
+_epoch = time.perf_counter()
+_epoch_wall = time.time()
+_capacity = tuning.span_ring_capacity()
+_ring: collections.deque = collections.deque(maxlen=max(_capacity, 1))
+_enabled = _capacity > 0
+_tids: dict[int, int] = {}        # thread ident -> small stable tid
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(capacity: int) -> None:
+    """Resize (and clear) the ring; 0 disables recording. Mainly for
+    tests and embedding applications — jobs configure via
+    ``MP4J_SPAN_RING``."""
+    global _ring, _capacity, _enabled
+    with _lock:
+        _capacity = capacity
+        _enabled = capacity > 0
+        _ring = collections.deque(maxlen=max(capacity, 1))
+
+
+def clear() -> None:
+    with _lock:
+        _ring.clear()
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    tid = _tids.get(ident)
+    if tid is None:
+        with _lock:
+            tid = _tids.setdefault(ident, len(_tids))
+    return tid
+
+
+def record(name: str, cat: str, t0: float, dur: float,
+           pid: int | None, args: dict[str, Any] | None = None) -> None:
+    """Append one complete span (``t0`` in ``time.perf_counter``
+    seconds). Bounded ring: the oldest span falls off when full."""
+    if not _enabled:
+        return
+    _ring.append((name, cat, t0, dur, pid or 0, _tid(), args))
+
+
+def phase(name: str, seconds: float, pid: int | None, collective: str,
+          seq: int, **extra) -> None:
+    """A phase span (wire/reduce/serialize) booked after the fact: the
+    caller measured ``seconds`` ending now, so the span's start is
+    reconstructed as ``now - seconds``."""
+    if not _enabled:
+        return
+    end = time.perf_counter()
+    args: dict[str, Any] = {"collective": collective, "seq": seq}
+    for k, v in extra.items():
+        if v is not None:
+            args[k] = v
+    _ring.append((name, "phase", end - seconds, seconds, pid or 0,
+                  _tid(), args))
+
+
+def collective(name: str, t0: float, dur: float, pid: int | None,
+               seq: int) -> None:
+    """The outermost collective-call span (emitted by trace.traced)."""
+    if not _enabled:
+        return
+    _ring.append((name, "collective", t0, dur, pid or 0, _tid(),
+                  {"seq": seq}))
+
+
+def snapshot() -> list[tuple]:
+    with _lock:
+        return list(_ring)
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write the ring as trace-event JSON; returns the event count.
+
+    Events are globally sorted by start time, so ``ts`` is monotone
+    non-decreasing on every (pid, tid) track — the invariant the tier-1
+    schema test asserts and Perfetto's importer expects.
+    """
+    events = []
+    for name, cat, t0, dur, pid, tid, args in sorted(
+            snapshot(), key=lambda s: s[2]):
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": round((t0 - _epoch + _epoch_wall) * 1e6, 3),
+            "dur": round(dur * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    return len(events)
+
+
+def merge_chrome_traces(out_path: str, in_paths: list[str]) -> int:
+    """Merge per-rank Chrome-trace files into one timeline (ranks keep
+    their pids; events re-sorted by ``ts`` so every track stays
+    monotone). Accepts both the object form (``{"traceEvents": [...]}``)
+    and the bare-array form of the trace-event format."""
+    merged: list[dict] = []
+    for p in in_paths:
+        with open(p, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        events = doc["traceEvents"] if isinstance(doc, dict) else doc
+        merged.extend(events)
+    merged.sort(key=lambda e: (e.get("ts", 0)))
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, fh)
+    return len(merged)

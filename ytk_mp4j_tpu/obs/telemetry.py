@@ -1,0 +1,150 @@
+"""Cluster telemetry: heartbeat schema, cross-rank skew, hang diagnosis.
+
+Pure functions over per-rank telemetry records — the stateful consumers
+are the master (``comm/master.py``, live heartbeat table) and the
+``mp4j-scope`` CLI (post-hoc per-rank ``comm.stats()`` dumps); both
+share this implementation. Deliberately imports nothing from ``comm``.
+
+Heartbeat schema (one ``TELEMETRY`` message, slave -> master)::
+
+    {"progress": {"seq": int,          # collectives ENTERED so far
+                  "current": str|None, # collective in flight, if any
+                  "last": str|None,    # last collective completed
+                  "phase": str|None,   # last phase booked (wire/...)
+                  "current_secs": float},  # time inside `current`
+     "stats": {collective: {calls, bytes_sent, bytes_recv, chunks,
+                            wire_seconds, reduce_seconds,
+                            serialize_seconds}}}
+
+``seq`` is the per-slave monotonically increasing collective sequence
+number (bumped by ``CommStats.begin`` on every outermost collective
+call), the quantity hang diagnosis compares across ranks: in a correct
+SPMD schedule every rank runs the same collective sequence, so a rank
+whose ``seq`` trails the cluster maximum is the rank everyone else is
+waiting for.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+_PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
+
+
+def busy_seconds(entry: dict[str, float]) -> float:
+    """A rank's total busy time for one collective family (phase times
+    are busy, possibly overlapping, times — see utils.stats)."""
+    return float(sum(entry.get(p, 0.0) for p in _PHASES))
+
+
+def cluster_skew(per_rank: dict[int, dict[str, dict[str, float]]]
+                 ) -> dict[str, dict]:
+    """Cross-rank skew per collective family.
+
+    ``per_rank`` maps rank -> ``comm.stats()`` snapshot. Returns, per
+    collective name seen on any rank::
+
+        {"ranks": int,                 # ranks reporting this family
+         "calls": int,                 # max calls any rank made
+         "bytes": int,                 # total wire bytes, all ranks
+         "busy_min"/"busy_median"/"busy_max": float,
+         "stragglers": [rank, ...]}    # ranks at busy_max (ties kept)
+
+    A straggler here is the rank spending the most busy time in the
+    family — on a balanced workload that is noise, on a skewed one it
+    names who the other ranks waited for.
+    """
+    names: set[str] = set()
+    for snap in per_rank.values():
+        names.update(snap)
+    out: dict[str, dict] = {}
+    for name in names:
+        rows = {r: snap[name] for r, snap in per_rank.items()
+                if name in snap}
+        busys = {r: busy_seconds(e) for r, e in rows.items()}
+        bmax = max(busys.values())
+        out[name] = {
+            "ranks": len(rows),
+            "calls": int(max(e.get("calls", 0) for e in rows.values())),
+            "bytes": int(sum(e.get("bytes_sent", 0)
+                             + e.get("bytes_recv", 0)
+                             for e in rows.values())),
+            "busy_min": min(busys.values()),
+            "busy_median": statistics.median(busys.values()),
+            "busy_max": bmax,
+            "stragglers": sorted(r for r, b in busys.items()
+                                 if b >= bmax and bmax > 0),
+        }
+    return out
+
+
+def format_skew(skew: dict[str, dict]) -> str:
+    """Human-readable skew table (the ``mp4j-scope report`` view)."""
+    if not skew:
+        return "(no telemetry)"
+    w = max(len(n) for n in skew)
+    lines = [f"{'collective':<{w}}  ranks  calls      MB  "
+             f"busy min/med/max (s)  stragglers"]
+    for name in sorted(skew):
+        s = skew[name]
+        lines.append(
+            f"{name:<{w}}  {s['ranks']:>5d}  {s['calls']:>5d}  "
+            f"{s['bytes'] / 1e6:>6.2f}  "
+            f"{s['busy_min']:>6.3f}/{s['busy_median']:>6.3f}/"
+            f"{s['busy_max']:>6.3f}  "
+            f"{','.join(map(str, s['stragglers'])) or '-'}")
+    return "\n".join(lines)
+
+
+def render_diagnosis(table: dict[int, dict], slave_num: int) -> list[str]:
+    """Render a hang/straggler diagnosis from the master's heartbeat
+    table.
+
+    ``table`` maps rank -> ``{"seq", "current", "last", "phase",
+    "age"}`` (``age`` = seconds since that rank's last heartbeat
+    arrived). Returns log lines: the cluster's max sequence number,
+    then one line per rank — laggards (seq behind the max) with their
+    lag, where they last were, and how stale their heartbeat is — and a
+    closing line naming the likely stuck rank(s).
+    """
+    if not table:
+        return [f"no telemetry received from any of the {slave_num} "
+                "rank(s) — cannot localize the hang (heartbeats "
+                "disabled? MP4J_HEARTBEAT_SECS=0)"]
+    max_seq = max(t["seq"] for t in table.values())
+    lines = [f"cluster diagnosis: max collective seq {max_seq}, "
+             f"{len(table)}/{slave_num} ranks reporting"]
+    stuck: list[int] = []
+    for rank in range(slave_num):
+        t = table.get(rank)
+        if t is None:
+            stuck.append(rank)
+            lines.append(f"rank {rank}: NO heartbeat ever received")
+            continue
+        lag = max_seq - t["seq"]
+        if t.get("current"):
+            where = (f"stuck in '{t['current']}'"
+                     + (f" (phase {t['phase']})" if t.get("phase")
+                        else "")
+                     + f" for {t.get('current_secs', 0.0):.1f}s")
+        elif t.get("last"):
+            where = f"idle after '{t['last']}'"
+        else:
+            where = "no collective entered yet"
+        mark = f"lag {lag}" if lag > 0 else "up to date"
+        lines.append(
+            f"rank {rank}: seq {t['seq']} ({mark}), {where}; "
+            f"last heartbeat {t.get('age', 0.0):.1f}s ago")
+        if lag > 0:
+            stuck.append(rank)
+    if stuck:
+        lines.append(
+            f"likely stuck rank(s): {', '.join(map(str, stuck))} — "
+            "behind the cluster schedule; the other ranks' bounded "
+            "waits expired waiting for them")
+    else:
+        lines.append(
+            "all reporting ranks are at the same sequence number — "
+            "the stall is inside one collective (rank skew or a dead "
+            "transport), not a mismatched schedule")
+    return lines

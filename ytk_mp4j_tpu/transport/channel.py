@@ -94,17 +94,22 @@ class Channel:
     ``stats`` (optional, set by the owning slave on peer channels) is a
     :class:`ytk_mp4j_tpu.utils.stats.CommStats`; when present the
     channel books wire seconds/bytes and serialize (pickle/zlib)
-    seconds into the current collective's bucket.
+    seconds into the current collective's bucket. ``peer_rank``
+    (likewise set by the owning slave) tags the booked wire spans with
+    the remote rank, so a timeline span reads "wire recv<-2" instead of
+    an anonymous transfer.
     """
 
     # class-level defaults so partially-constructed channels (tests
     # build bare instances around socket stand-ins) still frame
     stats = None
+    peer_rank = None
     _chunk_bytes = tuning.DEFAULT_CHUNK_BYTES
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.stats = None
+        self.peer_rank = None
         self._chunk_bytes = tuning.chunk_bytes()
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -130,7 +135,8 @@ class Channel:
                 "send timed out (peer dead or not draining?)") from None
         if self.stats is not None:
             self.stats.add_wire(sum(len(b) for b in bufs), 0,
-                                time.perf_counter() - t0, chunks=0)
+                                time.perf_counter() - t0, chunks=0,
+                                peer=self.peer_rank)
 
     def set_timeout(self, timeout: float | None) -> None:
         """Transfer timeout, both directions: receives AND sends (a
@@ -157,7 +163,8 @@ class Channel:
                 raise Mp4jError("peer closed connection mid-message")
             got += r
         if self.stats is not None:
-            self.stats.add_wire(0, n, time.perf_counter() - t0, chunks=0)
+            self.stats.add_wire(0, n, time.perf_counter() - t0, chunks=0,
+                                peer=self.peer_rank)
 
     def _recv_exact(self, n: int) -> bytearray:
         out = bytearray(n)

@@ -17,6 +17,14 @@ native exchanges, merge kernels — possibly on helper threads) land on
 the collective that caused them. Events outside any collective land on
 ``"<untracked>"``.
 
+Observability hooks (ISSUE 3): every outermost ``begin`` bumps the
+per-slave monotonically increasing collective **sequence number** the
+cluster hang diagnosis compares across ranks, :meth:`progress` is the
+heartbeat payload the slave ships to the master, and every phase event
+also lands in the bounded span ring (:mod:`ytk_mp4j_tpu.obs.spans`) as
+a chunk-granularity timeline span tagged with its collective and
+sequence number.
+
 Schema of one snapshot entry (all keys always present)::
 
     {"calls": int, "bytes_sent": int, "bytes_recv": int,
@@ -31,6 +39,9 @@ their sum can exceed the collective's wall time.
 from __future__ import annotations
 
 import threading
+import time
+
+from ytk_mp4j_tpu.obs import spans
 
 _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
 _COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks")
@@ -49,41 +60,65 @@ class CommStats:
     bucket); the add methods may be called from any thread — helper
     threads inherit the bucket that was current when the work was
     handed to them via the ``bucket()`` handle.
+
+    ``rank`` (set by the owning slave after rendezvous) tags the span
+    ring's timeline track and the heartbeat's identity; ``None`` (e.g.
+    a standalone thread group) renders as rank 0.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._agg: dict[str, dict[str, float]] = {}
         self._tl = threading.local()
+        self.rank: int | None = None
+        # progress state for the telemetry heartbeat / hang diagnosis
+        self._seq = 0                      # outermost collectives entered
+        self._current: str | None = None   # collective in flight
+        self._current_since = 0.0
+        self._last: str | None = None      # last collective completed
+        self._last_phase: str | None = None
         # helper-thread fallback: pool workers doing wire work on a
         # collective's behalf have no thread-local scope, so the
         # outermost begin also publishes the name here. Concurrent
         # outermost scopes only happen on the thread backend, where the
         # barrier-aligned schedule guarantees they share one name.
         self._shared_name: str | None = None
+        self._shared_seq = 0
         self._shared_depth = 0
 
     # -- attribution ---------------------------------------------------
-    def begin(self, name: str) -> bool:
-        """Enter a collective scope; returns True when this is the
-        outermost scope on the calling thread (the caller must pass
-        that flag back to :meth:`end`)."""
+    def begin(self, name: str) -> int:
+        """Enter a collective scope; returns the (truthy) sequence
+        number when this is the outermost scope on the calling thread,
+        0 for nested scopes (the caller must pass the return value back
+        to :meth:`end`)."""
         depth = getattr(self._tl, "depth", 0)
         self._tl.depth = depth + 1
         if depth == 0:
             self._tl.name = name
             with self._lock:
+                self._seq += 1
+                seq = self._seq
+                self._current = name
+                self._current_since = time.perf_counter()
+                self._last_phase = None  # phase is per-collective: a
+                # rank stuck before booking any phase must not report
+                # the PREVIOUS collective's last phase in its heartbeat
                 self._bucket_locked(name)["calls"] += 1
                 self._shared_name = name
+                self._shared_seq = seq
                 self._shared_depth += 1
-            return True
-        return False
+            self._tl.seq = seq
+            return seq
+        return 0
 
-    def end(self, outermost: bool) -> None:
+    def end(self, outermost: int) -> None:
         self._tl.depth = getattr(self._tl, "depth", 1) - 1
         if outermost:
             self._tl.name = None
             with self._lock:
+                self._last = self._current or self._last
+                self._current = None
                 self._shared_depth -= 1
                 if self._shared_depth <= 0:
                     self._shared_name = None
@@ -92,10 +127,31 @@ class CommStats:
         """The current attribution bucket: this thread's collective
         scope, else the slave's active collective (helper threads),
         else ``"<untracked>"``."""
+        return self._attribution()[0]
+
+    def _attribution(self) -> tuple[str, int]:
+        """(bucket, seq) captured TOGETHER, so a span's seq tag always
+        matches the collective instance it is attributed to — on the
+        shared thread-backend stats another thread's begin() may bump
+        the global seq while this thread's scope is still open."""
         name = getattr(self._tl, "name", None)
         if name is not None:
-            return name
-        return self._shared_name or "<untracked>"
+            return name, getattr(self._tl, "seq", 0)
+        shared = self._shared_name
+        if shared is not None:
+            return shared, self._shared_seq
+        return "<untracked>", self._seq
+
+    def progress(self) -> dict:
+        """The heartbeat progress record (schema: obs.telemetry):
+        sequence number, the collective in flight (and for how long),
+        the last completed collective, and the last phase booked."""
+        with self._lock:
+            current_secs = (time.perf_counter() - self._current_since
+                            if self._current is not None else 0.0)
+            return {"seq": self._seq, "current": self._current,
+                    "last": self._last, "phase": self._last_phase,
+                    "current_secs": current_secs}
 
     # -- recording -----------------------------------------------------
     def _bucket_locked(self, name: str) -> dict[str, float]:
@@ -105,17 +161,39 @@ class CommStats:
         return entry
 
     def add(self, key: str, value: float, bucket: str | None = None) -> None:
+        if bucket is None:
+            name, seq = self._attribution()
+        else:
+            name, seq = bucket, self._seq
+        is_phase = key.endswith("_seconds")
         with self._lock:
-            self._bucket_locked(bucket or self.bucket())[key] += value
+            self._bucket_locked(name)[key] += value
+            if is_phase:
+                self._last_phase = key[:-len("_seconds")]
+        # module-flag guard: with spans disabled (MP4J_SPAN_RING=0) the
+        # hot path pays one attribute read, not a call + kwargs dict
+        if is_phase and spans._enabled:
+            spans.phase(key[:-len("_seconds")], value, self.rank, name,
+                        seq)
 
     def add_wire(self, bytes_sent: int, bytes_recv: int, seconds: float,
-                 chunks: int = 1, bucket: str | None = None) -> None:
+                 chunks: int = 1, bucket: str | None = None,
+                 peer: int | None = None) -> None:
+        if bucket is None:
+            name, seq = self._attribution()
+        else:
+            name, seq = bucket, self._seq
         with self._lock:
-            e = self._bucket_locked(bucket or self.bucket())
+            e = self._bucket_locked(name)
             e["bytes_sent"] += bytes_sent
             e["bytes_recv"] += bytes_recv
             e["wire_seconds"] += seconds
             e["chunks"] += chunks
+            self._last_phase = "wire"
+        if spans._enabled:
+            spans.phase("wire", seconds, self.rank, name, seq,
+                        bytes_sent=bytes_sent or None,
+                        bytes_recv=bytes_recv or None, peer=peer)
 
     # -- reading -------------------------------------------------------
     def snapshot(self) -> dict[str, dict[str, float]]:

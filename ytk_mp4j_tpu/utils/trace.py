@@ -24,34 +24,54 @@ Usage::
 from __future__ import annotations
 
 import functools
+import math
 import threading
 import time
 from typing import Any
 
 import numpy as np
 
+from ytk_mp4j_tpu.obs import spans as _spans
+
 _lock = threading.Lock()
 _enabled = False
 _events: list[tuple[str, float, int]] = []
 
 
-def _payload_bytes(x: Any) -> int:
-    """Best-effort payload size of a collective operand."""
+def _payload_bytes(x: Any, _seen: set[int] | None = None) -> int:
+    """Best-effort payload size of a collective operand.
+
+    Containers (dicts/lists of arrays) count each distinct underlying
+    buffer ONCE: two views sharing a base — e.g. the halves of one
+    scratch array deposited under two dict keys — must not double-count
+    (dedup by ``id(arr.base)``, no O(n^2) ``np.shares_memory`` sweep).
+    Non-numeric scalars (``None``, arbitrary objects, non-numeric numpy
+    scalars) count 0, not a phantom 8.
+    """
     if isinstance(x, np.ndarray):
+        if _seen is not None:
+            base = x.base if isinstance(x.base, np.ndarray) else x
+            if id(base) in _seen:
+                return 0
+            _seen.add(id(base))
         return x.nbytes
+    if isinstance(x, np.generic):
+        return x.nbytes if np.issubdtype(x.dtype, np.number) else 0
+    if isinstance(x, dict):
+        seen = set() if _seen is None else _seen
+        return sum(_payload_bytes(v, seen) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        seen = set() if _seen is None else _seen
+        return sum(_payload_bytes(v, seen) for v in x)
+    if isinstance(x, (bytes, str)):
+        return len(x)
+    if isinstance(x, (int, float, complex)):
+        return 8
     if hasattr(x, "nbytes"):  # jax arrays
         try:
             return int(x.nbytes)
         except Exception:
             return 0
-    if isinstance(x, dict):
-        return sum(_payload_bytes(v) for v in x.values())
-    if isinstance(x, (list, tuple)):
-        return sum(_payload_bytes(v) for v in x)
-    if isinstance(x, (bytes, str)):
-        return len(x)
-    if isinstance(x, (int, float, np.generic)):
-        return 8
     return 0
 
 
@@ -100,29 +120,51 @@ def traced(fn):
     backend's always-on :class:`~ytk_mp4j_tpu.utils.stats.CommStats`
     (when the instance carries one as ``_comm_stats``) so wire/reduce/
     serialize phase events recorded deeper in the stack attribute to
-    the collective that caused them."""
+    the collective that caused them; each OUTERMOST scope also lands as
+    a span in the bounded ring (obs.spans, Chrome-trace exportable) and,
+    on failure, fires the backend's ``_on_collective_error`` hook (the
+    slave ships a DIAGNOSE to the master so a timed-out collective
+    yields a cluster-wide hang diagnosis instead of a bare error)."""
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
         stats = getattr(self, "_comm_stats", None)
         outermost = (stats.begin(fn.__name__)
-                     if stats is not None else False)
-        try:
-            if not _enabled or getattr(_in_collective, "depth", 0) > 0:
-                return fn(self, *args, **kwargs)
-            nbytes = _payload_bytes(args[0]) if args else 0
-            _in_collective.depth = 1
-            t0 = time.perf_counter()
+                     if stats is not None else 0)
+        trace_this = _enabled and getattr(_in_collective, "depth", 0) == 0
+        if not trace_this and not outermost:
             try:
-                out = fn(self, *args, **kwargs)
+                return fn(self, *args, **kwargs)
             finally:
-                _in_collective.depth = 0
-            record(f"{type(self).__name__}.{fn.__name__}",
-                   time.perf_counter() - t0, nbytes)
-            return out
+                if stats is not None:
+                    stats.end(outermost)
+        nbytes = _payload_bytes(args[0]) if (trace_this and args) else 0
+        if trace_this:
+            _in_collective.depth = 1
+        t0 = time.perf_counter()
+        try:
+            out = fn(self, *args, **kwargs)
+        except Exception as e:
+            # hook BEFORE stats.end so the diagnosis payload still sees
+            # the failed collective as `current` (best-effort, only at
+            # the outermost frame — composed collectives report once)
+            if outermost:
+                hook = getattr(self, "_on_collective_error", None)
+                if hook is not None:
+                    hook(fn.__name__, e)
+            raise
         finally:
+            if trace_this:
+                _in_collective.depth = 0
+            dur = time.perf_counter() - t0
+            if outermost:
+                _spans.collective(fn.__name__, t0, dur,
+                                  stats.rank, outermost)
             if stats is not None:
                 stats.end(outermost)
+        if trace_this:
+            record(f"{type(self).__name__}.{fn.__name__}", dur, nbytes)
+        return out
 
     return wrapper
 
@@ -194,19 +236,32 @@ def clear() -> None:
         _events.clear()
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted list."""
+    idx = math.ceil(q * len(sorted_vals)) - 1
+    return sorted_vals[max(0, min(len(sorted_vals) - 1, idx))]
+
+
 def summary() -> dict[str, dict[str, float]]:
     """Aggregate events: per collective name, ``{calls, seconds, bytes,
     gb_per_s}`` (payload bytes over wall time — an effective, not wire,
-    rate)."""
+    rate) plus per-call duration percentiles ``{p50, p95, max}`` in
+    seconds — one straggling call stays visible behind a healthy mean."""
     agg: dict[str, dict[str, float]] = {}
+    durs: dict[str, list[float]] = {}
     for name, sec, nb in events():
         a = agg.setdefault(name, {"calls": 0, "seconds": 0.0, "bytes": 0})
         a["calls"] += 1
         a["seconds"] += sec
         a["bytes"] += nb
-    for a in agg.values():
+        durs.setdefault(name, []).append(sec)
+    for name, a in agg.items():
         a["gb_per_s"] = (a["bytes"] / a["seconds"] / 1e9
                          if a["seconds"] > 0 else 0.0)
+        ds = sorted(durs[name])
+        a["p50"] = _percentile(ds, 0.50)
+        a["p95"] = _percentile(ds, 0.95)
+        a["max"] = ds[-1]
     return agg
 
 
@@ -216,10 +271,21 @@ def format_summary() -> str:
     if not agg:
         return "(no collective events traced)"
     w = max(len(k) for k in agg)
-    lines = [f"{'collective':<{w}}  calls  seconds    MB      GB/s"]
+    lines = [f"{'collective':<{w}}  calls  seconds    MB      GB/s"
+             f"    p50ms    p95ms    maxms"]
     for name in sorted(agg):
         a = agg[name]
         lines.append(
             f"{name:<{w}}  {a['calls']:>5d}  {a['seconds']:>7.4f}  "
-            f"{a['bytes'] / 1e6:>7.2f}  {a['gb_per_s']:>7.3f}")
+            f"{a['bytes'] / 1e6:>7.2f}  {a['gb_per_s']:>7.3f}  "
+            f"{a['p50'] * 1e3:>7.3f}  {a['p95'] * 1e3:>7.3f}  "
+            f"{a['max'] * 1e3:>7.3f}")
     return "\n".join(lines)
+
+
+def export_chrome_trace(path: str) -> int:
+    """Export the span ring (collective + chunk-level wire/reduce/
+    serialize phase spans, always-on — see :mod:`ytk_mp4j_tpu.obs.spans`)
+    as Chrome-trace/Perfetto JSON; returns the event count. One file per
+    process; merge per-rank files with ``mp4j-scope merge``."""
+    return _spans.export_chrome_trace(path)

@@ -27,6 +27,12 @@ Knobs (all validated where they are consumed; garbage raises
 - ``MP4J_SO_SNDBUF`` / ``MP4J_SO_RCVBUF`` — socket buffer sizes applied
   at channel setup (``transport/channel.py``); unset keeps the kernel
   defaults.
+- ``MP4J_HEARTBEAT_SECS`` — period of the slave->master telemetry
+  heartbeat (``comm/process_comm.py``); ``0`` disables heartbeats.
+- ``MP4J_SPAN_RING`` — capacity of the in-process span ring buffer
+  (``obs/spans.py``); ``0`` disables span recording.
+- ``MP4J_LOG_LEVEL`` — minimum level the master's log sink prints
+  (``DEBUG``/``INFO``/``WARN``/``ERROR``).
 """
 
 from __future__ import annotations
@@ -44,6 +50,15 @@ DEFAULT_CHUNK_BYTES = 1024 * 1024
 # core counts / NICs tune via env.
 DEFAULT_ALGO_SMALL_BYTES = 256 * 1024
 DEFAULT_ALGO_LARGE_BYTES = 4 * 1024 * 1024
+# Telemetry defaults: a heartbeat is one ~300-byte control frame per
+# rank per period (off the data plane entirely), and a span is one
+# O(1) deque append — both default-on, both sized so the observability
+# tax stays well under the <2% bench budget (ISSUE 3).
+DEFAULT_HEARTBEAT_SECS = 0.5
+DEFAULT_SPAN_RING = 65536
+
+# Log-level ladder for the master's log sink (MP4J_LOG_LEVEL).
+LOG_LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "ERROR": 40}
 
 
 def env_bytes(name: str, default: int, minimum: int = 1) -> int:
@@ -65,8 +80,50 @@ def env_bytes(name: str, default: int, minimum: int = 1) -> int:
     return val
 
 
+def env_float(name: str, default: float, minimum: float = 0.0) -> float:
+    """A float knob from the environment, validated like
+    :func:`env_bytes`: unset/empty yields ``default``; anything else
+    must parse as a float >= ``minimum`` or setup fails cleanly."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        raise Mp4jError(f"{name}={raw!r} is not a number") from None
+    if val < minimum:
+        raise Mp4jError(f"{name}={val} must be >= {minimum}")
+    return val
+
+
 def chunk_bytes() -> int:
     return env_bytes("MP4J_CHUNK_BYTES", DEFAULT_CHUNK_BYTES, minimum=64)
+
+
+def heartbeat_secs() -> float:
+    """Slave->master telemetry heartbeat period; 0 disables."""
+    return env_float("MP4J_HEARTBEAT_SECS", DEFAULT_HEARTBEAT_SECS,
+                     minimum=0.0)
+
+
+def span_ring_capacity() -> int:
+    """Capacity of the in-process span ring (obs.spans); 0 disables."""
+    return env_bytes("MP4J_SPAN_RING", DEFAULT_SPAN_RING, minimum=0)
+
+
+def log_level() -> str:
+    """The master log sink's minimum level (``MP4J_LOG_LEVEL``),
+    validated against :data:`LOG_LEVELS` — a typo'd level fails master
+    setup cleanly instead of silently printing everything."""
+    raw = os.environ.get("MP4J_LOG_LEVEL")
+    if raw is None or raw.strip() == "":
+        return "INFO"
+    name = raw.strip().upper()
+    if name not in LOG_LEVELS:
+        raise Mp4jError(
+            f"MP4J_LOG_LEVEL={raw!r} is not one of "
+            f"{sorted(LOG_LEVELS)}")
+    return name
 
 
 def algo_thresholds() -> tuple[int, int]:
