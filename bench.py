@@ -131,14 +131,17 @@ def _numpy_histograms(bins, g, h, node_ids, n_nodes, f, b):
     return hg, hh
 
 
-def _run_socket_job(procs, body, native_transport, join_timeout=300.0):
+def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
+                    **slave_kwargs):
     """Master + ``procs`` slave worker PROCESSES; ``body(slave, rank)``
     returns a per-rank result. Returns ``(results, stats)`` where
     ``stats`` is the merged cross-rank ``comm.stats()`` snapshot of the
     whole job (emitted in the BENCH extra so every socket workload's
     wire/reduce/serialize budget is tracked across rounds). Raises the
     first worker error, or a RuntimeError naming the hung ranks if any
-    worker missed the join deadline without raising.
+    worker missed the join deadline without raising. ``slave_kwargs``
+    forward to every ProcessCommSlave (e.g. ``map_columnar=False`` for
+    the pickled-plane A/B leg).
 
     Real OS processes (fork), matching the reference's unit of
     parallelism — N slave JVMs on one host (SURVEY.md section 4). A
@@ -160,7 +163,8 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0):
     def worker():
         try:
             slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
-                                     native_transport=native_transport)
+                                     native_transport=native_transport,
+                                     **slave_kwargs)
             res = body(slave, slave.rank)
             snap = slave.stats()
             slave.close(0)
@@ -517,14 +521,20 @@ def bench_device_map_chained(keys=50_000, chain=8):
     return chain * keys / (time.perf_counter() - t0)
 
 
-def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
+def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False,
+                     columnar=None, join_timeout=120.0):
     """Map<String,Double> sparse-grad allreduce over loopback TCP
-    (BASELINE.md configs[2], the reference's Kryo operand path —
-    pickle-framed here). Returns merged keys/sec.
+    (BASELINE.md configs[2]). Returns merged keys/sec on the job's
+    DEFAULT map plane — since ISSUE 4, the columnar (codes, values)
+    data plane; ``columnar=False`` forces the pickled-dict reference
+    path (the pre-ISSUE-4 Kryo-analogue figure) for the A/B.
 
     ``int_keys=True`` uses {feature id -> value} integer keys — the
-    actual ytk-learn sparse-gradient shape (cheaper to pickle than
-    strings; the merge loop is identical)."""
+    actual ytk-learn sparse-gradient shape. One UNTIMED warmup call
+    precedes the loop: a sparse-gradient stream's vocabulary is
+    near-persistent, so the steady-state rate (codec warm, novelty
+    exchange empty) is the honest per-call figure; the warmup is a
+    no-op for the pickled plane, which keeps no per-call state."""
     from ytk_mp4j_tpu.operands import Operands
     from ytk_mp4j_tpu.operators import Operators
 
@@ -537,8 +547,10 @@ def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
             return c if int_keys else f"w{c}"
         dicts = [
             {key(i): float(i) for i in range(keys)}
-            for _ in range(reps)
+            for _ in range(reps + 1)
         ]
+        slave.allreduce_map(dicts.pop(), Operands.DOUBLE,
+                            Operators.SUM)     # untimed codec warmup
         slave.barrier()
         t0 = time.perf_counter()
         nkeys = 0
@@ -548,8 +560,42 @@ def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False):
         return nkeys / (time.perf_counter() - t0)
 
     rates, stats = _run_socket_job(procs, body, native_transport=False,
-                                   join_timeout=120.0)
+                                   join_timeout=join_timeout,
+                                   map_columnar=columnar)
     return min(rates), stats
+
+
+def bench_socket_map_sweep(procs=4,
+                           sizes=(1_000, 10_000, 100_000, 500_000),
+                           reps=3):
+    """Columnar-vs-pickle A/B over map sizes, int AND str keys — the
+    honest re-run of the old ``_merge_maps`` packed-merge measurement
+    (which paid a per-call union sort + Python pack the grow-only
+    codec amortizes away). Emitted in the BENCH ``extra`` so the
+    crossover threshold is data-grounded, not guessed. Returns
+    ``({"<keys>": {"int"|"str": {"columnar"|"pickle": keys/s}}},
+    merged_stats)``."""
+    from ytk_mp4j_tpu.utils.stats import merge_snapshots
+
+    sweep = {}
+    snaps = []
+    for keys in sizes:
+        # big unions are slow on the pickled leg and the least noisy;
+        # repeat the cheap latency-bound sizes instead
+        r = reps if keys <= 10_000 else 1
+        row = {}
+        for kind, int_keys in (("int", True), ("str", False)):
+            cell = {}
+            for plane, columnar in (("columnar", True),
+                                    ("pickle", False)):
+                rate, stats = bench_socket_map(
+                    procs=procs, keys=keys, reps=r, int_keys=int_keys,
+                    columnar=columnar, join_timeout=600.0)
+                cell[plane] = round(rate, 0)
+                snaps.append(stats)
+            row[kind] = cell
+        sweep[str(keys)] = row
+    return sweep, _round_stats(merge_snapshots(*snaps))
 
 
 def main():
@@ -576,6 +622,13 @@ def main():
     sweep, sweep_stats = bench_socket_allreduce_sweep()
     map_keys, map_stats = bench_socket_map()
     map_int_keys, map_int_stats = bench_socket_map(int_keys=True)
+    # columnar-vs-pickle A/B at the headline config (the pickle legs
+    # are the pre-ISSUE-4 reference figures) + the size sweep that
+    # grounds the crossover claim
+    map_pickle_keys, _ = bench_socket_map(columnar=False)
+    map_int_pickle_keys, _ = bench_socket_map(int_keys=True,
+                                              columnar=False)
+    map_sweep, map_sweep_stats = bench_socket_map_sweep()
     (tpu_gbs, trees_per_sec, n_chips, gbdt_fps,
      gbdt_hist_fps) = bench_tpu(n=n_tpu)
     ffm_steps, ffm_fps = bench_ffm_tpu()
@@ -614,8 +667,18 @@ def main():
                 "ratio lands near vs_baseline/4 (see BASELINE.md) — "
                 "still clearing the >=10x north star, but vs_baseline "
                 "as printed is environment-specific"),
+            # headline map figures ride the DEFAULT socket map plane —
+            # columnar (codes, values) since ISSUE 4; the *_pickle_*
+            # keys are the frozen pickled-dict reference legs of the
+            # same config, and socket_map_allreduce_sweep carries the
+            # full columnar-vs-pickle A/B over 1k..500k keys x
+            # {int, str} so the crossover is measured, not guessed
             "socket_map_allreduce_keys_per_sec": round(map_keys, 0),
             "socket_map_int_allreduce_keys_per_sec": round(map_int_keys, 0),
+            "socket_map_pickle_keys_per_sec": round(map_pickle_keys, 0),
+            "socket_map_int_pickle_keys_per_sec": round(
+                map_int_pickle_keys, 0),
+            "socket_map_allreduce_sweep": map_sweep,
             # merged cross-rank comm.stats() snapshot per socket
             # workload: where the wire/reduce/serialize budget actually
             # went (schema: ytk_mp4j_tpu/utils/stats.py)
@@ -626,6 +689,7 @@ def main():
                 "allreduce_sweep": sweep_stats,
                 "map_allreduce": map_stats,
                 "map_int_allreduce": map_int_stats,
+                "map_sweep": map_sweep_stats,
             },
             # telemetry overhead (ISSUE 3 acceptance, qualitative): the
             # spans + heartbeats are DEFAULT-ON in every socket figure

@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 9)]
+    assert ids == [f"R{i}" for i in range(1, 10)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -530,3 +530,78 @@ def test_r8_inline_suppression():
     """)
     assert not r.findings
     assert len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R9 — pickled dict payload on a collective map path
+# ----------------------------------------------------------------------
+def test_r9_fires_on_dict_send_in_map_function():
+    r = run_rule("R9", """
+        def reduce_map(self, d, operand, operator, root):
+            acc = dict(d)
+            self._send(0, acc, compress=operand.compress)
+    """)
+    [f] = r.findings
+    assert f.rule == "R9" and f.line == 4
+    assert "codes" in f.message
+
+
+def test_r9_fires_on_parameter_and_subscript_payloads():
+    r = run_rule("R9", """
+        def broadcast_map(self, d):
+            self._send(1, d)
+
+        def scatter_map(self, shares, peer):
+            self._send(peer, shares[peer])
+    """)
+    assert [f.line for f in r.findings] == [3, 6]
+
+
+def test_r9_clean_on_columnar_and_header_sends():
+    # the columnar plane's real shape: tuple negotiation headers plus
+    # paired column frames — neither is a pickled dict payload
+    r = run_rule("R9", """
+        def allreduce_map(self, d, operand, operator):
+            header = (True, "int", (), [])
+            self._send(0, header)
+            self._channel(1).send_map_columns(codes, vals)
+            self._send_map_columns(2, cols, operand)
+    """)
+    assert not r.findings
+
+
+def test_r9_scoped_to_map_functions_in_comm():
+    src = """
+        def reduce_map(self, d):
+            self._send(0, dict(d))
+    """
+    assert not run_rule("R9", src,
+                        path="ytk_mp4j_tpu/models/snippet.py").findings
+    # non-map collectives may pickle freely (lists, control tuples)
+    r = run_rule("R9", """
+        def allreduce_array(self, d):
+            self._send(0, dict(d))
+    """)
+    assert not r.findings
+
+
+def test_r9_inline_suppression_and_baseline():
+    src = """
+        def gather_map(self, d, root):
+            # mp4j-lint: disable=R9 (sanctioned fallback)
+            self._send(root, d)
+    """
+    r = run_rule("R9", src)
+    assert not r.findings and len(r.suppressed) == 1
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R9"
+        file = "ytk_mp4j_tpu/comm/snippet.py"
+        context = "gather_map"
+        reason = "negotiated fallback"
+    """))
+    r = run_rule("R9", """
+        def gather_map(self, d, root):
+            self._send(root, d)
+    """, baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1

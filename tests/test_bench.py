@@ -75,3 +75,23 @@ def test_bench_socket_allreduce_sweep_smoke():
         for rate in row.values():
             assert np.isfinite(rate) and rate > 0
     _check_socket_stats(stats)
+
+
+def test_bench_socket_map_sweep_smoke():
+    sweep, stats = bench.bench_socket_map_sweep(procs=2, sizes=(40,),
+                                                reps=1)
+    assert set(sweep) == {"40"}
+    for kind in ("int", "str"):
+        cell = sweep["40"][kind]
+        assert set(cell) == {"columnar", "pickle"}
+        for rate in cell.values():
+            assert np.isfinite(rate) and rate > 0
+    _check_socket_stats(stats)
+
+
+def test_bench_socket_map_pickle_leg_smoke():
+    rate, stats = bench.bench_socket_map(procs=2, keys=50, reps=1,
+                                         columnar=False)
+    assert np.isfinite(rate) and rate > 0
+    # the forced-pickle leg must not touch the columnar encoder
+    assert all(e.get("keys", 0) == 0 for e in stats.values())

@@ -1,0 +1,434 @@
+"""Columnar socket map plane (ISSUE 4): bit-exactness against the
+pickled-dict reference path across operand dtypes x operators x key
+kinds x map shapes x non-power-of-2 rank counts, vocabulary-sync
+invariants, negotiated fallbacks, duplicate-key naming, and analytic
+``comm.stats()`` wire-byte accounting.
+
+The bit-exactness contract: both planes apply ``operator.np_fn`` with
+identical operand order per key (``op(acc, src)`` up the same binomial
+tree), so for dtype-typed values the results must match byte for byte
+— not just approximately (see ops/sparse.py host-twin section)."""
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.operands import Operand, Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.ops import sparse as sparse_ops
+
+from helpers import run_slaves
+
+NUMERIC_OPERANDS = [op for op in Operands.NUMERIC if op is not None]
+OPERATORS = ["SUM", "PROD", "MAX", "MIN"]
+
+
+def make_values(operand, rng, n):
+    """Values typed to the operand dtype — the columnar plane computes
+    in the declared dtype (like the device path), so dtype-typed
+    inputs are the bit-exactness regime. Small positive ints keep PROD
+    finite on the narrow dtypes."""
+    if operand.dtype.kind == "f" or operand.dtype.kind == "V":
+        vals = rng.standard_normal(n)
+    else:
+        vals = rng.integers(1, 4, n)
+    return [operand.dtype.type(v) for v in vals]
+
+
+def make_maps(n_ranks, operand, rng, n_keys=60, fill=0.6, key=str):
+    maps = []
+    for _ in range(n_ranks):
+        ks = [key(k) for k in rng.integers(0, int(n_keys / fill), n_keys)]
+        maps.append(dict(zip(ks, make_values(operand, rng, n_keys))))
+    return maps
+
+
+def run_plane(maps, columnar, call, n=None, **slave_kwargs):
+    """Run ``call(slave, dict(maps[rank]))`` on every rank of a socket
+    job pinned to one map plane; returns (per-rank dicts, per-rank
+    stats snapshots)."""
+    n = len(maps) if n is None else n
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        call(slave, d)
+        return d, slave.stats()
+
+    out = run_slaves(n, fn, map_columnar=columnar, **slave_kwargs)
+    return [d for d, _ in out], [s for _, s in out]
+
+
+def assert_bit_identical(a: dict, b: dict):
+    assert set(a) == set(b)
+    for k in a:
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        assert va.dtype == vb.dtype, (k, va.dtype, vb.dtype)
+        assert va.shape == vb.shape, k
+        assert va.tobytes() == vb.tobytes(), (k, a[k], b[k])
+
+
+def assert_planes_agree(maps, call, n=None):
+    col, col_stats = run_plane(maps, True, call, n=n)
+    pkl, _ = run_plane(maps, False, call, n=n)
+    for dc, dp in zip(col, pkl):
+        assert_bit_identical(dc, dp)
+    return col, col_stats
+
+
+# ------------------------------------------------- the full dtype x op grid
+@pytest.mark.parametrize("operand", NUMERIC_OPERANDS,
+                         ids=lambda o: o.name)
+@pytest.mark.parametrize("op", OPERATORS)
+def test_allreduce_bit_identical_across_dtypes_and_ops(operand, op, rng):
+    operator = Operators.by_name(op)
+    maps = make_maps(3, operand, rng)   # 3: non-power-of-2
+
+    def call(slave, d):
+        slave.allreduce_map(d, operand, operator)
+
+    assert_planes_agree(maps, call)
+
+
+@pytest.mark.parametrize("key,kind", [
+    (lambda k: int(k), "int"),
+    (lambda k: f"w{k}", "str"),
+    (lambda k: np.int64(k), "np-int"),
+    (lambda k: bool(k % 2), "bool-obj"),   # bool is an OBJ key by rule
+])
+def test_allreduce_key_kinds(key, kind, rng):
+    maps = make_maps(4, Operands.DOUBLE, rng, n_keys=40, key=key)
+
+    def call(slave, d):
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+    assert_planes_agree(maps, call)
+
+
+@pytest.mark.parametrize("shape", ["empty", "some-empty", "disjoint",
+                                   "overlap"])
+def test_allreduce_map_shapes(shape, rng):
+    n = 5   # non-power-of-2, exercises the fold-free binomial tree
+    if shape == "empty":
+        maps = [{} for _ in range(n)]
+    elif shape == "some-empty":
+        maps = make_maps(n, Operands.DOUBLE, rng, n_keys=25)
+        maps[0] = {}
+        maps[3] = {}
+    elif shape == "disjoint":
+        maps = [{r * 1000 + i: float(i) for i in range(30)}
+                for r in range(n)]
+    else:   # fully overlapping key sets
+        vals = [make_values(Operands.DOUBLE, rng, 30) for _ in range(n)]
+        maps = [dict(zip(range(30), v)) for v in vals]
+
+    def call(slave, d):
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+    assert_planes_agree(maps, call)
+
+
+@pytest.mark.parametrize("collective",
+                         ["reduce", "broadcast", "scatter", "gather",
+                          "reduce_scatter", "allgather"])
+def test_full_family_bit_identical(collective, rng):
+    n = 3
+    if collective in ("gather", "allgather"):
+        maps = [{r * 100 + i: float(r + i) for i in range(12)}
+                for r in range(n)]   # disjoint, per the contract
+    else:
+        maps = make_maps(n, Operands.DOUBLE, rng, n_keys=35)
+
+    def call(slave, d):
+        if collective == "reduce":
+            slave.reduce_map(d, Operands.DOUBLE, Operators.SUM, root=2)
+        elif collective == "broadcast":
+            slave.broadcast_map(d, Operands.DOUBLE, root=1)
+        elif collective == "scatter":
+            slave.scatter_map(d, Operands.DOUBLE, root=0)
+        elif collective == "gather":
+            slave.gather_map(d, Operands.DOUBLE, root=1)
+        elif collective == "reduce_scatter":
+            slave.reduce_scatter_map(d, Operands.DOUBLE, Operators.SUM)
+        else:
+            slave.allgather_map(d, Operands.DOUBLE)
+
+    assert_planes_agree(maps, call)
+
+
+def test_vector_values_and_compressed_operand(rng):
+    maps = [{f"e{i}": rng.standard_normal(4) for i in range(10 + r)}
+            for r in range(3)]
+    operand = Operands.compressed(Operands.DOUBLE)
+
+    def call(slave, d):
+        slave.allreduce_map(d, operand, Operators.SUM)
+
+    assert_planes_agree(maps, call)
+
+
+# ---------------------------------------------------- vocabulary invariants
+def test_vocab_identical_across_ranks_and_calls(rng):
+    """The sync invariant: after any sequence of columnar collectives
+    with drifting key sets, every rank holds byte-identical code->key
+    tables — and later calls reuse codes (novelty exchange empty)."""
+    batches = [make_maps(3, Operands.DOUBLE, rng, n_keys=20 + 10 * s,
+                         key=lambda k: f"f{k}") for s in range(4)]
+
+    def fn(slave, r):
+        outs = []
+        for maps in batches:
+            d = dict(maps[r])
+            slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+            outs.append(d)
+        codec = slave._map_codecs["obj"]
+        return outs, list(codec._by_code)
+
+    res = run_slaves(3, fn, map_columnar=True)
+    vocab0 = res[0][1]
+    assert all(vocab == vocab0 for _, vocab in res)
+    # the vocabulary is the union of every key ever seen, grown once
+    every_key = set()
+    for maps in batches:
+        for m in maps:
+            every_key |= set(m)
+    assert set(vocab0) == every_key and len(vocab0) == len(every_key)
+    # and the results still match the pickled plane per batch
+    for b, maps in enumerate(batches):
+        pkl, _ = run_plane(maps, False, lambda s, d: s.allreduce_map(
+            d, Operands.DOUBLE, Operators.SUM))
+        for r in range(3):
+            assert_bit_identical(res[r][0][b], pkl[r])
+
+
+# ------------------------------------------------------ negotiated fallback
+def test_fallback_object_values(rng):
+    """Complex values under a DOUBLE operand cannot pack into the
+    float64 column — the negotiation must divert every rank to the
+    pickled plane, which still merges them (np.add handles complex)."""
+    maps = [{i: complex(i, r) for i in range(10)} for r in range(3)]
+
+    def call(slave, d):
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+    col, col_stats = run_plane(maps, True, call)
+    pkl, _ = run_plane(maps, False, call)
+    assert col == pkl
+    # nothing was encoded columnar: the fallback engaged job-wide
+    assert all(s["allreduce_map"]["keys"] == 0 for s in col_stats)
+
+
+def test_fallback_mixed_key_kinds_across_ranks(rng):
+    """Rank 0 int keys, rank 1 str keys: kinds differ job-wide, so the
+    negotiation falls back rather than desyncing vocabularies."""
+    maps = [{i: float(i) for i in range(8)},
+            {f"k{i}": float(i) for i in range(8)},
+            {}]
+
+    def call(slave, d):
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+    col, col_stats = run_plane(maps, True, call)
+    pkl, _ = run_plane(maps, False, call)
+    for dc, dp in zip(col, pkl):
+        assert set(dc) == set(dp)
+    assert all(s["allreduce_map"]["keys"] == 0 for s in col_stats)
+
+
+def test_fallback_unsortable_key_mix_within_rank(rng):
+    """int+str keys in ONE map read as obj kind (str first) but cannot
+    be canonically ordered for codec growth — negotiated fallback."""
+    maps = [{"a": 1.0, 2: 2.0, "c": 3.0}, {"a": 4.0}, {}]
+
+    def call(slave, d):
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+    col, _ = run_plane(maps, True, call)
+    pkl, _ = run_plane(maps, False, call)
+    for dc, dp in zip(col, pkl):
+        assert set(dc) == set(dp)
+        for k in dc:
+            assert float(dc[k]) == float(dp[k])
+
+
+def test_fallback_object_operator(rng):
+    """A custom (non-ufunc) operator keeps the pickled plane — its fn
+    is arbitrary host Python the segment reducer cannot honor."""
+    first = Operator.custom("FIRST", lambda a, b: a, 0.0)
+    maps = make_maps(3, Operands.DOUBLE, rng, n_keys=15)
+
+    def call(slave, d):
+        slave.allreduce_map(d, Operands.DOUBLE, first)
+
+    col, col_stats = run_plane(maps, True, call)
+    pkl, _ = run_plane(maps, False, call)
+    for dc, dp in zip(col, pkl):
+        assert_bit_identical(dc, dp)
+    assert all(s["allreduce_map"]["keys"] == 0 for s in col_stats)
+
+
+# --------------------------------------------------- gather duplicate naming
+@pytest.mark.parametrize("columnar", [True, False],
+                         ids=["columnar", "pickle"])
+def test_gather_duplicate_names_key_and_both_ranks(columnar):
+    maps = [{0: 1.0, 7: 1.0}, {1: 2.0}, {7: 3.0}]  # 0 and 2 both own 7
+
+    def fn(slave, r):
+        d = dict(maps[r])
+        try:
+            slave.gather_map(d, Operands.DOUBLE, root=0)
+        except Mp4jError as e:
+            return str(e)
+        return None
+
+    res = run_slaves(3, fn, map_columnar=columnar)
+    msg = res[0]
+    assert msg is not None and "7" in msg
+    assert "ranks 0 and 2" in msg, msg
+
+
+def test_thread_gather_duplicate_names_global_ranks():
+    """The thread leader's disjoint-union check must name the key and
+    both owner GLOBAL ranks (helper tested directly: a leader raise
+    inside a live _fan_in_out strands sibling threads at the barrier
+    by design — fail-stop — so the full collective cannot be driven
+    through a conflict in-process)."""
+    from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
+
+    slaves = ThreadCommSlave.spawn_group(3)
+    slots = [{"x": 1.0}, {"y": 2.0}, {"x": 3.0}]
+    with pytest.raises(Mp4jError, match=r"'x'.*global ranks 0 and 2"):
+        slaves[0]._disjoint_union_slots(slots, "gather_map")
+    # disjoint slots stay on the fast path
+    ok = slaves[0]._disjoint_union_slots(
+        [{"a": 1.0}, {"b": 2.0}, {}], "gather_map")
+    assert ok == {"a": 1.0, "b": 2.0}
+
+
+def test_tpu_gather_duplicate_names_both_ranks():
+    from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+
+    cl = TpuCommCluster(4)
+    maps = [{"a": 1.0}, {"b": 2.0}, {}, {"a": 9.0}]
+    with pytest.raises(Mp4jError, match=r"'a'.*ranks 0 and 3"):
+        cl.gather_map(maps, Operands.DOUBLE, root=0)
+
+
+# ------------------------------------------------------- analytic accounting
+def test_columnar_stats_wire_bytes_and_keys(rng):
+    """Analytic wire accounting for a 2-rank int-keyed allreduce: the
+    non-root ships exactly one (codes, values) pair up the tree —
+    K*4 codes bytes + K*8 value bytes plus bounded frame/negotiation
+    overhead — and books keys == its map size."""
+    K = 256
+    maps = [{i: float(i) for i in range(K)},
+            {i + K // 2: float(i) for i in range(K)}]
+
+    def call(slave, d):
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+
+    _, stats = run_plane(maps, True, call)
+    for r, snap in enumerate(stats):
+        e = snap["allreduce_map"]
+        assert e["calls"] == 1
+        assert e["keys"] == K
+        assert e["serialize_seconds"] > 0
+    payload = K * (4 + 8)                  # codes:int32 + values:f64
+    union_payload = 2 * K * (4 + 8) * 3 // 4   # 50% overlap -> 1.5K keys
+    # rank 1 (vr=1): novelty header + one column pair up; receives the
+    # union columns in the broadcast down-sweep
+    sent1 = stats[1]["allreduce_map"]["bytes_sent"]
+    assert payload <= sent1 <= payload + 8192, sent1
+    recv1 = stats[1]["allreduce_map"]["bytes_recv"]
+    assert union_payload <= recv1 <= union_payload + 8192, recv1
+    # rank 0 merges: vectorized reduce time is booked as reduce phase
+    assert stats[0]["allreduce_map"]["reduce_seconds"] > 0
+
+
+def test_map_columnar_env_knob(monkeypatch):
+    from ytk_mp4j_tpu.utils import tuning
+
+    monkeypatch.delenv("MP4J_MAP_COLUMNAR", raising=False)
+    assert tuning.map_columnar_enabled() is True
+    monkeypatch.setenv("MP4J_MAP_COLUMNAR", "0")
+    assert tuning.map_columnar_enabled() is False
+    monkeypatch.setenv("MP4J_MAP_COLUMNAR", "yes")
+    with pytest.raises(Mp4jError):
+        tuning.map_columnar_enabled()
+
+
+# ------------------------------------------------------- merge-kernel twins
+@pytest.mark.parametrize("op", OPERATORS)
+def test_np_merge_twins_match_dict_oracle(op, rng):
+    np_fn = Operators.by_name(op).np_fn
+    for _ in range(10):
+        ka = np.unique(rng.integers(0, 50, 20)).astype(np.int32)
+        kb = np.unique(rng.integers(0, 50, 20)).astype(np.int32)
+        va = rng.standard_normal(ka.size)
+        vb = rng.standard_normal(kb.size)
+        mc, mv = sparse_ops.np_merge_sorted_columns(ka, va, kb, vb,
+                                                    np_fn)
+        oracle = dict(zip(ka.tolist(), va))
+        for k, v in zip(kb.tolist(), vb):
+            oracle[k] = np_fn(oracle[k], v) if k in oracle else v
+        assert mc.tolist() == sorted(oracle)
+        for k, v in zip(mc.tolist(), mv):
+            assert np.float64(v).tobytes() == \
+                np.float64(oracle[k]).tobytes()
+
+
+def test_np_merge_twins_property():
+    """Hypothesis form of the oracle test (skips with the other
+    hypothesis suites when the package is absent)."""
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    @hyp.given(
+        st.lists(st.tuples(st.integers(0, 99),
+                           st.floats(-1e6, 1e6)), max_size=40),
+        st.lists(st.tuples(st.integers(0, 99),
+                           st.floats(-1e6, 1e6)), max_size=40))
+    @hyp.settings(deadline=None, max_examples=50)
+    def prop(a, b):
+        da, db = dict(a), dict(b)
+        ka = np.asarray(sorted(da), np.int32)
+        kb = np.asarray(sorted(db), np.int32)
+        va = np.asarray([da[k] for k in ka.tolist()])
+        vb = np.asarray([db[k] for k in kb.tolist()])
+        mc, mv = sparse_ops.np_merge_sorted_columns(ka, va, kb, vb,
+                                                    np.add)
+        oracle = dict(da)
+        for k, v in db.items():
+            oracle[k] = np.add(oracle[k], v) if k in oracle else v
+        assert mc.tolist() == sorted(oracle)
+        for k, v in zip(mc.tolist(), mv):
+            assert np.float64(v).tobytes() == \
+                np.float64(oracle[k]).tobytes()
+
+    prop()
+
+
+# ---------------------------------------------------- pack_values satellites
+def test_pack_values_ndarray_fast_path_no_copy():
+    from ytk_mp4j_tpu.comm import keycodec
+
+    arr = np.arange(6.0).reshape(3, 2)
+    out = keycodec.pack_values(arr, 3, (2,), np.float64)
+    assert out is arr                       # no copy when dtype matches
+    out32 = keycodec.pack_values(arr, 3, (2,), np.float32)
+    assert out32.dtype == np.float32
+    with pytest.raises(Mp4jError, match="share"):
+        keycodec.pack_values(arr, 3, (3,), np.float64)
+
+
+def test_pack_values_from_dict_view_rejects_shape_mixes():
+    from ytk_mp4j_tpu.comm import keycodec
+
+    d = {0: 1.0, 1: 2.5, 2: 3.0}
+    v = keycodec.pack_values(d.values(), 3, (), np.float64)
+    assert v.tolist() == [1.0, 2.5, 3.0]
+    # a stray shape-(1,) array must raise, not silently flatten
+    bad = {0: 1.0, 1: np.ones(1)}
+    with pytest.raises(Mp4jError, match="share"):
+        keycodec.pack_values(bad.values(), 2, (), np.float64)
+    with pytest.raises(Mp4jError):
+        keycodec.pack_values({0: "x"}.values(), 1, (), np.float64)

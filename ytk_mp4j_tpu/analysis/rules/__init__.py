@@ -10,6 +10,7 @@
 | R6 | warning  | leader returns an aliased slot (no _detach) |
 | R7 | error    | mutable defaults / mutated module-level state |
 | R8 | error    | chunk schedule derived from rank-local state |
+| R9 | error    | pickled dict payload on a collective map path |
 """
 
 from __future__ import annotations
@@ -29,6 +30,8 @@ from ytk_mp4j_tpu.analysis.rules.r6_aliased_result import (
 from ytk_mp4j_tpu.analysis.rules.r7_mutable_state import R7MutableState
 from ytk_mp4j_tpu.analysis.rules.r8_chunk_schedule import (
     R8RankLocalChunkSchedule)
+from ytk_mp4j_tpu.analysis.rules.r9_map_payload import (
+    R9PickledMapPayload)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -39,6 +42,7 @@ ALL_RULES = [
     R6AliasedLeaderResult,
     R7MutableState,
     R8RankLocalChunkSchedule,
+    R9PickledMapPayload,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

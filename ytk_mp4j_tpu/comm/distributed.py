@@ -684,17 +684,33 @@ class DistributedComm(CommSlave):
         if self._n == 1:
             return d
         maps = self._exchange_obj(d)
+        union = self._disjoint_union(maps, "gather_map")
+        if self._rank == root:
+            d.clear()
+            d.update(union)
+        return d
+
+    @staticmethod
+    def _disjoint_union(maps, what: str) -> dict:
+        """Disjoint union of per-rank maps; a duplicate raises naming
+        the key and both owner ranks (contract parity with the socket
+        backend's gather_map; the conflict hunt runs only on the error
+        path)."""
         total = sum(len(m) for m in maps)
         union: dict = {}
         for m in maps:
             union.update(m)
         if len(union) != total:
-            raise Mp4jError("gather_map requires disjoint keys across "
-                            "ranks; use reduce_map to combine")
-        if self._rank == root:
-            d.clear()
-            d.update(union)
-        return d
+            seen: dict = {}
+            for r, m in enumerate(maps):
+                for k in m:
+                    if k in seen:
+                        raise Mp4jError(
+                            f"{what}: duplicate key {k!r} owned by "
+                            f"ranks {seen[k]} and {r}; use reduce_map "
+                            f"to combine")
+                    seen[k] = r
+        return union
 
     def allgather_map(self, d: dict,
                       operand: Operand = Operands.DOUBLE) -> dict:
@@ -702,12 +718,7 @@ class DistributedComm(CommSlave):
         if self._n == 1:
             return d
         maps = self._exchange_obj(d)
-        total = sum(len(m) for m in maps)
-        union: dict = {}
-        for m in maps:
-            union.update(m)
-        if len(union) != total:
-            raise Mp4jError("allgather_map requires disjoint keys")
+        union = self._disjoint_union(maps, "allgather_map")
         d.clear()
         d.update(union)
         return d

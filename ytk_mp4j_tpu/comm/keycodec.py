@@ -60,20 +60,55 @@ def codec_for_key(key):
 
 def pack_values(values, count: int, vshape, dtype) -> np.ndarray:
     """One vectorized map-values -> ``[count, *vshape]`` conversion,
-    shared by the driver and multi-host map planes so their
-    accept/reject behavior cannot drift: ragged mixes raise via
-    asarray, and the explicit shape check also catches scalar vs
-    shape-(1,) mixes that a fromiter would silently flatten."""
+    shared by the driver, multi-host, and socket map planes so their
+    accept/reject behavior cannot drift: ragged mixes raise, and scalar
+    vs shape-(1,) mixes raise rather than silently flattening.
+
+    Three paths, cheapest first:
+
+    - ``values`` already an ndarray: validated in place — no list()
+      round-trip, no copy unless the dtype needs casting;
+    - scalar ``vshape``: packed straight from the (re-iterable) values
+      view with ``np.fromiter`` — no boxed-pointer list materialized.
+      fromiter would silently FLATTEN a stray shape-(1,) array value
+      (a NumPy deprecation), so that warning is promoted to the same
+      Mp4jError the asarray path raises;
+    - array-valued maps: the original list + asarray conversion.
+    """
+    vshape = tuple(vshape)
+    want = (count,) + vshape
+    dt = np.dtype(dtype)
+    if isinstance(values, np.ndarray):
+        if values.shape != want:
+            raise Mp4jError(
+                f"map values must share a shape; got {values.shape} "
+                f"vs expected {want}")
+        try:
+            return values if values.dtype == dt else values.astype(dt)
+        except (TypeError, ValueError) as e:
+            raise Mp4jError(
+                f"map values must be {dt}-castable: {e}") from None
+    if vshape == ():
+        import warnings
+
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", DeprecationWarning)
+                return np.fromiter(values, dt, count)
+        except (TypeError, ValueError, DeprecationWarning) as e:
+            raise Mp4jError(
+                f"map values must share shape {vshape} and be "
+                f"{dt}-castable: {e}") from None
     try:
-        v = np.asarray(list(values), dtype=dtype)
+        v = np.asarray(list(values), dtype=dt)
     except (TypeError, ValueError) as e:
         raise Mp4jError(
             f"map values must share shape {vshape} and be "
-            f"{dtype}-castable: {e}") from None
-    if v.shape != (count,) + tuple(vshape):
+            f"{dt}-castable: {e}") from None
+    if v.shape != want:
         raise Mp4jError(
-            f"map values must share a shape; got {v.shape[1:]} vs "
-            f"{vshape}")
+            f"map values must share a shape; got {v.shape} vs "
+            f"expected {want}")
     return v
 
 

@@ -41,8 +41,10 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ytk_mp4j_tpu import meta
+from ytk_mp4j_tpu.comm import keycodec
 from ytk_mp4j_tpu.comm import master as master_mod
 from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
@@ -113,7 +115,8 @@ class ProcessCommSlave(CommSlave):
                  timeout: float | None = 120.0,
                  peer_timeout: float | None = None,
                  handshake_timeout: float | None = 30.0,
-                 native_transport: bool = True):
+                 native_transport: bool = True,
+                 map_columnar: bool | None = None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -128,7 +131,14 @@ class ProcessCommSlave(CommSlave):
         a job must pass the same value (the raw/framed decision must
         match on both ends of every exchange). False keeps the fully
         framed Python path — the frozen reference baseline bench.py
-        measures against."""
+        measures against.
+
+        ``map_columnar`` selects the map-collective wire plane for
+        numeric operands (None reads ``MP4J_MAP_COLUMNAR``, default
+        on): the columnar (codes, values) data plane, or False for the
+        pickled-dict reference path. JOB-wide like ``native_transport``
+        — every slave must agree (see the map-collective section
+        comment)."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
         self._handshake_timeout = handshake_timeout
@@ -139,6 +149,13 @@ class ProcessCommSlave(CommSlave):
         # thread starts: an early peer dial-in races __init__
         self._chunk_bytes = tuning.chunk_bytes()
         self._algo_small, self._algo_large = tuning.algo_thresholds()
+        self._map_columnar = (tuning.map_columnar_enabled()
+                              if map_columnar is None
+                              else bool(map_columnar))
+        # persistent key<->code vocabularies for the columnar map
+        # plane, kept IDENTICAL across ranks (grown only inside the
+        # synchronized novelty exchange — see _map_sync)
+        self._map_codecs: dict[str, object] = {}
         self._scratch = _ScratchPool()
         self._comm_stats = CommStats()
         # own listen socket on an ephemeral port. Buffer-size knobs
@@ -1040,17 +1057,44 @@ class ProcessCommSlave(CommSlave):
     # ------------------------------------------------------------------
     # collectives: sparse maps (reference: *Map methods, SURVEY.md 3c)
     #
-    # Dicts travel pickled (the Kryo analogue); merges apply the operator
-    # key-wise on shared keys. In-place semantics: the caller's dict is
-    # mutated. Values may be scalars, numpy arrays, strings, or arbitrary
-    # objects (with a suitable operator).
+    # Two wire planes, selected per call:
+    #
+    # - COLUMNAR (default for numeric operands with ufunc operators,
+    #   ISSUE 4): each map is encoded ONCE through the persistent
+    #   comm.keycodec vocabulary into a (codes:int32,
+    #   values:[n, *vshape]) pair and shipped as a paired framed-array
+    #   unit (Channel.send_map_columns) — inheriting the framed plane's
+    #   streaming compression, no-zero-fill receives and comm.stats()
+    #   wire/serialize attribution — and merged with vectorized
+    #   sorted-union + segment-reduce kernels (ops.sparse numpy twins)
+    #   instead of a per-key Python loop. Vocabulary sync is part of
+    #   the collective: novel keys ride a small pickled header exchange
+    #   (near-empty once a gradient stream's vocabulary stabilizes) and
+    #   every rank grows its codec with the same canonical key list, so
+    #   code->key tables stay IDENTICAL job-wide — the invariant every
+    #   later call's codes rely on. Columnar merges compute in the
+    #   operand dtype (the declared operand is load-bearing, matching
+    #   the device path's pack_values cast).
+    # - PICKLED dicts (the Kryo analogue; the frozen reference wire
+    #   under map_columnar=False): STRING/OBJECT operands, non-ufunc
+    #   (object) operators, and any call whose negotiated header
+    #   reports un-codec-able content on some rank (mixed/unsortable
+    #   key kinds, ragged or object values). The negotiation makes the
+    #   fallback a JOB-wIDE decision carried on the wire — ranks can
+    #   never disagree about the plane of one exchange.
+    #
+    # (History: an earlier in-line note here measured a packed merge as
+    # a LOSS at 20k-200k int keys — but that variant re-paid a full
+    # per-call sorted-union + Python pack, exactly the work the
+    # grow-only codec amortizes away. The honest re-run is bench.py's
+    # socket_map_allreduce_sweep columnar-vs-pickle A/B, BENCH extra.)
+    #
+    # In-place semantics on every plane: the caller's dict is mutated.
     # ------------------------------------------------------------------
     @staticmethod
     def _merge_maps(operator: Operator, acc: dict, src: dict) -> dict:
-        # Deliberately a plain per-key loop: a packed numpy/native
-        # alternative (array conversion + sorted-u64 union + vectorized
-        # combine) was measured 0.85-0.95x of this at 20k-200k int keys
-        # — dict ops are already C-level and the output must be a dict.
+        # the pickled plane's per-key merge loop (dict ops are C-level;
+        # the columnar plane replaces this wholesale, see above)
         for k, v in src.items():
             if k in acc:
                 acc[k] = operator.np_fn(acc[k], v)
@@ -1058,43 +1102,32 @@ class ProcessCommSlave(CommSlave):
                 acc[k] = v
         return acc
 
-    def allreduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
-                      operator: Operator = Operators.SUM) -> dict:
-        """Key-union reduce; every rank ends with the merged map."""
-        self.reduce_map(d, operand, operator, root=0)
-        return self.broadcast_map(d, operand, root=0)
-
-    def reduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
-                   operator: Operator = Operators.SUM, root: int = 0) -> dict:
-        """Binomial-tree key-wise merge into ``root``'s map."""
-        self._check_root(root)
-        if self._n == 1:
-            return d
+    # -- the map planes' shared binomial-tree walks ---------------------
+    # ONE copy of each walk, parameterized by the per-plane send/recv
+    # callables: a protocol tweak (rank math, timeouts) lands on every
+    # plane at once instead of needing six synchronized edits.
+    def _tree_reduce_walk(self, value, root: int, send, recv_merge):
+        """Up-sweep: ``value`` merges toward ``root``. ``send(peer,
+        value)`` ships this rank's merged value to its parent;
+        ``recv_merge(peer, value) -> value`` receives a child's
+        contribution and merges it in. Returns the full merge at
+        ``root`` (a partial merge elsewhere)."""
         vr = (self._rank - root) % self._n
-        acc = dict(d)
         mask = 1
         while mask < self._n:
             if vr & mask:
-                self._send(((vr - mask) + root) % self._n, acc,
-                           compress=operand.compress)
+                send(((vr - mask) + root) % self._n, value)
                 break
-            else:
-                src_vr = vr + mask
-                if src_vr < self._n:
-                    recv = self._recv((src_vr + root) % self._n)
-                    acc = self._merge_maps(operator, acc, recv)
+            src_vr = vr + mask
+            if src_vr < self._n:
+                value = recv_merge((src_vr + root) % self._n, value)
             mask <<= 1
-        if self._rank == root:
-            d.clear()
-            d.update(acc)
-        return d
+        return value
 
-    def broadcast_map(self, d: dict, operand: Operand = Operands.DOUBLE,
-                      root: int = 0) -> dict:
-        """Binomial-tree broadcast of ``root``'s map."""
-        self._check_root(root)
-        if self._n == 1:
-            return d
+    def _tree_bcast_walk(self, value, root: int, send, recv):
+        """Down-sweep: ``root``'s ``value`` reaches every rank.
+        ``recv(peer) -> value`` replaces the local value on first
+        receipt; holders forward with ``send(peer, value)``."""
         vr = (self._rank - root) % self._n
         mask = 1
         have = vr == 0
@@ -1102,23 +1135,220 @@ class ProcessCommSlave(CommSlave):
             if have:
                 dst_vr = vr + mask
                 if dst_vr < self._n:
-                    self._send((dst_vr + root) % self._n, d,
-                               compress=operand.compress)
+                    send((dst_vr + root) % self._n, value)
             elif mask <= vr < 2 * mask:
-                recv = self._recv(((vr - mask) + root) % self._n)
-                d.clear()
-                d.update(recv)
+                value = recv(((vr - mask) + root) % self._n)
                 have = True
             mask <<= 1
+        return value
+
+    # -- columnar plane: negotiation / codec plumbing -------------------
+    def _map_columnar_ok(self, operand: Operand,
+                         operator: Operator | None = None) -> bool:
+        """Whether this call may negotiate the columnar plane — a pure
+        function of job-wide call parameters (operand, operator, the
+        job's map_columnar flag), NEVER of rank-local map content: both
+        ends of every exchange must agree whether a negotiation header
+        travels at all (R4 discipline). Map-content problems are
+        handled by the negotiation itself."""
+        if not (self._map_columnar and operand.columnar_maps):
+            return False
+        if operator is None:
+            return True
+        # segment-reduce needs a real binary ufunc (reduceat); object
+        # operators (plain Python callables) keep the pickled plane
+        return isinstance(operator.np_fn, np.ufunc) and \
+            operator.np_fn.nin == 2
+
+    def _map_codec(self, kind: str):
+        codec = self._map_codecs.get(kind)
+        if codec is None:
+            codec = self._map_codecs[kind] = keycodec.codec_for_kind(kind)
+        return codec
+
+    def _map_local_header(self, d: dict, operand: Operand):
+        """``((ok, kind, vshape, novel), packed_values)`` for THIS
+        rank's map. All local validation happens here, BEFORE any wire
+        exchange, and its outcome rides the header: a bad map on one
+        rank must divert EVERY rank to the pickled plane, not error on
+        one side of an exchange (cf. distributed._union_device)."""
+        if not d:
+            return (True, None, None, []), None
+        k0 = next(iter(d))
+        kind = keycodec.kind_of(k0)
+        codec = self._map_codec(kind)
+        t0 = time.perf_counter()
+        try:
+            novel = codec.novel(d.keys(), len(d))
+            vshape = tuple(np.shape(d[k0]))
+            vals = keycodec.pack_values(d.values(), len(d), vshape,
+                                        operand.dtype)
+        except Mp4jError:
+            return (False, kind, None, []), None
+        self._comm_stats.add("serialize_seconds",
+                             time.perf_counter() - t0)
+        return (True, kind, vshape, novel), vals
+
+    @staticmethod
+    def _merge_map_headers(a, b):
+        """Associative header merge for the sync up-sweep."""
+        ok = a[0] and b[0]
+        kind = a[1] if a[1] is not None else b[1]
+        if a[1] is not None and b[1] is not None and a[1] != b[1]:
+            ok = False
+        vshape = a[2] if a[2] is not None else b[2]
+        if a[2] is not None and b[2] is not None and a[2] != b[2]:
+            ok = False
+        novel = a[3] if not b[3] else list(dict.fromkeys(a[3] + b[3]))
+        return (ok, kind, vshape, novel)
+
+    @staticmethod
+    def _map_decision(header):
+        """Root's plane decision from the merged header: ``("col",
+        kind, vshape, canonical_novel)``, ``("nop",)`` (every map
+        empty), or ``("obj",)`` (negotiated pickle fallback). The
+        canonical novelty order is sorted — the one growth order every
+        rank can derive identically; an unsortable key mix cannot be
+        canonicalized and falls back."""
+        ok, kind, vshape, novel = header
+        if not ok:
+            return ("obj",)
+        if kind is None:
+            return ("nop",)
+        try:
+            canonical = sorted(novel)
+        except TypeError:
+            return ("obj",)
+        return ("col", kind, vshape, canonical)
+
+    def _map_bcast_obj(self, obj, root: int):
+        """Binomial-tree broadcast of one small pickled object (the
+        decision header)."""
+        return self._tree_bcast_walk(obj, root, self._send, self._recv)
+
+    def _map_sync(self, header, root: int):
+        """Vocabulary-sync + plane-negotiation round: headers merge up
+        the binomial tree to ``root``, the decision broadcasts back
+        down, and on ``"col"`` every rank (including this one) grows
+        its codec with the same canonical novelty — so every rank
+        returns the same decision over identical code->key tables."""
+        header = self._tree_reduce_walk(
+            header, root, self._send,
+            lambda peer, h: self._merge_map_headers(
+                h, self._recv(peer)))
+        decision = (self._map_decision(header)
+                    if self._rank == root else None)
+        decision = self._map_bcast_obj(decision, root)
+        if decision[0] == "col":
+            self._grow_map_codec(decision)
+        return decision
+
+    def _grow_map_codec(self, decision) -> None:
+        _, kind, _vshape, canonical = decision
+        if canonical:
+            t0 = time.perf_counter()
+            self._map_codec(kind).encode(canonical, len(canonical))
+            self._comm_stats.add("serialize_seconds",
+                                 time.perf_counter() - t0)
+
+    # -- columnar plane: data movement ----------------------------------
+    def _encode_map_columns(self, d: dict, decision, vals,
+                            operand: Operand):
+        """This rank's code-sorted (codes, values) columns. Every key
+        is already in the vocabulary (the sync grew it), so encode is a
+        pure vectorized lookup."""
+        _, kind, vshape, _ = decision
+        t0 = time.perf_counter()
+        if not d:
+            codes = np.empty(0, np.int32)
+            vals = np.empty((0,) + tuple(vshape), operand.dtype)
+        else:
+            codes = self._map_codec(kind).encode(d.keys(), len(d))
+        order = np.argsort(codes)
+        cols = (codes[order], vals[order])
+        self._comm_stats.add("serialize_seconds",
+                             time.perf_counter() - t0)
+        self._comm_stats.add("keys", int(codes.size))
+        return cols
+
+    def _decode_map_columns(self, decision, codes, vals) -> dict:
+        t0 = time.perf_counter()
+        out = dict(zip(self._map_codec(decision[1]).decode(codes),
+                       list(vals)))
+        self._comm_stats.add("serialize_seconds",
+                             time.perf_counter() - t0)
+        return out
+
+    def _send_map_columns(self, peer: int, cols, operand: Operand):
+        self._channel(peer).send_map_columns(cols[0], cols[1],
+                                             compress=operand.compress)
+
+    def _recv_map_columns(self, peer: int):
+        # peer channels carry peer_timeout from creation
+        # mp4j-lint: disable=R2 (peer_timeout is set at channel creation)
+        return self._channel(peer).recv_map_columns()
+
+    def _merge_map_columns(self, acc, src, operator: Operator):
+        """Vectorized sorted-union merge, acc side first — the same
+        ``op(acc[k], src[k])`` operand order as the dict loop, so the
+        two planes agree bit-for-bit (ops.sparse contract)."""
+        t0 = time.perf_counter()
+        out = sparse_ops.np_merge_sorted_columns(
+            acc[0], acc[1], src[0], src[1], operator.np_fn)
+        self._comm_stats.add("reduce_seconds", time.perf_counter() - t0)
+        return out
+
+    def _reduce_map_columns(self, d: dict, vals, operand: Operand,
+                            operator: Operator, root: int, decision):
+        """Binomial-tree columnar reduce; the returned columns are the
+        full union at ``root`` (partial elsewhere)."""
+        return self._tree_reduce_walk(
+            self._encode_map_columns(d, decision, vals, operand), root,
+            lambda peer, acc: self._send_map_columns(peer, acc, operand),
+            lambda peer, acc: self._merge_map_columns(
+                acc, self._recv_map_columns(peer), operator))
+
+    def _bcast_map_columns(self, cols, root: int, operand: Operand):
+        """Binomial-tree broadcast of ``root``'s columns."""
+        return self._tree_bcast_walk(
+            cols, root,
+            lambda peer, c: self._send_map_columns(peer, c, operand),
+            self._recv_map_columns)
+
+    # -- pickled plane (the sanctioned fallback) ------------------------
+    def _send_map_obj(self, peer: int, d, operand: Operand) -> None:
+        """The ONE sanctioned pickled-map send: STRING/OBJECT operands,
+        object operators, and negotiated fallbacks route here (README
+        "Sparse map collectives"; mp4j-lint R9 baseline entry)."""
+        self._send(peer, d, compress=operand.compress)
+
+    def _reduce_map_obj(self, d: dict, operand: Operand,
+                        operator: Operator, root: int) -> dict:
+        acc = self._tree_reduce_walk(
+            dict(d), root,
+            lambda peer, a: self._send_map_obj(peer, a, operand),
+            lambda peer, a: self._merge_maps(operator, a,
+                                             self._recv(peer)))
+        if self._rank == root:
+            d.clear()
+            d.update(acc)
         return d
 
-    def gather_map(self, d: dict, operand: Operand = Operands.DOUBLE,
-                   root: int = 0) -> dict:
-        """Disjoint union into ``root``'s map (duplicate keys raise)."""
-        self._check_root(root)
-        if self._n == 1:
-            return d
+    def _broadcast_map_obj(self, d: dict, operand: Operand,
+                           root: int) -> dict:
+        out = self._tree_bcast_walk(
+            d, root,
+            lambda peer, m: self._send_map_obj(peer, m, operand),
+            self._recv)
+        if out is not d:
+            d.clear()
+            d.update(out)
+        return d
+
+    def _gather_map_obj(self, d: dict, operand: Operand,
+                        root: int) -> dict:
         if self._rank == root:
+            owners = {k: root for k in d}
             for peer in range(self._n):
                 if peer == root:
                     continue
@@ -1126,11 +1356,161 @@ class ProcessCommSlave(CommSlave):
                 for k, v in recv.items():
                     if k in d:
                         raise Mp4jError(
-                            f"gather_map: duplicate key {k!r} from rank "
-                            f"{peer}; use reduce_map to combine")
+                            f"gather_map: duplicate key {k!r} owned by "
+                            f"ranks {owners[k]} and {peer}; use "
+                            f"reduce_map to combine")
                     d[k] = v
+                    owners[k] = peer
         else:
-            self._send(root, d, compress=operand.compress)
+            self._send_map_obj(root, d, operand)
+        return d
+
+    # -- the map collective family --------------------------------------
+    def allreduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      operator: Operator = Operators.SUM) -> dict:
+        """Key-union reduce; every rank ends with the merged map. On
+        the columnar plane the union stays in (codes, values) form end
+        to end: one encode, log2(n) vectorized merges, one column
+        broadcast, one decode."""
+        if self._n == 1:
+            return d
+        if self._map_columnar_ok(operand, operator):
+            header, vals = self._map_local_header(d, operand)
+            decision = self._map_sync(header, 0)
+            if decision[0] == "nop":
+                return d
+            if decision[0] == "col":
+                cols = self._reduce_map_columns(d, vals, operand,
+                                                operator, 0, decision)
+                cols = self._bcast_map_columns(cols, 0, operand)
+                merged = self._decode_map_columns(decision, *cols)
+                d.clear()
+                d.update(merged)
+                return d
+        self._reduce_map_obj(d, operand, operator, 0)
+        return self._broadcast_map_obj(d, operand, 0)
+
+    def reduce_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   operator: Operator = Operators.SUM, root: int = 0) -> dict:
+        """Binomial-tree key-wise merge into ``root``'s map."""
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        if self._map_columnar_ok(operand, operator):
+            header, vals = self._map_local_header(d, operand)
+            decision = self._map_sync(header, root)
+            if decision[0] == "nop":
+                return d
+            if decision[0] == "col":
+                cols = self._reduce_map_columns(d, vals, operand,
+                                                operator, root, decision)
+                if self._rank == root:
+                    merged = self._decode_map_columns(decision, *cols)
+                    d.clear()
+                    d.update(merged)
+                return d
+        return self._reduce_map_obj(d, operand, operator, root)
+
+    def broadcast_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                      root: int = 0) -> dict:
+        """Binomial-tree broadcast of ``root``'s map. Columnar: only
+        root's keys matter, so the decision (with root's canonical
+        novelty) rides the broadcast tree itself — no up-sweep."""
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        if self._map_columnar_ok(operand):
+            vals = None
+            decision = None
+            if self._rank == root:
+                header, vals = self._map_local_header(d, operand)
+                decision = self._map_decision(header)
+            decision = self._map_bcast_obj(decision, root)
+            if decision[0] == "nop":
+                d.clear()      # root's map is empty; every copy is
+                return d
+            if decision[0] == "col":
+                self._grow_map_codec(decision)
+                cols = (self._encode_map_columns(d, decision, vals,
+                                                 operand)
+                        if self._rank == root else None)
+                cols = self._bcast_map_columns(cols, root, operand)
+                if self._rank != root:
+                    d.clear()
+                    d.update(self._decode_map_columns(decision, *cols))
+                return d
+        return self._broadcast_map_obj(d, operand, root)
+
+    def gather_map(self, d: dict, operand: Operand = Operands.DOUBLE,
+                   root: int = 0) -> dict:
+        """Disjoint union into ``root``'s map. A duplicate key raises
+        an Mp4jError naming the key and BOTH owner ranks."""
+        self._check_root(root)
+        if self._n == 1:
+            return d
+        if self._map_columnar_ok(operand):
+            header, vals = self._map_local_header(d, operand)
+            if self._rank != root:
+                self._send(root, header)
+                decision = self._recv(root)
+                if decision[0] == "col":
+                    self._grow_map_codec(decision)
+                    self._send_map_columns(
+                        root,
+                        self._encode_map_columns(d, decision, vals,
+                                                 operand),
+                        operand)
+                    return d
+                if decision[0] == "nop":
+                    return d
+            else:
+                for peer in range(self._n):
+                    if peer != root:
+                        header = self._merge_map_headers(
+                            header, self._recv(peer))
+                decision = self._map_decision(header)
+                for peer in range(self._n):
+                    if peer != root:
+                        self._send(peer, decision)
+                if decision[0] == "nop":
+                    return d
+                if decision[0] == "col":
+                    self._grow_map_codec(decision)
+                    return self._gather_map_columns(d, decision,
+                                                    operand, root)
+        return self._gather_map_obj(d, operand, root)
+
+    def _gather_map_columns(self, d: dict, decision, operand: Operand,
+                            root: int) -> dict:
+        """Root side of the columnar gather: collect every peer's
+        columns, then ONE stable sort over (code, owner) and an
+        adjacent-equality scan detects duplicates (naming the key and
+        both owner ranks — concat order root-then-peers-ascending, so
+        the pair reads in rank order). ``d`` is only mutated once the
+        whole union is proven disjoint."""
+        codec = self._map_codec(decision[1])
+        own = (codec.encode(d.keys(), len(d)) if d
+               else np.empty(0, np.int32))
+        cols = [(own, None, root)]      # root's values stay in d
+        for peer in range(self._n):
+            if peer != root:
+                rc, rv = self._recv_map_columns(peer)
+                cols.append((rc, rv, peer))
+        codes = np.concatenate([c for c, _, _ in cols])
+        owners = np.concatenate([np.full(c.size, p, np.int32)
+                                 for c, _, p in cols])
+        order = np.argsort(codes, kind="stable")
+        sc, so = codes[order], owners[order]
+        dup = np.flatnonzero(sc[1:] == sc[:-1])
+        if dup.size:
+            i = int(dup[0])
+            key = codec.decode(sc[i:i + 1])[0]
+            raise Mp4jError(
+                f"gather_map: duplicate key {key!r} owned by ranks "
+                f"{int(so[i])} and {int(so[i + 1])}; use reduce_map "
+                f"to combine")
+        for rc, rv, _peer in cols[1:]:
+            d.update(zip(codec.decode(rc), list(rv)))
         return d
 
     def allgather_map(self, d: dict, operand: Operand = Operands.DOUBLE) -> dict:
@@ -1145,10 +1525,78 @@ class ProcessCommSlave(CommSlave):
 
         ``partitioner(key) -> rank`` overrides the placement rule (the
         thread backend uses this to place by GLOBAL thread rank while
-        shipping each process only its threads' share)."""
+        shipping each process only its threads' share). The columnar
+        plane's default placement rides the codec's cached per-code
+        blake2b partition — the per-key hash is paid once per key ever,
+        not once per call."""
         self._check_root(root)
         if self._n == 1:
             return d
+        if self._map_columnar_ok(operand):
+            return self._scatter_map_negotiated(d, operand, root,
+                                                partitioner)
+        return self._scatter_map_obj(d, operand, root, partitioner)
+
+    def _scatter_map_negotiated(self, d: dict, operand: Operand,
+                                root: int, partitioner) -> dict:
+        """Scatter under the columnar gate: root decides the plane from
+        its own map (only its keys travel) and prefixes every share
+        with the decision; placement (and its validation) runs BEFORE
+        any send so a bad partitioner raises without wedging peers
+        mid-protocol."""
+        if self._rank != root:
+            decision = self._recv(root)
+            if decision[0] == "col":
+                self._grow_map_codec(decision)
+                cols = self._recv_map_columns(root)
+                d.clear()
+                d.update(self._decode_map_columns(decision, *cols))
+            elif decision[0] == "nop":
+                d.clear()
+            else:
+                recv = self._recv(root)
+                d.clear()
+                d.update(recv)
+            return d
+        header, vals = self._map_local_header(d, operand)
+        decision = self._map_decision(header)
+        if decision[0] == "obj":
+            for peer in range(self._n):
+                if peer != root:
+                    self._send(peer, decision)
+            return self._scatter_map_obj(d, operand, root, partitioner,
+                                         _negotiated=True)
+        if decision[0] == "nop":
+            for peer in range(self._n):
+                if peer != root:
+                    self._send(peer, decision)
+            return d
+        self._grow_map_codec(decision)
+        codec = self._map_codec(decision[1])
+        codes = (codec.encode(d.keys(), len(d)) if d
+                 else np.empty(0, np.int32))
+        if partitioner is None:
+            part = codec.partition(codes, self._n)
+        else:
+            part = np.fromiter(
+                (meta.check_partition_rank(partitioner(k), self._n, k)
+                 for k in d.keys()), np.int32, len(d))
+        for peer in range(self._n):
+            if peer == root:
+                continue
+            self._send(peer, decision)
+            m = part == peer
+            self._send_map_columns(peer, (codes[m], vals[m]), operand)
+        self._comm_stats.add("keys", int(codes.size))
+        mine = part == root
+        merged = self._decode_map_columns(decision, codes[mine],
+                                          vals[mine])
+        d.clear()
+        d.update(merged)
+        return d
+
+    def _scatter_map_obj(self, d: dict, operand: Operand, root: int,
+                         partitioner, _negotiated: bool = False) -> dict:
         if partitioner is None:
             partitioner = lambda k: meta.key_partition(k, self._n)  # noqa: E731
         if self._rank == root:
@@ -1158,10 +1606,12 @@ class ProcessCommSlave(CommSlave):
                     partitioner(k), self._n, k)][k] = v
             for peer in range(self._n):
                 if peer != root:
-                    self._send(peer, shares[peer],
-                               compress=operand.compress)
+                    self._send_map_obj(peer, shares[peer], operand)
             d.clear()
             d.update(shares[root])
+        elif _negotiated:
+            raise Mp4jError("scatter_map protocol error: non-root "
+                            "reached the fallback sender")  # unreachable
         else:
             recv = self._recv(root)
             d.clear()

@@ -575,6 +575,30 @@ class ThreadCommSlave(CommSlave):
 
         return self._fan_in_out(deposit, leader, collect)
 
+    def _disjoint_union_slots(self, slots, what: str) -> dict:
+        """Disjoint union of the threads' deposited maps; a duplicate
+        raises naming the key and BOTH owner GLOBAL ranks (contract
+        parity with ProcessCommSlave.gather_map). The conflict hunt
+        runs only on the error path — the fast path stays one
+        update+len check per slot."""
+        acc: dict = {}
+        total = 0
+        for m in slots:
+            total += len(m)
+            acc.update(m)
+        if len(acc) != total:
+            base = self._g.proc_rank * self._g.thread_num
+            seen: dict = {}
+            for t, m in enumerate(slots):
+                for k in m:
+                    if k in seen:
+                        raise Mp4jError(
+                            f"{what}: duplicate key {k!r} owned by "
+                            f"global ranks {base + seen[k]} and "
+                            f"{base + t}; use reduce_map to combine")
+                    seen[k] = t
+        return acc
+
     def gather_map(self, d: dict, operand: Operand = Operands.DOUBLE,
                    root: int = 0) -> dict:
         root_proc, root_thread = self._decompose_root(root)
@@ -583,13 +607,7 @@ class ThreadCommSlave(CommSlave):
             return dict(d)
 
         def leader(slots):
-            acc: dict = {}
-            total = 0
-            for m in slots:
-                total += len(m)
-                acc.update(m)
-            if len(acc) != total:
-                raise Mp4jError("gather_map requires disjoint keys")
+            acc = self._disjoint_union_slots(slots, "gather_map")
             if self._g.proc is not None:
                 self._g.proc.gather_map(acc, operand, root=root_proc)
             return acc
@@ -609,13 +627,7 @@ class ThreadCommSlave(CommSlave):
             return dict(d)
 
         def leader(slots):
-            acc: dict = {}
-            total = 0
-            for m in slots:
-                total += len(m)
-                acc.update(m)
-            if len(acc) != total:
-                raise Mp4jError("allgather_map requires disjoint keys")
+            acc = self._disjoint_union_slots(slots, "allgather_map")
             if self._g.proc is not None:
                 self._g.proc.allgather_map(acc, operand)
             return acc

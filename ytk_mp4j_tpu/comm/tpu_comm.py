@@ -725,7 +725,9 @@ class TpuCommCluster:
 
     def gather_map(self, maps, operand: Operand = Operands.DOUBLE,
                    root: int = 0):
-        """Disjoint union into ``root``'s dict; others unchanged."""
+        """Disjoint union into ``root``'s dict; others unchanged. A
+        duplicate key raises naming the key and both owner ranks
+        (contract parity with the socket backend)."""
         self._check_root(root)
         maps = self._norm_maps(maps, operand)
         total = sum(len(m) for m in maps)
@@ -733,8 +735,15 @@ class TpuCommCluster:
         for m in maps:
             union.update(m)
         if len(union) != total:
-            raise Mp4jError("gather_map requires disjoint keys across "
-                            "ranks; use reduce_map to combine")
+            seen: dict = {}
+            for r, m in enumerate(maps):
+                for k in m:
+                    if k in seen:
+                        raise Mp4jError(
+                            f"gather_map: duplicate key {k!r} owned by "
+                            f"ranks {seen[k]} and {r}; use reduce_map "
+                            f"to combine")
+                    seen[k] = r
         maps[root].clear()
         maps[root].update(union)
         return maps

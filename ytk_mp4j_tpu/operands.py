@@ -40,6 +40,20 @@ class Operand:
     def is_numeric(self) -> bool:
         return self.dtype is not None
 
+    @property
+    def columnar_maps(self) -> bool:
+        """Whether map collectives may ship this operand as a columnar
+        (codes:int32, values:[n, *vshape]) pair on the socket plane
+        (``comm.process_comm``): numeric operands only — STRING/OBJECT
+        values have no dense column form and keep the pickled-dict
+        path. A pure function of the operand, so it is part of the
+        job-wide wire decision both ends of an exchange derive
+        independently (the same R4 discipline as the raw/framed
+        choice). Columnar merges compute in ``dtype`` — the declared
+        operand is load-bearing, exactly as on the device path's
+        ``pack_values`` cast."""
+        return self.is_numeric
+
     def check_array(self, arr) -> np.ndarray:
         """Validate/coerce a host array for this operand."""
         if not self.is_numeric:

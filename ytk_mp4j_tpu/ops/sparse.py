@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -227,6 +229,63 @@ def sparse_allgather(idx, val, axis_name: str = "mp4j"):
     gi = lax.all_gather(idx, axis_name, axis=0, tiled=True)
     gv = lax.all_gather(val, axis_name, axis=0, tiled=True)
     return sort_by_key(gi, gv)
+
+
+# ----------------------------------------------------------------------
+# Host-side numpy twins of the segment-reduce kernels.
+#
+# The socket backend's columnar map plane (process_comm) merges
+# (codes:int32, values:[n, *vshape]) column pairs with these instead of
+# the per-key dict loop: same sorted-union + segment-reduce shape as the
+# device kernels above, expressed over numpy so the CPU reference path
+# and the TPU path share one merge algorithm. Bit-exactness contract:
+# for two per-map-unique sorted streams concatenated LEFT column first,
+# the stable sort keeps equal codes in (left, right) order and
+# ``ufunc.reduceat`` applies the operator left-to-right — exactly
+# ``op(acc[k], src[k])``, the dict loop's operand order, so the two
+# paths agree bit-for-bit on every dtype.
+# ----------------------------------------------------------------------
+def np_sort_columns(codes, val):
+    """Host twin of :func:`sort_by_key`: jointly sort ``(codes, val)``
+    ascending by code with one stable argsort (payload rows ride a
+    single take)."""
+    order = np.argsort(codes, kind="stable")
+    return codes[order], val[order]
+
+
+def np_segment_reduce_sorted(codes, val, np_fn):
+    """Host twin of :func:`segment_reduce_sorted` over a code-sorted
+    stream: reduce runs of equal code with ``np_fn`` (a binary numpy
+    ufunc — ``Operator.np_fn`` for the builtins), packing unique codes
+    ascending. No sentinel padding: host shapes are dynamic."""
+    if codes.size == 0:
+        return codes, val
+    head = np.empty(codes.size, bool)
+    head[0] = True
+    np.not_equal(codes[1:], codes[:-1], out=head[1:])
+    starts = np.flatnonzero(head)
+    if starts.size == codes.size:       # all unique: nothing to reduce
+        return codes, val
+    # dtype pinned: reduceat otherwise promotes narrow ints to the
+    # platform int (np.sum rules), which would break the bit-exactness
+    # contract with the per-key scalar merge (int32+int32 -> int32)
+    return codes[starts], np_fn.reduceat(val, starts, axis=0,
+                                         dtype=val.dtype)
+
+
+def np_merge_sorted_columns(ca, va, cb, vb, np_fn):
+    """Sorted-union merge of two code-sorted column pairs (each with
+    unique codes): the vectorized replacement for the socket map path's
+    per-key dict merge. ``(ca, va)`` is the ACCUMULATOR side — it is
+    concatenated first, so shared codes reduce as ``np_fn(acc, src)``
+    (see the section comment's bit-exactness contract)."""
+    if ca.size == 0:
+        return cb, vb
+    if cb.size == 0:
+        return ca, va
+    codes = np.concatenate([ca, cb])
+    val = np.concatenate([va, vb])
+    return np_segment_reduce_sorted(*np_sort_columns(codes, val), np_fn)
 
 
 def sparse_to_dense(idx, val, size: int,

@@ -235,6 +235,39 @@ class Channel:
             self._send_all(_U32.pack(len(piece)), piece)
         self._send_all(_U32.pack(0))
 
+    # -- paired columnar map frames ------------------------------------
+    # The socket map plane's wire unit (ISSUE 4): a map travels as its
+    # int32 code column followed by its value column, two back-to-back
+    # array frames forming ONE protocol unit — the receiver always
+    # drains both. Riding the array frames (rather than a pickled dict)
+    # buys the columnar plane everything the framed path already has:
+    # streaming compression (TAG_ARRAY_ZC), no-zero-fill receives, and
+    # wire/serialize stats attribution.
+    def send_map_columns(self, codes: np.ndarray, values: np.ndarray,
+                         compress: bool = False) -> None:
+        """Send one (codes, values) column pair. ``compress`` applies
+        to the VALUE column only (codes are near-random int32s that
+        zlib cannot help; the value column is the bulk of the bytes) —
+        a fixed rule, so both ends derive the same wire format from the
+        call's operand alone."""
+        self.send_array(codes)
+        self.send_array(values, compress=compress)
+
+    def recv_map_columns(self) -> tuple[np.ndarray, np.ndarray]:
+        """Receive one (codes, values) column pair (protocol-checked:
+        a malformed pair is a wire violation, not a recoverable
+        condition — both ends derive the pairing from the same
+        collective call)."""
+        codes = self.recv_array()
+        values = self.recv_array()
+        if (codes.dtype != np.int32 or codes.ndim != 1
+                or values.shape[:1] != codes.shape):
+            raise Mp4jError(
+                f"malformed map column pair: codes {codes.dtype}"
+                f"{codes.shape} vs values {values.shape} (operand "
+                "disagreement between sender and receiver?)")
+        return codes, values
+
     # -- raw (unframed) fast path ----------------------------------------
     # Sizes never travel on the wire: both peers derive them from the
     # collective's segment metadata, like the reference's primitive

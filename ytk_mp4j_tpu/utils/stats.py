@@ -28,12 +28,21 @@ sequence number.
 Schema of one snapshot entry (all keys always present)::
 
     {"calls": int, "bytes_sent": int, "bytes_recv": int,
-     "chunks": int, "wire_seconds": float, "reduce_seconds": float,
-     "serialize_seconds": float}
+     "chunks": int, "keys": int, "wire_seconds": float,
+     "reduce_seconds": float, "serialize_seconds": float}
 
 Phase seconds are BUSY times and may overlap in wall time (the whole
 point of the pipelined engine is that wire and reduce overlap), so
 their sum can exceed the collective's wall time.
+
+``keys`` counts map entries this rank encoded into columnar frames
+(the socket map plane, ISSUE 4) — per call it equals the local map
+size, so analytic keys-per-second and wire-bytes-per-key fall straight
+out of a snapshot. Columnar phase attribution: codec encode/decode and
+value packing book ``serialize_seconds`` (they are serialization, like
+pickle on the object path), the vectorized sorted-union merge books
+``reduce_seconds``, and the paired column frames book wire
+seconds/bytes through the channel like any framed array.
 """
 
 from __future__ import annotations
@@ -44,7 +53,7 @@ import time
 from ytk_mp4j_tpu.obs import spans
 
 _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
-_COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks")
+_COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys")
 
 
 def _zero() -> dict[str, float]:

@@ -33,6 +33,11 @@ Knobs (all validated where they are consumed; garbage raises
   (``obs/spans.py``); ``0`` disables span recording.
 - ``MP4J_LOG_LEVEL`` — minimum level the master's log sink prints
   (``DEBUG``/``INFO``/``WARN``/``ERROR``).
+- ``MP4J_MAP_COLUMNAR`` — socket map-collective wire plane: ``1``
+  (default) ships numeric-operand maps as (codes, values) columns
+  through the persistent key codec; ``0`` forces the pickled-dict
+  reference path (``comm/process_comm.py``; README "Sparse map
+  collectives").
 """
 
 from __future__ import annotations
@@ -124,6 +129,23 @@ def log_level() -> str:
             f"MP4J_LOG_LEVEL={raw!r} is not one of "
             f"{sorted(LOG_LEVELS)}")
     return name
+
+
+def map_columnar_enabled() -> bool:
+    """Whether numeric-operand socket map collectives default to the
+    columnar (codes, values) wire plane (``MP4J_MAP_COLUMNAR``).
+    JOB-wide, exactly like ``native_transport``: both ends of every
+    exchange must agree on the plane, so every rank of a job must run
+    with the same value (the per-call negotiation header then handles
+    data-dependent fallback consistently)."""
+    raw = os.environ.get("MP4J_MAP_COLUMNAR")
+    if raw is None or raw.strip() == "":
+        return True
+    val = raw.strip()
+    if val not in ("0", "1"):
+        raise Mp4jError(
+            f"MP4J_MAP_COLUMNAR={raw!r} must be 0 or 1")
+    return val == "1"
 
 
 def algo_thresholds() -> tuple[int, int]:
