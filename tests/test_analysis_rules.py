@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 10)]
+    assert ids == [f"R{i}" for i in range(1, 11)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -583,6 +583,102 @@ def test_r9_scoped_to_map_functions_in_comm():
             self._send(0, dict(d))
     """)
     assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R10 — peer-channel I/O bypassing the epoch fence
+# ----------------------------------------------------------------------
+def test_r10_fires_on_direct_channel_io():
+    r = run_rule("R10", """
+        class ProcessCommSlave:
+            def _recv_reduce(self, peer, rbuf):
+                self._channel(peer).recv_array_into(rbuf)
+
+            def _send(self, peer, data):
+                ch = self._channel(peer)
+                ch.send_array(data)
+    """)
+    assert [f.line for f in r.findings] == [4, 8]
+    assert "epoch fence" in r.findings[0].message
+
+
+def test_r10_fires_on_bare_channel_constructors():
+    r = run_rule("R10", """
+        class ProcessCommSlave:
+            def _dial(self, peer):
+                ch = connect(host, port)
+                ch.send_obj((self._rank, epoch))
+
+            def _accept_loop(self):
+                ch = Channel(sock)
+                hs = ch.recv()
+    """)
+    assert [f.line for f in r.findings] == [5, 9]
+
+
+def test_r10_quiet_on_fenced_and_master_channels():
+    r = run_rule("R10", """
+        class ProcessCommSlave:
+            def _send(self, peer, data):
+                self._fenced(peer).send_array(data)
+
+            def _submit(self, peer, data):
+                ch = self._fenced(peer)
+                ch.send_obj(data)
+
+            def _master_send(self, obj):
+                self._master.send_obj(obj)
+
+            def barrier(self):
+                self._master_send(("barrier", 1))
+    """)
+    assert not r.findings
+
+
+def test_r10_scoped_to_comm_slave_classes():
+    # the master (control plane, no epoch) and non-comm dirs are out
+    # of scope
+    src = """
+        class Master:
+            def _serve_slave(self, rank, ch):
+                kind, payload = ch.recv()
+    """
+    assert not run_rule("R10", src).findings
+    slave_src = """
+        class ProcessCommSlave:
+            def _recv(self, peer):
+                return self._channel(peer).recv()
+    """
+    assert not run_rule(
+        "R10", slave_src,
+        path="ytk_mp4j_tpu/models/snippet.py").findings
+    assert run_rule("R10", slave_src).findings
+
+
+def test_r10_inline_suppression_and_baseline():
+    src = """
+        class ProcessCommSlave:
+            def _dial(self, peer):
+                ch = connect(host, port)
+                # mp4j-lint: disable=R10 (handshake pins the epoch)
+                ch.send_obj((self._rank, epoch))
+    """
+    r = run_rule("R10", src)
+    assert not r.findings and len(r.suppressed) == 1
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R10"
+        file = "ytk_mp4j_tpu/comm/snippet.py"
+        context = "ProcessCommSlave._accept_loop"
+        reason = "handshake establishes the epoch"
+    """))
+    r = run_rule("R10", """
+        class ProcessCommSlave:
+            def _accept_loop(self):
+                ch = Channel(sock)
+                hs = ch.recv()
+    """, baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1
 
 
 def test_r9_inline_suppression_and_baseline():

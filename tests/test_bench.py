@@ -95,3 +95,13 @@ def test_bench_socket_map_pickle_leg_smoke():
     assert np.isfinite(rate) and rate > 0
     # the forced-pickle leg must not touch the columnar encoder
     assert all(e.get("keys", 0) == 0 for e in stats.values())
+
+
+def test_bench_socket_recovery_latency_smoke():
+    summary, stats = bench.bench_socket_recovery_latency(
+        procs=2, reps=5, size=4096)
+    assert summary["retries"] >= 1          # the reset actually fired
+    assert np.isfinite(summary["recovery_latency_ms"])
+    ss = summary["steady_state"]
+    assert ss["default_gbs"] > 0 and ss["failstop_gbs"] > 0
+    _check_socket_stats(stats)

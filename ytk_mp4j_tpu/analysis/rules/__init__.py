@@ -11,6 +11,7 @@
 | R7 | error    | mutable defaults / mutated module-level state |
 | R8 | error    | chunk schedule derived from rank-local state |
 | R9 | error    | pickled dict payload on a collective map path |
+| R10 | error   | peer-channel I/O bypassing the epoch fence |
 """
 
 from __future__ import annotations
@@ -32,6 +33,8 @@ from ytk_mp4j_tpu.analysis.rules.r8_chunk_schedule import (
     R8RankLocalChunkSchedule)
 from ytk_mp4j_tpu.analysis.rules.r9_map_payload import (
     R9PickledMapPayload)
+from ytk_mp4j_tpu.analysis.rules.r10_epoch_fence import (
+    R10EpochFenceBypass)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -43,6 +46,7 @@ ALL_RULES = [
     R7MutableState,
     R8RankLocalChunkSchedule,
     R9PickledMapPayload,
+    R10EpochFenceBypass,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

@@ -141,6 +141,12 @@ class _Partitions:
             self._by_n[n] = arr
         return arr[codes]
 
+    def truncate(self, size: int) -> None:
+        """Drop cached placements for codes >= ``size`` (vocabulary
+        rollback, see the codecs' ``truncate``) — a re-grown code may
+        map to a DIFFERENT key, so its cached rank would be wrong."""
+        self._by_n = {n: a[:size] for n, a in self._by_n.items()}
+
 
 class IntKeyCodec:
     """Grow-only int64 key <-> int32 code vocabulary (vectorized)."""
@@ -217,6 +223,23 @@ class IntKeyCodec:
             codes, n, self._by_code.size,
             lambda old: self._by_code[old:].tolist())
 
+    def truncate(self, size: int) -> None:
+        """Roll the vocabulary back to its first ``size`` codes — the
+        epoch-fenced retry's codec restore (ISSUE 5): a failed map
+        collective may have grown the codec on SOME ranks before the
+        abort tore the decision broadcast, and re-running ``novel()``
+        against the half-grown vocabulary would desynchronize code
+        tables job-wide. Restoring every rank to the (identical)
+        pre-attempt size re-establishes the invariant the retry's
+        sync round then grows from."""
+        if size >= self._by_code.size:
+            return
+        self._by_code = self._by_code[:size]
+        keep = self._sorted_codes < size
+        self._sorted = self._sorted[keep]
+        self._sorted_codes = self._sorted_codes[keep]
+        self._partitions.truncate(size)
+
 
 class ObjKeyCodec:
     """Grow-only hashable-key <-> int32 code vocabulary."""
@@ -274,3 +297,13 @@ class ObjKeyCodec:
         return self._partitions.lookup(
             codes, n, len(self._by_code),
             lambda old: self._by_code[old:])
+
+    def truncate(self, size: int) -> None:
+        """See :meth:`IntKeyCodec.truncate`."""
+        if size >= len(self._by_code):
+            return
+        for k in self._by_code[size:]:
+            del self._code[k]
+        del self._by_code[size:]
+        self._arr = None
+        self._partitions.truncate(size)

@@ -9,9 +9,17 @@ This is that master, rebuilt in Python over the framed-socket transport.
 It can run embedded (a thread, for tests and single-host jobs) or as a
 CLI: ``python -m ytk_mp4j_tpu.comm.master --port P --slaves N``.
 
-Failure model matches the reference: fail-stop, fixed slave count, no
-elastic recovery (SURVEY.md section 5) — but rendezvous has an optional
-timeout as a cheap diagnosability win over indefinite hangs.
+Failure model (ISSUE 5, a deliberate departure from the reference's
+fail-stop scope, SURVEY.md section 5): the slave count is still fixed —
+no elastic membership — but transient transport faults are recoverable.
+The master drives the epoch-fenced abort protocol (resilience.recovery):
+an ABORT_REQ from any rank fans out an abort round, all-rank acks gate
+the ``abort_go`` release, and unrecoverable states (dead control
+connection, stalled round, exhausted retry budget, watchdog-escalated
+barrier stall) fan out ONE terminal abort so every surviving rank
+raises the same ``Mp4jFatalError`` within its bounded wait.
+``MP4J_MAX_RETRIES=0`` restores the reference's exact fail-stop
+contract. Rendezvous keeps its optional timeout.
 
 Observability (ISSUE 3): slaves piggyback periodic TELEMETRY heartbeats
 (``{progress, stats}``, schema in obs.telemetry) on the control
@@ -46,6 +54,8 @@ BARRIER = "barrier"
 CLOSE = "close"
 TELEMETRY = "telemetry"   # periodic heartbeat: {progress, stats}
 DIAGNOSE = "diagnose"     # a slave's bounded wait expired; report it
+ABORT_REQ = "abort_req"   # a collective failed; start an abort round
+ABORT_ACK = "abort_ack"   # slave finished tearing down the old epoch
 
 
 class Master:
@@ -55,7 +65,8 @@ class Master:
     def __init__(self, slave_num: int, port: int = 0, host: str = "",
                  log_stream=None, timeout: float | None = 120.0,
                  handshake_timeout: float | None = 5.0,
-                 stall_timeout: float | None = 60.0):
+                 stall_timeout: float | None = 60.0,
+                 dead_rank_secs: float | None = None):
         """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
         bounds each accepted connection's registration message, so one
         stray dial-in stalls rendezvous briefly instead of consuming the
@@ -63,12 +74,23 @@ class Master:
         ``stall_timeout`` arms the barrier watchdog: a barrier
         generation with some ranks still missing after this many
         seconds gets a hang diagnosis logged (once per generation);
-        ``None`` disables the watchdog. The watchdog only LOGS — the
-        barrier itself stays fail-stop, per the reference contract."""
+        ``None`` disables the watchdog.
+
+        ``dead_rank_secs`` (None reads ``MP4J_DEAD_RANK_SECS``;
+        ``float("inf")`` disables escalation, restoring the PR-3
+        log-only watchdog) is the ESCALATION threshold (ISSUE 5): a barrier generation or an
+        abort round still incomplete after this many seconds means a
+        rank is permanently gone or permanently diverged, and the
+        watchdog escalates from the PR-3 log-only diagnosis to a
+        terminal abort fan-out — every surviving rank raises the same
+        clean error instead of relying on its local timeout. It is
+        deliberately much larger than ``stall_timeout``: the diagnosis
+        is cheap and reversible, declaring a rank dead is neither."""
         self.slave_num = slave_num
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
         self.stall_timeout = stall_timeout
+        self.dead_rank_secs = tuning.dead_rank_secs(dead_rank_secs)
         self.log_stream = log_stream if log_stream is not None else sys.stderr
         # log sink config: validated once at construction (a typo'd
         # MP4J_LOG_LEVEL fails the job here, not silently mid-run)
@@ -80,11 +102,22 @@ class Master:
         self._server.listen(slave_num * 2)
         self.port = self._server.getsockname()[1]
         self._channels: list[Channel] = []      # by rank after rendezvous
+        # master->slave pushes (barrier releases, abort fan-outs) may
+        # originate on any serve thread; one lock per slave channel
+        # keeps concurrent pushes from interleaving frame bytes
+        self._send_locks: list[threading.Lock] = []
         self._exit_codes: dict[int, int] = {}
         self._barrier_waiting: dict[int, list[int]] = {}  # gen -> ranks
         self._barrier_since: dict[int, float] = {}        # gen -> mono ts
         self._diagnosed_gens: set[int] = set()
         self._diag_incident_seq: int | None = None  # debounce key
+        # recovery protocol state (ISSUE 5)
+        self._abort_epoch = 0                   # highest epoch fanned out
+        self._abort_acks: set[int] = set()      # ranks acked current round
+        self._abort_progress: dict[int, tuple[int, bool]] = {}
+        self._abort_since: float | None = None  # mono ts of open round
+        self._departed: dict[int, str] = {}     # rank -> why it left
+        self._fatal_msg: str | None = None      # terminal abort, once
         # rank -> last heartbeat: progress fields + stats + arrival time
         self._telemetry: dict[int, dict] = {}
         self._lock = threading.Lock()
@@ -103,10 +136,19 @@ class Master:
                                  daemon=True, name=f"master-slave{rank}")
             t.start()
             threads.append(t)
+        # the watchdog now also drives the dead-rank ESCALATION
+        # (ISSUE 5): it must run even with stall_timeout=None —
+        # disabling the diagnosis must not silently disable the
+        # terminal abort that bounds every recovery wait. Only when
+        # BOTH functions are off (dead_rank_secs=inf too) is there
+        # nothing it could ever do — skip the thread instead of
+        # waking at 1 Hz for the job's lifetime
         watchdog = None
-        if self.stall_timeout is not None:
+        if (self.stall_timeout is not None
+                or self.dead_rank_secs != float("inf")):
             watchdog = threading.Thread(target=self._watchdog_loop,
-                                        daemon=True, name="mp4j-watchdog")
+                                        daemon=True,
+                                        name="mp4j-watchdog")
             watchdog.start()
         try:
             for t in threads:
@@ -178,6 +220,7 @@ class Master:
         for rank, (ch, _) in enumerate(pending):
             ch.send_obj({"rank": rank, "roster": roster})
             self._channels.append(ch)
+            self._send_locks.append(threading.Lock())
 
     def _serve_slave(self, rank: int, ch: Channel):
         try:
@@ -191,20 +234,175 @@ class Master:
                     self._record_telemetry(rank, payload)
                 elif kind == DIAGNOSE:
                     self._handle_diagnose(rank, payload)
+                elif kind == ABORT_REQ:
+                    self._handle_abort_req(rank, payload)
+                elif kind == ABORT_ACK:
+                    self._handle_abort_ack(rank, payload)
                 elif kind == CLOSE:
+                    code = payload["code"]
                     with self._lock:
-                        self._exit_codes[rank] = payload["code"]
-                    ch.send_obj("closed")
+                        self._exit_codes[rank] = code
+                        live_left = (set(range(self.slave_num))
+                                     - set(self._departed)
+                                     - set(self._exit_codes))
+                    with self._send_locks[rank]:
+                        ch.send_obj("closed")
                     ch.close()
+                    self._mark_departed(
+                        rank, f"closed with code {code}")
+                    if code != 0 and live_left:
+                        # a nonzero close is a defect report; peers
+                        # blocked on this rank's data would otherwise
+                        # only find out at their own (long) timeouts
+                        self._fatal_abort(
+                            f"rank {rank} exited with code {code} "
+                            "before the job completed; aborting the "
+                            "job")
                     return
                 else:
                     self._log(rank, "ERROR", f"unknown message {kind!r}")
         except Exception as e:
-            # fail-stop: a dead slave (reset, EOF, corrupt frame) marks a
-            # nonzero exit code; the master keeps serving the others
+            # a dead slave (reset, EOF, corrupt frame) marks a nonzero
+            # exit code and the master keeps serving the others — but
+            # no longer silently (ISSUE 5): a lost connection means the
+            # process died without closing, so the job cannot complete;
+            # fan out the terminal abort so every survivor raises the
+            # same clean error instead of timing out one by one
             self._log(rank, "ERROR", f"slave connection lost: {e!r}")
             with self._lock:
                 self._exit_codes.setdefault(rank, 1)
+            self._mark_departed(rank, f"connection lost ({e!r})")
+            self._fatal_abort(
+                f"rank {rank} is dead (connection lost: {e!r}); "
+                "aborting the job")
+
+    # -- recovery protocol (ISSUE 5) ------------------------------------
+    def _send_to(self, rank: int, obj) -> None:
+        """Push one control message to a slave; a rank that dies while
+        we push is marked departed, never crashes a serve thread."""
+        try:
+            with self._send_locks[rank]:
+                self._channels[rank].send_obj(obj)
+        except (Mp4jError, OSError):
+            self._mark_departed(rank, "unreachable on push")
+
+    def _live_ranks(self) -> set[int]:
+        with self._lock:
+            return set(range(self.slave_num)) - set(self._departed)
+
+    def _mark_departed(self, rank: int, why: str) -> None:
+        with self._lock:
+            self._departed.setdefault(rank, why)
+            pending = self._abort_since is not None
+        if pending:
+            # an open abort round can never complete without this rank
+            self._fatal_abort(
+                f"rank {rank} left during recovery ({why}); "
+                "aborting the job")
+
+    def _handle_abort_req(self, rank: int, payload: dict) -> None:
+        if payload.get("fatal"):
+            self._fatal_abort(
+                f"terminal abort requested by rank {rank}: "
+                f"{payload.get('error')}")
+            return
+        target = int(payload.get("epoch", 0)) + 1
+        with self._lock:
+            if target <= self._abort_epoch:
+                dup = True      # round already fanned out; debounce
+            else:
+                dup = False
+                self._abort_epoch = target
+                self._abort_acks = set()
+                self._abort_progress = {}
+                self._abort_since = time.monotonic()
+                dead = dict(self._departed)
+        self._log(rank, "ERROR",
+                  f"collective '{payload.get('collective')}' failed "
+                  f"(epoch {payload.get('epoch')}): "
+                  f"{payload.get('error')}")
+        if dup:
+            return
+        if dead:
+            self._fatal_abort(
+                f"cannot recover: rank(s) {sorted(dead)} already gone "
+                f"({'; '.join(f'{r}: {w}' for r, w in sorted(dead.items()))})")
+            return
+        self._log("M", "WARN",
+                  f"abort round -> epoch {target}: tearing down the "
+                  f"data plane on all {self.slave_num} ranks")
+        for r in sorted(self._live_ranks()):
+            self._send_to(r, ("abort", target))
+
+    def _handle_abort_ack(self, rank: int, payload: dict) -> None:
+        release = False
+        with self._lock:
+            if int(payload.get("epoch", 0)) != self._abort_epoch:
+                return          # ack for a stale round
+            self._abort_acks.add(rank)
+            self._abort_progress[rank] = (int(payload.get("seq", 0)),
+                                          bool(payload.get("inflight")))
+            live = set(range(self.slave_num)) - set(self._departed)
+            if self._abort_since is not None and live <= self._abort_acks:
+                release = True
+                self._abort_since = None
+                epoch = self._abort_epoch
+                progress = {r: self._abort_progress.get(r, (0, False))
+                            for r in sorted(live)}
+        if not release:
+            return
+        mixed = self._mixed_progress(progress)
+        if mixed is not None:
+            self._fatal_abort(mixed)
+            return
+        self._log("M", "WARN",
+                  f"abort round complete: releasing epoch {epoch} "
+                  f"to all ranks")
+        for r in sorted(self._live_ranks()):
+            self._send_to(r, ("abort_go", epoch))
+
+    @staticmethod
+    def _mixed_progress(progress: dict) -> str | None:
+        """Recovery is PER-COLLECTIVE: a round may only be released
+        when every in-flight rank is retrying the SAME collective
+        ordinal m, and every idle rank sits exactly one behind (it
+        will enter m fresh). Any other shape means the fault spans a
+        collective boundary — a rank that already completed m cannot
+        re-serve its contribution (its input snapshot is gone), so
+        retrying would deadlock or, worse, pair mismatched exchanges
+        into silently wrong results. Returns the terminal message, or
+        None when consistent."""
+        inflight = {r: s for r, (s, f) in progress.items() if f}
+        if not inflight:
+            return None
+        m = max(inflight.values())
+        bad = {r: s for r, (s, f) in progress.items()
+               if (f and s != m) or (not f and s != m - 1)}
+        if not bad:
+            return None
+        detail = ", ".join(
+            f"rank {r} at collective #{s}"
+            f"{' (in flight)' if progress[r][1] else ' (completed)'}"
+            for r, s in sorted(bad.items()))
+        return (f"cannot recover: the fault spans a collective "
+                f"boundary — ranks retrying collective #{m} but "
+                f"{detail}; recovery is per-collective (align the "
+                "schedule, e.g. with a barrier, to make this fault "
+                "window recoverable)")
+
+    def _fatal_abort(self, msg: str) -> None:
+        """Fan the terminal abort out to every live rank, once. The
+        message is composed HERE so all ranks raise identically."""
+        with self._lock:
+            if self._fatal_msg is not None:
+                return
+            self._fatal_msg = msg
+            self._abort_since = None
+        self._log("M", "ERROR", f"terminal abort: {msg}")
+        for line in self.diagnose():
+            self._log("M", "WARN", line)
+        for r in sorted(self._live_ranks()):
+            self._send_to(r, ("abort_fatal", msg))
 
     def _log(self, rank, level: str, msg: str):
         """Centralized log sink: ISO-8601 timestamps and a fixed-width
@@ -287,23 +485,50 @@ class Master:
         return telemetry_mod.format_skew(self.cluster_stats())
 
     def _watchdog_loop(self):
-        """Diagnose stalled barriers: a generation some ranks reached
-        ``stall_timeout`` seconds ago while others never arrived is the
-        mismatched-schedule deadlock signature — log the diagnosis once
-        per generation. Logging only; the barrier stays fail-stop."""
-        tick = min(1.0, max(0.05, self.stall_timeout / 4))
+        """Diagnose stalled barriers, then ACT on them (ISSUE 5).
+
+        A generation some ranks reached ``stall_timeout`` seconds ago
+        while others never arrived is the mismatched-schedule deadlock
+        signature — log the diagnosis once per generation (the PR-3
+        behavior). A generation (or an open abort round) still
+        incomplete after ``dead_rank_secs`` escalates to the terminal
+        abort fan-out: the whole cluster raises one clean error instead
+        of each rank relying on its local timeout — the watchdog is no
+        longer log-only. ``stall_timeout=None`` disables the diagnosis
+        only; ``dead_rank_secs=inf`` disables the escalation only."""
+        bounds = [t for t in (self.stall_timeout, self.dead_rank_secs)
+                  if t is not None and t != float("inf")]
+        tick = min(1.0, max(0.05, min(bounds) / 4)) if bounds else 1.0
         while not self._stop.wait(tick):
             now = time.monotonic()
-            stalled = []
+            stalled, fatal = [], None
             with self._lock:
                 for gen, since in self._barrier_since.items():
-                    if (gen in self._barrier_waiting
-                            and gen not in self._diagnosed_gens
-                            and now - since > self.stall_timeout):
+                    if gen not in self._barrier_waiting:
+                        continue
+                    age = now - since
+                    if (age > self.dead_rank_secs
+                            and self._fatal_msg is None):
+                        missing = sorted(
+                            set(range(self.slave_num))
+                            - set(self._barrier_waiting[gen]))
+                        fatal = (f"barrier gen {gen} stalled for "
+                                 f"{age:.1f}s waiting on ranks "
+                                 f"{missing}; aborting the job")
+                    elif (self.stall_timeout is not None
+                            and age > self.stall_timeout
+                            and gen not in self._diagnosed_gens):
                         self._diagnosed_gens.add(gen)
                         stalled.append(
-                            (gen, list(self._barrier_waiting[gen]),
-                             now - since))
+                            (gen, list(self._barrier_waiting[gen]), age))
+                if (fatal is None and self._abort_since is not None
+                        and now - self._abort_since > self.dead_rank_secs):
+                    missing = sorted(set(range(self.slave_num))
+                                     - set(self._departed)
+                                     - self._abort_acks)
+                    fatal = (f"abort round -> epoch {self._abort_epoch} "
+                             f"stalled: no teardown ack from ranks "
+                             f"{missing}; aborting the job")
             for gen, ranks, age in stalled:
                 missing = sorted(set(range(self.slave_num)) - set(ranks))
                 self._log("M", "WARN",
@@ -312,19 +537,30 @@ class Master:
                           f"{missing}")
                 for line in self.diagnose():
                     self._log("M", "WARN", line)
+            if fatal is not None:
+                self._fatal_abort(fatal)
 
     def _barrier(self, rank: int, gen: int, ch: Channel):
         release = False
         with self._lock:
-            waiting = self._barrier_waiting.setdefault(gen, [])
-            self._barrier_since.setdefault(gen, time.monotonic())
-            waiting.append(rank)
-            if len(waiting) == self.slave_num:
-                release = True
+            fatal = self._fatal_msg
+            if fatal is None:
+                waiting = self._barrier_waiting.setdefault(gen, [])
+                self._barrier_since.setdefault(gen, time.monotonic())
+                waiting.append(rank)
+                if len(waiting) == self.slave_num:
+                    release = True
+        if fatal is not None:
+            # the job is terminally aborted: never release a barrier
+            # into it — a straggler arriving after the fan-out must
+            # raise the fatal, not "complete" a dead job (re-push the
+            # message in case the original fan-out raced its dial-in)
+            self._send_to(rank, ("abort_fatal", fatal))
+            return
         if release:
             # release everyone waiting on this generation
-            for r, c in enumerate(self._channels):
-                c.send_obj(("barrier_release", gen))
+            for r in range(len(self._channels)):
+                self._send_to(r, ("barrier_release", gen))
             with self._lock:
                 del self._barrier_waiting[gen]
                 self._barrier_since.pop(gen, None)

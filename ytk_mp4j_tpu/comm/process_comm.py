@@ -33,6 +33,7 @@ measured against (BASELINE.md).
 
 from __future__ import annotations
 
+import copy
 import socket
 import threading
 import time
@@ -45,13 +46,18 @@ from ytk_mp4j_tpu.comm import keycodec
 from ytk_mp4j_tpu.comm import master as master_mod
 from ytk_mp4j_tpu.comm.context import CommSlave
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
-from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.exceptions import (
+    Mp4jError, Mp4jFatalError, Mp4jTransportError)
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.resilience import faults as faults_mod
+from ytk_mp4j_tpu.resilience.recovery import RecoveryManager
 from ytk_mp4j_tpu.transport import channel as channel_mod
 from ytk_mp4j_tpu.transport.channel import Channel, connect
 from ytk_mp4j_tpu.utils import native, trace, tuning
 from ytk_mp4j_tpu.utils.stats import CommStats
+
+import functools
 
 
 class _ScratchPool:
@@ -116,7 +122,11 @@ class ProcessCommSlave(CommSlave):
                  peer_timeout: float | None = None,
                  handshake_timeout: float | None = 30.0,
                  native_transport: bool = True,
-                 map_columnar: bool | None = None):
+                 map_columnar: bool | None = None,
+                 max_retries: int | None = None,
+                 reconnect_backoff: float | None = None,
+                 dead_rank_secs: float | None = None,
+                 fault_plan=None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -138,11 +148,39 @@ class ProcessCommSlave(CommSlave):
         on): the columnar (codes, values) data plane, or False for the
         pickled-dict reference path. JOB-wide like ``native_transport``
         — every slave must agree (see the map-collective section
-        comment)."""
+        comment).
+
+        Resilience (ISSUE 5, all None -> env): ``max_retries``
+        (``MP4J_MAX_RETRIES``) bounds the epoch-fenced abort/retry
+        rounds per failed collective — 0 restores the reference's
+        fail-stop; ``reconnect_backoff`` (``MP4J_RECONNECT_BACKOFF``)
+        is the base of the capped exponential re-dial backoff;
+        ``dead_rank_secs`` (``MP4J_DEAD_RANK_SECS``) bounds every
+        recovery wait before the job goes terminal. ``fault_plan``
+        (``MP4J_FAULT_PLAN``; a grammar string or a
+        :class:`~ytk_mp4j_tpu.resilience.faults.FaultPlan`) arms
+        deterministic fault injection on this rank's data plane —
+        chaos-test machinery, never on by default."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
         self._handshake_timeout = handshake_timeout
         self._native_transport = native_transport
+        # resilience knobs, env-validated up front like the transport
+        # tuning below
+        self._max_retries = (tuning.max_retries() if max_retries is None
+                             else int(max_retries))
+        if self._max_retries < 0:
+            raise Mp4jError(f"max_retries={max_retries} must be >= 0")
+        self._reconnect_backoff = (tuning.reconnect_backoff()
+                                   if reconnect_backoff is None
+                                   else float(reconnect_backoff))
+        self._dead_rank_secs = tuning.dead_rank_secs(dead_rank_secs)
+        if fault_plan is None:
+            spec = tuning.fault_plan_spec()
+            fault_plan = faults_mod.FaultPlan.parse(spec) if spec else None
+        elif isinstance(fault_plan, str):
+            fault_plan = faults_mod.FaultPlan.parse(fault_plan)
+        self._fault_plan = fault_plan
         # job-wide transport tuning (env-validated here, before any
         # connection exists, so a typo'd knob fails the job cleanly)
         # and pipeline state — all of it must exist BEFORE the accept
@@ -193,6 +231,42 @@ class ProcessCommSlave(CommSlave):
         # lower rank's listen socket; one duplex channel per pair.
         self._peers: dict[int, Channel] = {}
         self._peer_cv = threading.Condition()
+        self._dead_channels: list[Channel] = []   # torn down, fd alive
+
+        # recovery engine + control-plane receiver (ISSUE 5). The
+        # control thread is the ONLY reader of the master channel from
+        # here on: barrier releases, close acks and abort fan-outs are
+        # demultiplexed through it, so an asynchronous abort push can
+        # never interleave with a barrier reply.
+        # (outermost collectives entered, one currently in flight) as
+        # ONE tuple-valued attribute: the control thread samples it for
+        # the abort ack, and a two-field sample could tear between the
+        # ordinal bump and the in-flight flag — the master would read
+        # "idle at m+1" next to in-flight ranks retrying m+1 as a
+        # collective-boundary fault and kill a recoverable job
+        self._progress_state = (0, False)
+        self._faults = None
+        if self._fault_plan is not None:
+            inj = faults_mod.FaultInjector(self._fault_plan, self._rank)
+            if not inj.empty:
+                self._faults = inj
+        self._recovery = RecoveryManager(
+            rank=self._rank, max_retries=self._max_retries,
+            dead_rank_secs=self._dead_rank_secs,
+            send_ctl=lambda kind, payload: self._master_send(
+                (kind, payload)),
+            teardown=self._teardown_peers, stats=self._comm_stats,
+            wake=self._ctl_wake, drain=self._drain_dead_channels,
+            progress=lambda: self._progress_state)
+        self._ctl_cv = threading.Condition()
+        self._barrier_released: set[int] = set()
+        self._closed_ack = threading.Event()
+        self._closed = False    # before the ctl thread can observe it
+        self._ctl_thread = threading.Thread(
+            target=self._ctl_loop, daemon=True,
+            name=f"mp4j-ctl-r{self._rank}")
+        self._ctl_thread.start()
+
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"mp4j-accept-r{self._rank}")
@@ -201,8 +275,10 @@ class ProcessCommSlave(CommSlave):
         # simultaneous exchanges)
         self._pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"mp4j-send-r{self._rank}")
+        # outstanding helper-thread sends; only the collective thread
+        # touches this (submit + the drain barrier), no lock needed
+        self._send_futs: list = []
         self._barrier_gen = 0
-        self._closed = False
         # telemetry heartbeat (control plane only — never touches the
         # peer data channels, so it cannot block a collective): ships
         # {progress, stats} to the master every MP4J_HEARTBEAT_SECS
@@ -247,12 +323,146 @@ class ProcessCommSlave(CommSlave):
         gen = self._barrier_gen
         self._barrier_gen += 1
         self._master_send((master_mod.BARRIER, {"gen": gen}))
-        # the release waits on the slowest rank indefinitely — the
-        # reference's fail-stop contract, not a missing timeout
-        # mp4j-lint: disable=R2 (fail-stop barrier wait)
-        reply = self._master.recv()
-        if reply != ("barrier_release", gen):
-            raise Mp4jError(f"barrier protocol violation: {reply!r}")
+        with self._ctl_cv:
+            # the release waits on the slowest rank indefinitely — the
+            # reference's fail-stop contract, not a missing timeout —
+            # but a terminal abort (dead rank, watchdog escalation)
+            # breaks the wait with the cluster-wide error
+            self._ctl_cv.wait_for(
+                lambda: gen in self._barrier_released
+                or self._recovery.fatal is not None)
+            if gen in self._barrier_released:
+                self._barrier_released.discard(gen)
+                return
+        raise Mp4jFatalError(self._recovery.fatal)
+
+    # -- control-plane receiver (ISSUE 5) -------------------------------
+    @property
+    def epoch(self) -> int:
+        """The job-wide recovery epoch this rank has been released
+        into (0 until the first abort round completes)."""
+        return self._recovery.epoch
+
+    def _ctl_wake(self) -> None:
+        with self._ctl_cv:
+            self._ctl_cv.notify_all()
+        with self._peer_cv:
+            self._peer_cv.notify_all()
+
+    def _teardown_peers(self) -> None:
+        """Invalidate every peer channel and forget it — the DRAIN of
+        an abort round: in-flight frames of the old epoch die with
+        their sockets (raw and framed planes alike), and any
+        collective blocked on one of them unblocks with a transport
+        error. The channels are only SHUT DOWN here, not closed: the
+        fd release is deferred to the collective thread
+        (:meth:`_drain_dead_channels`) so a native poll still
+        unwinding cannot race a re-dial onto a recycled fd number.
+        Idempotent; runs on the control thread."""
+        with self._peer_cv:
+            chans = list(self._peers.values())
+            self._peers.clear()
+            self._dead_channels.extend(chans)
+            self._peer_cv.notify_all()
+        for ch in chans:
+            ch.invalidate()
+
+    def _drain_dead_channels(self) -> None:
+        """Release the fds of torn-down channels. Called from the
+        COLLECTIVE thread between attempts (and at close): the
+        previous attempt has fully unwound, so no native call can
+        still hold these raw fd numbers — only now is fd reuse safe.
+
+        "Fully unwound" must cover the send-helper thread too: a recv
+        that raised first abandons its paired send future, and that
+        worker may still be entering sendall on a torn fd — wait for
+        every outstanding send (bounded: the teardown's shutdown()
+        errors them out) before any fd is freed for reuse."""
+        futs, self._send_futs = self._send_futs, []
+        for f in futs:
+            try:
+                f.result(timeout=5.0)
+            # Not a data path: these futures belong to a torn-down
+            # attempt and are expected to error — the wait exists only
+            # to fence fd reuse; the failure was already reported by
+            # the recv that triggered the teardown.
+            # mp4j-lint: disable=R5 (expected errors from torn-channel sends)
+            except Exception:
+                pass
+        with self._peer_cv:
+            chans = list(self._dead_channels)
+            self._dead_channels.clear()
+        for ch in chans:
+            try:
+                ch.close()
+            except OSError:
+                pass
+
+    def _ctl_loop(self) -> None:
+        """The single reader of the master channel after rendezvous:
+        demultiplexes barrier releases, the close ack, and the
+        recovery protocol's asynchronous abort pushes. Must stay alive
+        while any collective blocks — delivering an abort is what
+        unhangs it."""
+        while True:
+            try:
+                msg = self._master.recv()
+            except (Mp4jError, OSError, EOFError) as e:
+                if not self._closed:
+                    self._recovery.on_fatal(
+                        f"master connection lost: {e!r}")
+                    self._ctl_wake()
+                return
+            if msg == "closed":
+                self._closed_ack.set()
+                self._ctl_wake()
+                return
+            kind = msg[0] if isinstance(msg, tuple) and msg else None
+            try:
+                if kind == "barrier_release":
+                    with self._ctl_cv:
+                        self._barrier_released.add(msg[1])
+                        self._ctl_cv.notify_all()
+                elif kind == "abort":
+                    self._recovery.on_abort(int(msg[1]))
+                elif kind == "abort_go":
+                    self._recovery.on_go(int(msg[1]))
+                elif kind == "abort_fatal":
+                    self._recovery.on_fatal(str(msg[1]))
+                else:
+                    # fail fast like the pre-ISSUE-5 barrier reply
+                    # check: an unrecognized control frame means the
+                    # two sides disagree about the protocol — waiting
+                    # would hang
+                    self._recovery.on_fatal(
+                        f"control protocol violation: unexpected "
+                        f"master message {msg!r}")
+                    return
+            except Exception as e:
+                # a malformed-but-tuple frame (('abort',), ('abort',
+                # 'x'), ...) must not kill the sole master-channel
+                # reader silently: an untimed barrier wait would then
+                # hang forever with nobody left to deliver the
+                # master's eventual abort — turn it fatal instead
+                self._recovery.on_fatal(
+                    f"control protocol violation: malformed master "
+                    f"message {msg!r} ({e!r})")
+                return
+
+    def _fault_kill(self, fault) -> None:
+        """Fault-injected death (resilience.faults ``kill``): abruptly
+        close every socket this rank owns, as a crashed process would.
+        The master sees the control connection die and fans out the
+        terminal abort to the survivors."""
+        self._hb_stop.set()
+        with self._master_lock:
+            self._closed = True
+        self._teardown_peers()
+        try:
+            self._master.close()
+        except OSError:
+            pass
+        self._server.close()
 
     # -- telemetry (control plane only) --------------------------------
     def _telemetry_payload(self) -> dict:
@@ -286,6 +496,7 @@ class ProcessCommSlave(CommSlave):
         if self._closed:
             return
         self._hb_stop.set()
+        sent = False
         with self._master_lock:
             if self._closed:
                 return
@@ -297,14 +508,21 @@ class ProcessCommSlave(CommSlave):
             except (Mp4jError, OSError):
                 pass  # master may already be gone; close proceeds
             self._closed = True
-            self._master.send_obj((master_mod.CLOSE, {"code": code}))
-        try:
-            self._master.recv()  # "closed" ack
-        except Mp4jError:
-            pass
+            try:
+                self._master.send_obj((master_mod.CLOSE, {"code": code}))
+                sent = True
+            except (Mp4jError, OSError):
+                pass
+        if sent:
+            # the "closed" ack arrives on the control thread; bounded —
+            # a vanished master must not wedge shutdown
+            self._closed_ack.wait(5.0)
         self._master.close()
-        for ch in self._peers.values():
-            ch.close()
+        for ch in list(self._peers.values()):
+            # graceful: a peer recovering from a late abort round may
+            # still be draining our final collective's bytes
+            ch.close(graceful=True)
+        self._drain_dead_channels()
         self._server.close()
         self._pool.shutdown(wait=False)
 
@@ -336,29 +554,77 @@ class ProcessCommSlave(CommSlave):
                 ch = Channel(sock)
                 # bound the rank exchange: a stray connection that never
                 # sends must not wedge the accept loop every healthy
-                # peer depends on
+                # peer depends on. The handshake carries (rank, epoch)
+                # — the dialer pins the channel's job-wide epoch here,
+                # the frame-level half of the epoch fence.
                 ch.set_timeout(self._handshake_timeout)
-                peer_rank = ch.recv()
+                # sanctioned pre-fence receive: the handshake decides
+                # which epoch the channel BELONGS to, so the fence
+                # cannot apply yet (mp4j-lint R10 baseline)
+                hs = ch.recv()
+                peer_rank, peer_epoch = hs
+                # strict integer types, no coercion: int('2')/int(2.7)
+                # would let a stray dial-in claim a healthy rank's
+                # peer slot (bool is an int subclass — reject it too)
+                if (isinstance(peer_rank, bool)
+                        or not isinstance(peer_rank, int)
+                        or isinstance(peer_epoch, bool)
+                        or not isinstance(peer_epoch, int)):
+                    raise TypeError(f"malformed peer handshake {hs!r}")
             except Exception:
                 # a peer (or stray connection) died mid-handshake; the
                 # accept loop must survive to serve the healthy peers
                 sock.close()
                 continue
             with self._peer_cv:
-                # only a well-formed, novel rank may claim a peer slot:
-                # a stray dial-in that does send a frame must not
-                # hijack (or orphan) a healthy peer's channel
-                if (not isinstance(peer_rank, int)
-                        or not 0 <= peer_rank < self._n
+                # a dialer can be ahead of us by one abort round (its
+                # go arrived first): wait for our own go instead of
+                # rejecting a healthy reconnect
+                if peer_epoch > self._recovery.epoch:
+                    self._peer_cv.wait_for(
+                        lambda: self._recovery.epoch >= peer_epoch
+                        or self._recovery.fatal is not None,
+                        timeout=self._handshake_timeout)
+                # only a well-formed, novel rank dialing at the CURRENT
+                # epoch may claim a peer slot: a stray dial-in — or a
+                # stale one from a torn-down epoch — must not hijack
+                # (or orphan) a healthy peer's channel. abort_pending
+                # closes the announce->go window, where the epoch
+                # number still matches but the teardown may already
+                # have drained _peers (a registration after it would
+                # never be invalidated)
+                if (not 0 <= peer_rank < self._n
                         or peer_rank == self._rank
-                        or peer_rank in self._peers):
+                        or peer_rank in self._peers
+                        or peer_epoch != self._recovery.epoch
+                        or self._recovery.abort_pending()):
                     ch.close()
                     continue
                 ch.set_timeout(self._peer_timeout)
                 ch.stats = self._comm_stats  # peer channels book wire time
                 ch.peer_rank = peer_rank     # tags wire spans
+                ch.faults = self._faults     # fault-injection hook
+                ch.epoch = peer_epoch        # pinned for the fence
                 self._peers[peer_rank] = ch
                 self._peer_cv.notify_all()
+            if peer_epoch > 0:
+                self._comm_stats.add("reconnects", 1)
+
+    def _fenced(self, peer: int) -> Channel:
+        """THE epoch-fence wrapper: every peer data-plane operation
+        must acquire its channel here (mp4j-lint R10). One flag check
+        on the hot path — when an abort round is in flight this raises
+        immediately instead of dialing into (or writing to) a torn
+        epoch, so every rank converges on the retry barrier instead of
+        manufacturing fresh wire errors."""
+        self._recovery.poll()
+        ch = self._channel(peer)
+        # the channel's pinned epoch must also match the attempt's: a
+        # full abort round can complete while _channel blocks waiting
+        # for a peer dial-in, handing a fresh-epoch channel to a stale
+        # attempt that already passed poll()
+        self._recovery.check_channel(ch.epoch)
+        return ch
 
     def _channel(self, peer: int) -> Channel:
         if peer == self._rank or not (0 <= peer < self._n):
@@ -367,45 +633,132 @@ class ProcessCommSlave(CommSlave):
             ch = self._peers.get(peer)
             if ch is not None:
                 return ch
-            if peer < self._rank:
-                # Creation is serialized under the cv so a concurrent
-                # send+recv pair (ring _sendrecv) can't dial the same peer
-                # twice and orphan one connection. The outbound connect
-                # does not depend on our own accept loop, so holding the
-                # lock here cannot deadlock.
-                host, port = self._roster[peer]
-                ch = connect(host, port, timeout=self._timeout)
-                ch.send_obj(self._rank)
+        if peer < self._rank:
+            # Dial OUTSIDE the cv: only the collective thread ever
+            # dials (helper-thread sends bind their channel at submit
+            # time), so no serialization is needed — and a connect()
+            # blocked on an unreachable host must not hold the lock
+            # the control thread's abort teardown and the accept loop
+            # both depend on (a held cv would stall this rank's
+            # ABORT_ACK for the whole connect timeout and escalate a
+            # recoverable fault to a terminal abort).
+            ch = self._dial(peer)
+            with self._peer_cv:
+                if (ch.epoch != self._recovery.epoch
+                        or self._recovery.abort_pending()):
+                    # an abort round completed — or was announced and
+                    # its teardown already ran (epoch unchanged until
+                    # the go, so equality alone misses it) — while we
+                    # were dialing: registering this channel would park
+                    # it past the drain and wedge every retry behind
+                    # it — discard and re-route through the recovery
+                    # engine instead
+                    ch.close()
+                    self._recovery.poll()
+                    raise Mp4jTransportError(
+                        f"dial to peer {peer} landed in a torn-down "
+                        f"epoch {ch.epoch}")
                 ch.set_timeout(self._peer_timeout)
-                ch.stats = self._comm_stats  # peer channels book wire time
+                ch.stats = self._comm_stats  # channels book wire time
                 ch.peer_rank = peer          # tags wire spans
+                ch.faults = self._faults     # fault-injection hook
                 self._peers[peer] = ch
                 self._peer_cv.notify_all()
-                return ch
-            # lower rank waits for the higher rank to dial in
+            if ch.epoch > 0:
+                self._comm_stats.add("reconnects", 1)
+            return ch
+        with self._peer_cv:
+            # lower rank waits for the higher rank to dial in; an abort
+            # or terminal fan-out breaks the wait (the dial will never
+            # come for a torn-down epoch)
             ok = self._peer_cv.wait_for(
-                lambda: peer in self._peers, timeout=self._timeout)
-            if not ok:
-                raise Mp4jError(f"timeout waiting for peer {peer} to connect")
-            return self._peers[peer]
+                lambda: peer in self._peers
+                or self._recovery.abort_pending(),
+                timeout=self._timeout)
+            if peer in self._peers:
+                return self._peers[peer]
+        self._recovery.poll()   # raises if that is why we woke
+        if not ok:
+            raise Mp4jTransportError(
+                f"timeout waiting for peer {peer} to connect")
+        raise Mp4jTransportError(
+            f"peer {peer} never re-dialed after recovery")
 
-    def _send(self, peer: int, data, compress: bool = False) -> None:
-        ch = self._channel(peer)
+    def _dial(self, peer: int) -> Channel:
+        """Dial a lower rank's listen socket with capped exponential
+        backoff (``MP4J_RECONNECT_BACKOFF``): after an abort round the
+        remote may still be tearing down, so the first attempt can see
+        a refused/reset connect. Runs WITHOUT the peer cv (see
+        _channel); the fence poll each iteration keeps the loop
+        abort-aware. The channel's epoch is pinned HERE and rides the
+        handshake."""
+        host, port = self._roster[peer]
+        deadline = (None if self._timeout is None
+                    else time.monotonic() + self._timeout)
+        backoff = max(self._reconnect_backoff, 0.001)
+        while True:
+            self._recovery.poll()
+            epoch = self._recovery.epoch
+            ch = None
+            try:
+                ch = connect(host, port, timeout=self._timeout)
+                # sanctioned pre-fence send: the handshake pins the
+                # epoch the fence will enforce (mp4j-lint R10 baseline)
+                ch.send_obj((self._rank, epoch))
+                ch.epoch = epoch
+                return ch
+            except (Mp4jTransportError, OSError):
+                # OSError included: the remote can accept the TCP
+                # connection and tear it down before our handshake
+                # send lands (exactly the post-abort window this
+                # backoff exists for) — a raw ECONNRESET/EPIPE must
+                # back off locally, not burn a job-wide retry round
+                if ch is not None:
+                    ch.close()
+                if (deadline is not None
+                        and time.monotonic() + backoff > deadline):
+                    raise
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    @staticmethod
+    def _send_on(ch: Channel, data, compress: bool = False) -> None:
         if isinstance(data, np.ndarray):
             ch.send_array(data, compress=compress)
         else:
             ch.send_obj(data, compress=compress)
 
+    def _send(self, peer: int, data, compress: bool = False) -> None:
+        self._send_on(self._fenced(peer), data, compress)
+
+    def _submit_send(self, peer: int, data, compress: bool = False):
+        """Helper-thread send with the channel resolved NOW, under the
+        epoch fence — a queued send job from an attempt the recovery
+        engine has since aborted must error on its own (closed) channel,
+        never late-resolve a fresh one and write stale-epoch bytes into
+        the retry's stream."""
+        fut = self._pool.submit(self._send_on, self._fenced(peer),
+                                 data, compress)
+        # tracked so _drain_dead_channels can wait for abandoned
+        # futures (a recv that raises first orphans its paired send)
+        # before it frees fds; pruned opportunistically so a healthy
+        # run never grows the list
+        self._send_futs.append(fut)
+        if len(self._send_futs) > 32:
+            self._send_futs = [f for f in self._send_futs
+                               if not f.done()]
+        return fut
+
     def _recv(self, peer: int):
         # peer channels carry ``peer_timeout`` from creation (_channel /
         # _accept_loop); None is the reference's fail-stop default
         # mp4j-lint: disable=R2 (peer_timeout is set at channel creation)
-        return self._channel(peer).recv()
+        return self._fenced(peer).recv()
 
     def _sendrecv(self, send_peer: int, recv_peer: int, data,
                   compress: bool = False):
         """Send and receive concurrently (paired exchange, ring step)."""
-        fut = self._pool.submit(self._send, send_peer, data, compress)
+        fut = self._submit_send(send_peer, data, compress)
         out = self._recv(recv_peer)
         fut.result()
         return out
@@ -430,8 +783,17 @@ class ProcessCommSlave(CommSlave):
     def _exchange_raw(self, send_peer: int, recv_peer: int,
                       sarr: np.ndarray | None, rarr: np.ndarray | None):
         """Full-duplex raw exchange; either side may be absent (None)."""
-        send_ch = self._channel(send_peer) if sarr is not None else None
-        recv_ch = self._channel(recv_peer) if rarr is not None else None
+        send_ch = self._fenced(send_peer) if sarr is not None else None
+        recv_ch = self._fenced(recv_peer) if rarr is not None else None
+        if self._faults is not None:
+            # injector hook at exchange granularity: the native C++
+            # poll loop moves the bytes without touching the Python
+            # channel primitives, so the channel-level hooks alone
+            # would silently skip the raw plane
+            if send_ch is not None:
+                self._faults.on_io(send_ch, "send")
+            if recv_ch is not None and recv_ch is not send_ch:
+                self._faults.on_io(recv_ch, "recv")
         if sarr is not None:
             sarr = np.ascontiguousarray(sarr)
         sides = " ".join(
@@ -455,8 +817,10 @@ class ProcessCommSlave(CommSlave):
         except Exception as e:
             # also catches the fallback's raw socket errors (BrokenPipe,
             # socket.timeout from the helper-thread send) so the "dead
-            # peer becomes Mp4jError" contract holds on every path
-            raise Mp4jError(f"raw exchange ({sides}) failed: {e}") from None
+            # peer becomes Mp4jError" contract holds on every path —
+            # typed TRANSPORT so the recovery engine may retry it
+            raise Mp4jTransportError(
+                f"raw exchange ({sides}) failed: {e}") from None
         self._comm_stats.add_wire(
             0 if sarr is None else sarr.nbytes,
             0 if rarr is None else rarr.nbytes,
@@ -541,7 +905,7 @@ class ProcessCommSlave(CommSlave):
                 self._chunked_exchange(peer, peer, None, rbuf,
                                        on_chunk=merge)
             else:
-                self._channel(peer).recv_array_into(rbuf, on_chunk=merge)
+                self._fenced(peer).recv_array_into(rbuf, on_chunk=merge)
         finally:
             self._give_buf(rbuf)
 
@@ -560,10 +924,10 @@ class ProcessCommSlave(CommSlave):
                 self._chunked_exchange(peer, peer, send_view, rbuf,
                                        on_chunk=merge)
             else:
-                fut = self._pool.submit(
-                    self._send, peer, np.ascontiguousarray(send_view),
+                fut = self._submit_send(
+                    peer, np.ascontiguousarray(send_view),
                     operand.compress)
-                self._channel(peer).recv_array_into(rbuf, on_chunk=merge)
+                self._fenced(peer).recv_array_into(rbuf, on_chunk=merge)
                 fut.result()
         finally:
             self._give_buf(rbuf)
@@ -599,7 +963,7 @@ class ProcessCommSlave(CommSlave):
             # destination view (decompressing chunk-wise if compressed)
             view = arr[s:e]
             if view.flags.c_contiguous and view.flags.writeable:
-                self._channel(peer).recv_array_into(view)
+                self._fenced(peer).recv_array_into(view)
             else:
                 arr[s:e] = self._recv(peer)
         else:
@@ -771,8 +1135,8 @@ class ProcessCommSlave(CommSlave):
                 self._exchange_raw_into(partner, partner, arr[ms:me],
                                         arr[ts:te], operand)
             else:
-                fut = self._pool.submit(
-                    self._send, partner, np.ascontiguousarray(arr[ms:me]),
+                fut = self._submit_send(
+                    partner, np.ascontiguousarray(arr[ms:me]),
                     operand.compress)
                 self._recv_segment_into(partner, arr, ts, te, operand)
                 fut.result()
@@ -903,11 +1267,11 @@ class ProcessCommSlave(CommSlave):
                     self._chunked_exchange(right, left, out, rbuf,
                                            on_chunk=merge)
                 else:
-                    fut = self._pool.submit(
-                        self._send, right, np.ascontiguousarray(out),
+                    fut = self._submit_send(
+                        right, np.ascontiguousarray(out),
                         operand.compress)
-                    self._channel(left).recv_array_into(rbuf,
-                                                        on_chunk=merge)
+                    self._fenced(left).recv_array_into(rbuf,
+                                                       on_chunk=merge)
                     fut.result()
                 # the previous carry finished its last duty (this
                 # step's send) — recycle its buffer
@@ -942,8 +1306,8 @@ class ProcessCommSlave(CommSlave):
                 self._exchange_raw_into(right, left, seg, arr[rs:re],
                                         operand)
             elif numeric and operand.is_numeric:
-                fut = self._pool.submit(
-                    self._send, right, np.ascontiguousarray(seg),
+                fut = self._submit_send(
+                    right, np.ascontiguousarray(seg),
                     operand.compress)
                 self._recv_segment_into(left, arr, rs, re, operand)
                 fut.result()
@@ -967,7 +1331,11 @@ class ProcessCommSlave(CommSlave):
         if numeric:
             acc = acc.copy()
         else:
-            acc = list(acc)
+            # value-level copy (see _copy_value): the merge applies
+            # the user operator to acc's elements, and an in-place op
+            # must not reach the caller's objects — reduce_array is
+            # _SNAPSHOT_FREE on the strength of this copy
+            acc = [_copy_value(v) for v in acc]
         mask = 1
         while mask < self._n:
             if vr & mask:
@@ -1280,13 +1648,13 @@ class ProcessCommSlave(CommSlave):
         return out
 
     def _send_map_columns(self, peer: int, cols, operand: Operand):
-        self._channel(peer).send_map_columns(cols[0], cols[1],
-                                             compress=operand.compress)
+        self._fenced(peer).send_map_columns(cols[0], cols[1],
+                                            compress=operand.compress)
 
     def _recv_map_columns(self, peer: int):
         # peer channels carry peer_timeout from creation
         # mp4j-lint: disable=R2 (peer_timeout is set at channel creation)
-        return self._channel(peer).recv_map_columns()
+        return self._fenced(peer).recv_map_columns()
 
     def _merge_map_columns(self, acc, src, operator: Operator):
         """Vectorized sorted-union merge, acc side first — the same
@@ -1324,8 +1692,12 @@ class ProcessCommSlave(CommSlave):
 
     def _reduce_map_obj(self, d: dict, operand: Operand,
                         operator: Operator, root: int) -> dict:
+        # value-level copy, not dict(d): _merge_maps runs the user
+        # operator directly on acc's value objects, and an in-place
+        # op would otherwise mutate the caller's values mid-protocol —
+        # reduce_map is _SNAPSHOT_FREE on the strength of this copy
         acc = self._tree_reduce_walk(
-            dict(d), root,
+            {k: _copy_value(v) for k, v in d.items()}, root,
             lambda peer, a: self._send_map_obj(peer, a, operand),
             lambda peer, a: self._merge_maps(operator, a,
                                              self._recv(peer)))
@@ -1630,5 +2002,188 @@ class ProcessCommSlave(CommSlave):
             raise Mp4jError(f"root {root} out of range [0, {self._n})")
 
 
-# per-collective tracing (utils.trace; zero overhead when disabled)
+# ----------------------------------------------------------------------
+# epoch-fenced recovery wrapper (resilience.recovery, ISSUE 5)
+#
+# Installed UNDER trace.traced: a recovered retry stays inside the one
+# traced/stats scope of its collective call (the wire cost of failed
+# attempts books into the same bucket), and the DIAGNOSE hook fires
+# only when recovery is exhausted — a successfully recovered fault
+# never spams the master.
+# ----------------------------------------------------------------------
+# Collectives that are retry-idempotent WITHOUT an input snapshot —
+# they never mutate the caller's buffer before their last wire
+# operation, or mutate it only with pure overwrites a retry reproduces
+# byte-for-byte:
+#   broadcast/gather/scatter/allgather_array: receivers overwrite
+#     segments with data the retry re-ships identically; senders read
+#     intact data.
+#   reduce_array / reduce_map: the merge runs in an internal copy; the
+#     root writes back after its last receive, with no I/O after.
+#   broadcast_map / scatter_map: d is rebuilt only after the walk (or
+#     after the last share is sent) — no mid-protocol mutation.
+# Everything else (allreduce: in-place halving merges; reduce_scatter:
+# composed root mutation; gather/allgather/reduce_scatter_map: root's
+# dict grows between receives) snapshots its input so a retry starts
+# from the caller's original bytes. Keeping this set tight is a PERF
+# decision: the snapshot memcpy is the resilience layer's only
+# steady-state cost (bench.py socket_recovery steady_state).
+_SNAPSHOT_FREE = frozenset({
+    "broadcast_array", "gather_array", "scatter_array",
+    "allgather_array", "reduce_array", "reduce_map", "broadcast_map",
+    "scatter_map",
+})
+
+# Root-only mutators: every non-root rank only SENDS (both planes of
+# gather_map go direct-to-root, no tree relay), so its payload is
+# never touched and the retry snapshot copy is pure waste there. The
+# map codec-size pin still applies on every rank.
+_SNAPSHOT_ROOT_ONLY = frozenset({"gather_map"})
+
+
+# immutable value types a container snapshot can share by reference
+_IMMUTABLE_VALUES = (np.generic, int, float, complex, bool, str, bytes,
+                     type(None))
+
+
+def _copy_value(v):
+    """Per-element snapshot copy for dict/list payloads. The dict-plane
+    merge runs ``op(acc, src)`` directly on the caller's value objects,
+    and a user operator may mutate ``acc`` in place — a shared
+    reference would make the retry start from already-merged values.
+    Immutables (the whole columnar numeric plane) stay zero-copy."""
+    if isinstance(v, _IMMUTABLE_VALUES):
+        return v
+    if isinstance(v, np.ndarray):
+        return v.copy()
+    return copy.deepcopy(v)
+
+
+def _preserve_payload(self, x):
+    """Snapshot a collective's mutable input for retry idempotence.
+    ndarray snapshots ride the slave's scratch pool — a fresh
+    ``x.copy()`` per call would re-pay mmap + first-touch page faults
+    for every MB, the exact cost the pool exists to amortize."""
+    if isinstance(x, np.ndarray) and x.ndim == 1 and not x.dtype.hasobject:
+        buf = self._scratch.take(x.dtype, x.size)
+        np.copyto(buf, x)
+        return buf
+    if isinstance(x, np.ndarray):
+        return x.copy()
+    if isinstance(x, dict):
+        return {k: _copy_value(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_copy_value(v) for v in x]
+    return None
+
+
+def _restore_payload(x, saved) -> None:
+    """Put the snapshot back before a retry. Mutable container values
+    are re-copied on EVERY restore so ``saved`` stays pristine — a
+    second recovery round must not see the first retry's mutations."""
+    if saved is None:
+        return
+    if isinstance(x, np.ndarray):
+        x[:] = saved
+    elif isinstance(x, dict):
+        x.clear()
+        x.update((k, _copy_value(v)) for k, v in saved.items())
+    elif isinstance(x, list):
+        x[:] = [_copy_value(v) for v in saved]
+
+
+def _recovered(fn, snapshot: bool):
+    """Wrap a collective method with the abort/retry engine (outermost
+    frame only — composed collectives recover as one unit)."""
+    import inspect
+
+    sig = inspect.signature(fn)
+    params = list(sig.parameters)
+    payload_name = params[1] if len(params) > 1 else None
+    root_skip = None    # (index of root in *args, its default)
+    if fn.__name__ in _SNAPSHOT_ROOT_ONLY and "root" in params:
+        root_skip = (params.index("root") - 1,
+                     sig.parameters["root"].default)
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        rec = getattr(self, "_recovery", None)
+        if rec is None:
+            return fn(self, *args, **kwargs)
+        outermost = rec.enter()
+        try:
+            if not outermost:
+                return fn(self, *args, **kwargs)
+            ordinal = self._progress_state[0] + 1
+            self._progress_state = (ordinal, True)
+            if self._faults is not None:
+                # retried attempts keep the first attempt's ordinal
+                # (on_collective runs once per CALL), so a one-shot
+                # fault cannot re-fire into its own recovery
+                self._faults.on_collective(ordinal, self._fault_kill)
+            payload = None
+            if snapshot:
+                # by position OR keyword: a kwarg call must not skip
+                # the snapshot and silently retry on mutated input
+                payload = (args[0] if args
+                           else kwargs.get(payload_name))
+                if root_skip is not None:
+                    ri, rdefault = root_skip
+                    root = (args[ri] if len(args) > ri
+                            else kwargs.get("root", rdefault))
+                    if root != self._rank:
+                        payload = None   # see _SNAPSHOT_ROOT_ONLY
+            is_map = fn.__name__.endswith("_map")
+            saved_box = []
+
+            def preserve():
+                saved = _preserve_payload(self, payload)
+                # map collectives also pin the key-codec sizes: a torn
+                # decision broadcast can leave the vocabulary grown on
+                # SOME ranks only, and a retry negotiating novelty
+                # against half-grown codecs would desync code tables
+                # job-wide — truncating back to the (identical)
+                # pre-attempt sizes restores the invariant
+                sizes = ({k: c.size for k, c in self._map_codecs.items()}
+                         if is_map else None)
+                saved_box.append(saved)
+                return (saved, sizes)
+
+            def restore(pair):
+                saved, sizes = pair
+                if sizes is not None:
+                    for k, c in self._map_codecs.items():
+                        c.truncate(sizes.get(k, 0))
+                _restore_payload(payload, saved)
+
+            try:
+                return rec.run(
+                    fn.__name__,
+                    lambda: fn(self, *args, **kwargs),
+                    preserve, restore)
+            finally:
+                self._progress_state = (ordinal, False)
+                # pooled snapshot buffers go back for the next call
+                if saved_box and isinstance(saved_box[0], np.ndarray) \
+                        and saved_box[0].base is not None:
+                    self._give_buf(saved_box[0])
+        finally:
+            rec.exit()
+
+    return wrapper
+
+
+_RECOVERED_METHODS = tuple(
+    m for m in trace.COLLECTIVE_METHODS if m != "barrier")
+# barrier is excluded: it rides the control plane only — its failure
+# modes ARE the recovery machinery's failure modes (dead master, dead
+# rank), both already terminal.
+for _name in _RECOVERED_METHODS:
+    _fn = ProcessCommSlave.__dict__.get(_name)
+    if _fn is not None and callable(_fn):
+        setattr(ProcessCommSlave, _name,
+                _recovered(_fn, snapshot=_name not in _SNAPSHOT_FREE))
+
+# per-collective tracing (utils.trace; zero overhead when disabled) —
+# wraps OUTSIDE the recovery layer (see comment above)
 trace.instrument(ProcessCommSlave)

@@ -95,6 +95,18 @@ def phase(name: str, seconds: float, pid: int | None, collective: str,
                   _tid(), args))
 
 
+def mark(name: str, pid: int | None, **args: Any) -> None:
+    """A zero-duration recovery event (abort announced, retry started,
+    terminal abort) — renders as an instant tick on the rank's
+    timeline, so ``mp4j-scope`` traces show exactly where a job
+    recovered (ISSUE 5)."""
+    if not _enabled:
+        return
+    _ring.append((name, "recovery", time.perf_counter(), 0.0, pid or 0,
+                  _tid(), {k: v for k, v in args.items()
+                           if v is not None} or None))
+
+
 def collective(name: str, t0: float, dur: float, pid: int | None,
                seq: int) -> None:
     """The outermost collective-call span (emitted by trace.traced)."""

@@ -1,0 +1,319 @@
+"""Epoch-fenced abort/retry recovery for the socket backend.
+
+The job-wide **epoch** is an integer every rank agrees on, advanced
+only by the master's abort protocol. Peer connections pin the epoch at
+dial time (it rides the peer handshake), so "drain stale-epoch frames"
+has a sharp mechanical meaning: an abort round closes every connection
+of the old epoch, and whatever bytes were in flight die with their
+sockets — no frame parsing of torn streams, no heuristics, and it
+covers the unframed raw plane for free.
+
+Protocol (one **abort round**, driven by the master, ISSUE 5)::
+
+    rank r: collective fails with a transport error
+         -> ABORT_REQ {epoch, collective, error}          (control plane)
+    master: first request for this epoch fans out ("abort", epoch+1)
+    every rank (control thread): tear down peer channels  <- the drain;
+            also unblocks any rank stuck in a data-plane call
+         -> ABORT_ACK {epoch+1}
+    master: all live ranks acked -> ("abort_go", epoch+1)
+    every rank: epoch := epoch+1; failed collectives restore their
+            preserved input and re-run; peer channels re-dial lazily
+            with capped exponential backoff (MP4J_RECONNECT_BACKOFF)
+
+Terminal aborts: a dead control connection, a stalled abort round
+(``MP4J_DEAD_RANK_SECS`` without full acks), an escalated barrier
+stall, or an exhausted retry budget (``MP4J_MAX_RETRIES``) makes the
+master fan out ("abort_fatal", msg): every surviving rank raises the
+SAME :class:`~ytk_mp4j_tpu.exceptions.Mp4jFatalError` within its
+bounded wait — never a hang, never a partial result.
+
+What retries: only :data:`RECOVERABLE` failures (transport errors and
+raw OS socket errors). Validation/misuse errors propagate untouched —
+the reference's semantics, see ``exceptions.py``.
+
+Idempotence: the recovery wrapper snapshots the collective's mutable
+payload (array/list/map) at the OUTERMOST entry and restores it before
+each retry, because several collectives merge into the caller's buffer
+mid-flight (recursive halving, composed reduce+scatter). This copy is
+the only steady-state cost of resilience — the fence itself is a flag
+check — and it is skipped entirely at ``MP4J_MAX_RETRIES=0``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ytk_mp4j_tpu.exceptions import (
+    Mp4jAbortError, Mp4jError, Mp4jFatalError, Mp4jTransportError)
+from ytk_mp4j_tpu.obs import spans
+
+# the recoverable class: wire-level Mp4jTransportError (which includes
+# the fence's Mp4jAbortError) plus raw socket/OS failures surfaced by
+# an abort teardown cutting a live operation (EBADF, ECONNRESET, EOF
+# from a helper-thread send, ...)
+RECOVERABLE = (Mp4jTransportError, OSError, EOFError)
+
+
+class RecoveryManager:
+    """Per-slave recovery state machine.
+
+    Two call sides, matching the slave's two threads:
+
+    - the CONTROL thread delivers master messages via
+      :meth:`on_abort` / :meth:`on_go` / :meth:`on_fatal` (and MUST
+      keep doing so while a collective blocks — that is what unhangs
+      it);
+    - the COLLECTIVE thread runs attempts through :meth:`run` and
+      polls the epoch fence via :meth:`poll`.
+
+    ``send_ctl(kind, payload)`` ships a control message to the master
+    (best-effort; may raise). ``teardown()`` closes every peer channel
+    (idempotent; called from the control thread). ``stats`` is the
+    slave's :class:`~ytk_mp4j_tpu.utils.stats.CommStats` — retries and
+    aborts land in its counters and in the span ring.
+    """
+
+    def __init__(self, *, rank: int, max_retries: int,
+                 dead_rank_secs: float, send_ctl, teardown, stats,
+                 wake=None, drain=None, progress=None):
+        self.rank = rank
+        self.max_retries = max_retries
+        self.dead_rank_secs = dead_rank_secs
+        self._send_ctl = send_ctl
+        self._teardown = teardown
+        self._stats = stats
+        self._wake = wake or (lambda: None)
+        self._drain = drain or (lambda: None)
+        # (collective ordinal, in-flight flag) for the abort ack: the
+        # master refuses to release a round whose ranks sit at
+        # DIFFERENT collectives — recovery is per-collective, and a
+        # fault spanning a collective boundary is unrecoverable (a
+        # completed rank cannot re-serve its contribution)
+        self._progress = progress or (lambda: (0, False))
+        self._cond = threading.Condition()
+        self.epoch = 0          # last epoch the master released (go)
+        self._target = 0        # highest abort epoch announced
+        self._fatal: str | None = None
+        self._requested = 0     # highest abort epoch we asked for
+        self._tl = threading.local()
+
+    # ------------------------------------------------------------------
+    # control-thread side
+    # ------------------------------------------------------------------
+    def on_abort(self, target: int) -> None:
+        """Master announced an abort round targeting ``target``: tear
+        down the old epoch's data plane and ack. Runs on the control
+        thread so it fires even while the collective thread is blocked
+        mid-exchange (the teardown is what unblocks it)."""
+        with self._cond:
+            if target <= self._target:
+                return          # duplicate/stale announcement
+            self._target = target
+            self._cond.notify_all()
+        self._teardown()
+        self._stats.add("aborts_seen", 1)
+        spans.mark("abort", self.rank, epoch=target)
+        try:
+            seq, inflight = self._progress()
+            self._send_ctl("abort_ack", {"epoch": target, "seq": seq,
+                                         "inflight": inflight})
+        except (Mp4jError, OSError):
+            pass   # master gone; its watchdog turns this terminal
+        self._wake()
+
+    def on_go(self, epoch: int) -> None:
+        """Master released the round: advance the job-wide epoch."""
+        with self._cond:
+            if epoch > self.epoch:
+                self.epoch = epoch
+            self._cond.notify_all()
+        self._wake()
+
+    def on_fatal(self, msg: str) -> None:
+        """Terminal abort (from the master's fan-out, or locally when
+        the master is unreachable): record the one job-wide message and
+        wake every waiter."""
+        with self._cond:
+            if self._fatal is None:
+                self._fatal = msg
+            self._cond.notify_all()
+        self._teardown()
+        spans.mark("abort_fatal", self.rank)
+        self._wake()
+
+    @property
+    def fatal(self) -> str | None:
+        return self._fatal
+
+    # ------------------------------------------------------------------
+    # collective-thread side
+    # ------------------------------------------------------------------
+    def poll(self) -> None:
+        """The epoch fence: one flag check on the hot path. Raises
+        when this rank must stop touching the data plane — a pending
+        abort round (recoverable), a terminal abort (fatal), or a
+        ZOMBIE attempt: once the master releases a new epoch, an
+        attempt started under the old one may still be unwinding, and
+        without the attempt-epoch pin it would acquire fresh channels
+        and consume (or corrupt) frames that belong to the retry."""
+        if self._fatal is not None:
+            raise Mp4jFatalError(self._fatal)
+        if self._target > self.epoch:
+            raise Mp4jAbortError(
+                f"epoch fence: abort round -> {self._target} in flight "
+                f"(this rank still at epoch {self.epoch})")
+        att = getattr(self._tl, "attempt_epoch", None)
+        if att is not None and att != self.epoch:
+            raise Mp4jAbortError(
+                f"epoch fence: attempt pinned to epoch {att} but the "
+                f"job moved to epoch {self.epoch} (zombie attempt)")
+
+    def check_channel(self, ch_epoch: int) -> None:
+        """Validate a just-acquired channel's pinned epoch against the
+        running attempt (or, outside any attempt, the current epoch).
+        Closes the fence's one remaining gap: a thread that passed
+        ``poll`` and then BLOCKED waiting for a peer dial-in can wake
+        holding a channel from a newer epoch after a full abort round
+        completed mid-wait — using it would steal the retry's frames."""
+        att = getattr(self._tl, "attempt_epoch", None)
+        want = att if att is not None else self.epoch
+        if ch_epoch != want:
+            raise Mp4jAbortError(
+                f"epoch fence: channel pinned to epoch {ch_epoch} but "
+                f"this attempt runs at epoch {want}")
+
+    def abort_pending(self) -> bool:
+        """Non-raising fence read — wait-predicate form of
+        :meth:`poll` (peer-connect waits wake on it)."""
+        return self._fatal is not None or self._target > self.epoch
+
+    def enter(self) -> bool:
+        """Outermost-collective tracking for the recovery wrapper
+        (composed collectives recover at the outermost frame only)."""
+        depth = getattr(self._tl, "depth", 0)
+        self._tl.depth = depth + 1
+        return depth == 0
+
+    def exit(self) -> None:
+        self._tl.depth = getattr(self._tl, "depth", 1) - 1
+
+    def run(self, name: str, attempt, preserve, restore):
+        """Run ``attempt()`` under the abort/retry engine.
+
+        ``preserve()`` snapshots the collective's mutable input (called
+        once, before the first attempt); ``restore(saved)`` puts it
+        back before a retry. Raises ``Mp4jFatalError`` with the
+        master's job-wide message when recovery is impossible."""
+        saved = preserve() if self.max_retries > 0 else None
+        tries = 0
+        try:
+            return self._run_rounds(name, attempt, restore, saved, tries)
+        finally:
+            self._tl.attempt_epoch = None
+
+    def _run_rounds(self, name, attempt, restore, saved, tries):
+        while True:
+            self._join_pending_round()
+            # release fds of channels the last round tore down — only
+            # the collective thread may do this (native-poll fd-reuse
+            # hazard, see Channel.invalidate)
+            self._drain()
+            epoch0 = self.epoch
+            self._tl.attempt_epoch = epoch0   # pin (see poll)
+            try:
+                return attempt()
+            except Mp4jFatalError:
+                raise
+            except RECOVERABLE as e:
+                if self.max_retries == 0:
+                    # fail-stop (the reference's contract): first
+                    # transport error is final, nothing job-wide
+                    if isinstance(e, Mp4jError):
+                        raise
+                    raise Mp4jTransportError(
+                        f"collective '{name}' failed: {e!r}") from e
+                if self._fatal is not None:
+                    raise Mp4jFatalError(self._fatal) from e
+                if tries >= self.max_retries:
+                    self._go_terminal(
+                        f"collective '{name}' on rank {self.rank} "
+                        f"failed after {tries} recovery "
+                        f"round(s): {e}", cause=e)
+                tries += 1
+                self._stats.add("retries", 1, bucket=name)
+                spans.mark("retry", self.rank, collective=name,
+                           attempt=tries, error=repr(e)[:120])
+                self._request_abort(epoch0, name, e)
+                self._await_epoch_past(epoch0, name)
+                if restore is not None:
+                    restore(saved)
+
+    # ------------------------------------------------------------------
+    def _join_pending_round(self) -> None:
+        """A rank entering a collective while an abort round is in
+        flight (its control thread already tore down and acked) waits
+        here for the go instead of dialing into a dying epoch."""
+        deadline = time.monotonic() + self.dead_rank_secs
+        with self._cond:
+            while self._fatal is None and self._target > self.epoch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.5))
+        if self._fatal is not None:
+            raise Mp4jFatalError(self._fatal)
+        if self._target > self.epoch:
+            self._go_terminal(
+                f"rank {self.rank}: abort round -> {self._target} "
+                f"stalled for {self.dead_rank_secs:.1f}s with no "
+                "release from the master")
+
+    def _request_abort(self, epoch0: int, name: str, e) -> None:
+        with self._cond:
+            if self._requested > epoch0:
+                return     # this epoch's round is already requested
+            self._requested = epoch0 + 1
+        try:
+            self._send_ctl("abort_req", {
+                "epoch": epoch0, "collective": name,
+                "error": repr(e)[:300]})
+        except (Mp4jError, OSError):
+            self._go_terminal(
+                f"rank {self.rank}: master unreachable while "
+                f"requesting recovery of '{name}' ({e})")
+
+    def _await_epoch_past(self, epoch0: int, name: str) -> None:
+        deadline = time.monotonic() + self.dead_rank_secs
+        with self._cond:
+            while self._fatal is None and self.epoch <= epoch0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.5))
+        if self._fatal is not None:
+            raise Mp4jFatalError(self._fatal)
+        if self.epoch <= epoch0:
+            self._go_terminal(
+                f"rank {self.rank}: recovery of '{name}' stalled for "
+                f"{self.dead_rank_secs:.1f}s (abort round never "
+                "completed — dead rank or dead master)")
+
+    def _go_terminal(self, msg: str, cause=None):
+        """Ask the master to fan out a terminal abort, then raise the
+        SAME message it broadcasts (so every rank's error reads
+        identically); fall back to the local message if the master is
+        gone. Never returns."""
+        try:
+            self._send_ctl("abort_req", {"fatal": True, "error": msg})
+        except (Mp4jError, OSError):
+            self.on_fatal(msg)
+        deadline = time.monotonic() + min(self.dead_rank_secs, 10.0)
+        with self._cond:
+            while self._fatal is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.25))
+        raise Mp4jFatalError(self._fatal or msg) from cause

@@ -40,7 +40,7 @@ import zlib
 import numpy as np
 
 from ytk_mp4j_tpu.utils import tuning
-from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.exceptions import Mp4jError, Mp4jTransportError
 
 TAG_OBJ = 0
 TAG_ARRAY = 1
@@ -104,12 +104,16 @@ class Channel:
     # build bare instances around socket stand-ins) still frame
     stats = None
     peer_rank = None
+    faults = None     # resilience.faults.FaultInjector on peer channels
+    epoch = 0         # the job-wide epoch this channel was dialed in
     _chunk_bytes = tuning.DEFAULT_CHUNK_BYTES
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.stats = None
         self.peer_rank = None
+        self.faults = None
+        self.epoch = 0
         self._chunk_bytes = tuning.chunk_bytes()
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -129,9 +133,14 @@ class Channel:
         t0 = time.perf_counter() if self.stats is not None else 0.0
         try:
             for b in bufs:
+                # per-buffer hook so an injected cut lands BETWEEN the
+                # header and payload of one frame — a true mid-frame
+                # tear, the hardest drain case for the receiver
+                if self.faults is not None:
+                    self.faults.on_io(self, "send")
                 self.sock.sendall(b)
         except socket.timeout:
-            raise Mp4jError(
+            raise Mp4jTransportError(
                 "send timed out (peer dead or not draining?)") from None
         if self.stats is not None:
             self.stats.add_wire(sum(len(b) for b in bufs), 0,
@@ -146,21 +155,30 @@ class Channel:
         turns that hang into a diagnosable Mp4jError."""
         self.sock.settimeout(timeout)
 
+    def _whom(self) -> str:
+        """Peer tag for error messages (empty off the peer plane)."""
+        return f" (peer {self.peer_rank})" if self.peer_rank is not None \
+            else ""
+
     def _recv_into(self, view: memoryview) -> None:
         """Fill ``view`` from the socket (timeout-aware, fail-stop on a
         closed peer); the building block of every framed receive."""
         n = len(view)
         t0 = time.perf_counter() if self.stats is not None else 0.0
+        if self.faults is not None:
+            self.faults.on_io(self, "recv")
         got = 0
         while got < n:
             try:
                 r = self.sock.recv_into(view[got:], n - got)
             except socket.timeout:
-                raise Mp4jError(
-                    f"receive timed out with {n - got} bytes pending "
-                    "(peer dead or stalled?)") from None
+                raise Mp4jTransportError(
+                    f"receive timed out with {n - got} bytes pending"
+                    f"{self._whom()} (peer dead or stalled?)") from None
             if r == 0:
-                raise Mp4jError("peer closed connection mid-message")
+                raise Mp4jTransportError(
+                    f"peer closed connection mid-message{self._whom()} "
+                    f"({n - got}/{n} bytes short)")
             got += r
         if self.stats is not None:
             self.stats.add_wire(0, n, time.perf_counter() - t0, chunks=0,
@@ -274,13 +292,18 @@ class Channel:
     # DataOutputStream fast path. Used by ProcessCommSlave's numeric
     # collectives (native poll loop when available, these when not).
     def send_raw(self, arr: np.ndarray) -> None:
+        # no injector hook here: the raw plane hooks at EXCHANGE
+        # granularity (_exchange_raw) so the native poll loop and this
+        # fallback see identical fault schedules — a second hook here
+        # would double-fire slow directives on fallback hosts only
         try:
             self.sock.sendall(_raw_view(arr))
         except socket.timeout:
-            raise Mp4jError(
+            raise Mp4jTransportError(
                 "raw send timed out (peer dead or not draining?)") from None
 
     def recv_raw_into(self, arr: np.ndarray) -> None:
+        # no injector hook: see send_raw
         view = memoryview(_raw_view(arr))
         n = len(view)
         got = 0
@@ -288,11 +311,13 @@ class Channel:
             try:
                 r = self.sock.recv_into(view[got:], n - got)
             except socket.timeout:
-                raise Mp4jError(
-                    f"receive timed out with {n - got} raw bytes pending "
-                    "(peer dead or stalled?)") from None
+                raise Mp4jTransportError(
+                    f"receive timed out with {n - got} raw bytes pending"
+                    f"{self._whom()} (peer dead or stalled?)") from None
             if r == 0:
-                raise Mp4jError("peer closed connection mid-message")
+                raise Mp4jTransportError(
+                    f"peer closed connection mid-message{self._whom()} "
+                    f"({n - got}/{n} raw bytes short)")
             got += r
 
     # -- unified receive ------------------------------------------------
@@ -456,7 +481,39 @@ class Channel:
             raise Mp4jError(f"expected array frame, got {type(out)}")
         return out
 
-    def close(self) -> None:
+    def invalidate(self) -> None:
+        """Shut the connection down WITHOUT releasing the fd. The
+        recovery teardown runs on the control thread while the
+        collective thread may sit inside the native poll loop on this
+        channel's raw fd number: ``shutdown`` wakes that poller with
+        EOF/HUP, but an immediate ``close`` would free the fd number
+        for reuse — a re-dialed channel could then recycle it and the
+        still-unwinding native call would poll (or read!) the wrong
+        socket. The owner closes invalidated channels later, from the
+        collective thread, once no native call can be in flight
+        (:meth:`ProcessCommSlave._drain_dead_channels`)."""
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    def close(self, graceful: bool = False) -> None:
+        """Close the channel. ``graceful`` half-closes first (FIN after
+        flushing our send queue, then a bounded drain of inbound bytes
+        until the peer's FIN): a rank finishing its LAST collective
+        must not hard-close while a slower peer is still reading our
+        buffered bytes — a close with unread inbound data turns into a
+        TCP RST that discards our send queue and truncates the peer's
+        stream mid-message. Recovery teardown keeps the abrupt default:
+        there the hard cut IS the drain (stale frames must die)."""
+        if graceful:
+            try:
+                self.sock.shutdown(socket.SHUT_WR)
+                self.sock.settimeout(1.0)
+                while self.sock.recv(65536):
+                    pass
+            except OSError:
+                pass   # timeout/reset: fall through to the hard close
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -480,4 +537,4 @@ def connect(host: str, port: int, timeout: float | None = None) -> Channel:
         except OSError as e:
             sock.close()
             err = e
-    raise Mp4jError(f"cannot connect to {host}:{port}: {err}")
+    raise Mp4jTransportError(f"cannot connect to {host}:{port}: {err}")

@@ -28,7 +28,8 @@ sequence number.
 Schema of one snapshot entry (all keys always present)::
 
     {"calls": int, "bytes_sent": int, "bytes_recv": int,
-     "chunks": int, "keys": int, "wire_seconds": float,
+     "chunks": int, "keys": int, "retries": int, "reconnects": int,
+     "aborts_seen": int, "wire_seconds": float,
      "reduce_seconds": float, "serialize_seconds": float}
 
 Phase seconds are BUSY times and may overlap in wall time (the whole
@@ -53,7 +54,12 @@ import time
 from ytk_mp4j_tpu.obs import spans
 
 _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
-_COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys")
+# retries/reconnects/aborts_seen (ISSUE 5): how many recovery rounds a
+# collective burned (booked into its bucket), how many peer channels
+# were re-dialed into a fresh epoch, and how many abort fan-outs this
+# rank observed (control-plane events, booked wherever the rank stood)
+_COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys",
+             "retries", "reconnects", "aborts_seen")
 
 
 def _zero() -> dict[str, float]:

@@ -38,6 +38,17 @@ Knobs (all validated where they are consumed; garbage raises
   through the persistent key codec; ``0`` forces the pickled-dict
   reference path (``comm/process_comm.py``; README "Sparse map
   collectives").
+- ``MP4J_MAX_RETRIES`` — how many epoch-fenced abort/retry rounds a
+  failed collective may attempt before the job aborts terminally
+  (``resilience/recovery.py``); ``0`` restores the reference's
+  fail-stop behavior (first transport error is final).
+- ``MP4J_RECONNECT_BACKOFF`` — base seconds of the capped exponential
+  backoff used when re-dialing a dead peer channel during recovery.
+- ``MP4J_DEAD_RANK_SECS`` — how stale a rank may go (no abort ack, no
+  barrier arrival) before the master declares it dead and fans out a
+  terminal abort (``comm/master.py``).
+- ``MP4J_FAULT_PLAN`` — deterministic fault-injection plan for chaos
+  testing (``resilience/faults.py``; empty disables injection).
 """
 
 from __future__ import annotations
@@ -55,6 +66,15 @@ DEFAULT_CHUNK_BYTES = 1024 * 1024
 # core counts / NICs tune via env.
 DEFAULT_ALGO_SMALL_BYTES = 256 * 1024
 DEFAULT_ALGO_LARGE_BYTES = 4 * 1024 * 1024
+# Resilience defaults (ISSUE 5): recovery is ON by default — two
+# epoch-fenced retry rounds per failed collective — because the fence
+# itself is a flag check (~0 steady-state cost; the input-preservation
+# copy is the only measurable term, see README "Fault tolerance").
+# The dead-rank threshold is deliberately much larger than any
+# per-collective timeout: declaring a slow rank dead is irreversible.
+DEFAULT_MAX_RETRIES = 2
+DEFAULT_RECONNECT_BACKOFF = 0.05
+DEFAULT_DEAD_RANK_SECS = 120.0
 # Telemetry defaults: a heartbeat is one ~300-byte control frame per
 # rank per period (off the data plane entirely), and a span is one
 # O(1) deque append — both default-on, both sized so the observability
@@ -96,6 +116,23 @@ def env_float(name: str, default: float, minimum: float = 0.0) -> float:
         val = float(raw)
     except ValueError:
         raise Mp4jError(f"{name}={raw!r} is not a number") from None
+    if val < minimum:
+        raise Mp4jError(f"{name}={val} must be >= {minimum}")
+    return val
+
+
+def env_int(name: str, default: int, minimum: int = 0) -> int:
+    """A plain integer-count knob (retry budgets, not byte sizes) —
+    same validation shape as :func:`env_bytes` with an honest
+    diagnostic."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise Mp4jError(
+            f"{name}={raw!r} is not an integer") from None
     if val < minimum:
         raise Mp4jError(f"{name}={val} must be >= {minimum}")
     return val
@@ -146,6 +183,46 @@ def map_columnar_enabled() -> bool:
         raise Mp4jError(
             f"MP4J_MAP_COLUMNAR={raw!r} must be 0 or 1")
     return val == "1"
+
+
+def max_retries() -> int:
+    """Epoch-fenced retry budget per failed collective
+    (``MP4J_MAX_RETRIES``); 0 restores the reference's fail-stop."""
+    return env_int("MP4J_MAX_RETRIES", DEFAULT_MAX_RETRIES, minimum=0)
+
+
+def reconnect_backoff() -> float:
+    """Base seconds of the capped exponential re-dial backoff
+    (``MP4J_RECONNECT_BACKOFF``)."""
+    return env_float("MP4J_RECONNECT_BACKOFF", DEFAULT_RECONNECT_BACKOFF,
+                     minimum=0.0)
+
+
+def dead_rank_secs(override=None) -> float:
+    """Seconds of silence (missing abort ack / stalled barrier) before
+    the master declares a rank dead and fans out a terminal abort
+    (``MP4J_DEAD_RANK_SECS``); must be positive — a zero threshold
+    would declare every rank dead at the first tick (master) and
+    expire every recovery deadline instantly (slave). ``override`` is
+    an explicit constructor arg taking the SAME validation as the env
+    path, so master- and slave-side acceptance can never diverge;
+    ``float('inf')`` is the documented disable idiom."""
+    if override is None:
+        return env_float("MP4J_DEAD_RANK_SECS", DEFAULT_DEAD_RANK_SECS,
+                         minimum=0.001)
+    val = float(override)
+    if not val > 0:
+        raise Mp4jError(
+            f"dead_rank_secs={override} must be > 0 "
+            f"(use float('inf') to disable the escalation)")
+    return val
+
+
+def fault_plan_spec() -> str:
+    """The raw ``MP4J_FAULT_PLAN`` grammar string ('' disables
+    injection); parsed and validated by
+    :func:`ytk_mp4j_tpu.resilience.faults.FaultPlan.parse`."""
+    return os.environ.get("MP4J_FAULT_PLAN", "").strip()
 
 
 def algo_thresholds() -> tuple[int, int]:
