@@ -697,6 +697,25 @@ def main():
     # csecs rate, now kept as socket_collective_in_workload_gbs.
     sock_coll_gbs, sock_coll_stats = bench_socket_collective(
         native_transport=True)
+    # metrics-plane overhead A/B (ISSUE 6 acceptance: <= 3% on the
+    # headline leg): the same isolated collective leg with
+    # MP4J_METRICS=0 — histogram observes become flag checks, the
+    # heartbeat ships empty metric deltas. The default-on figure is
+    # sock_coll_gbs itself (every socket figure in this file carries
+    # the full metrics tax); forked slaves inherit the env toggle.
+    prior_metrics = os.environ.get("MP4J_METRICS")
+    os.environ["MP4J_METRICS"] = "0"
+    try:
+        sock_coll_gbs_nometrics, _ = bench_socket_collective(
+            native_transport=True)
+    finally:
+        # restore, don't delete: a caller-exported MP4J_METRICS must
+        # keep governing every later leg (and the A/B note below is
+        # only honest when the ON leg really ran with metrics on)
+        if prior_metrics is None:
+            del os.environ["MP4J_METRICS"]
+        else:
+            os.environ["MP4J_METRICS"] = prior_metrics
     sock_framed_coll_gbs, sock_framed_coll_stats = bench_socket_collective(
         native_transport=False)
     sweep, sweep_stats = bench_socket_allreduce_sweep()
@@ -800,6 +819,26 @@ def main():
                 "heartbeat_secs": tuning.heartbeat_secs(),
                 "span_ring_capacity": tuning.span_ring_capacity(),
                 "default_on": True,
+            },
+            # metrics-plane overhead (ISSUE 6 acceptance: <= 3% on the
+            # headline socket_collective_gbs leg). Same leg, metrics
+            # on (the default — sock_coll_gbs itself) vs MP4J_METRICS=0
+            # (observes become one flag check; heartbeats ship empty
+            # metric deltas). Positive overhead_pct = metrics cost;
+            # run-to-run spread on this shared 1-core host is ~10%, so
+            # small negatives are noise, not a speedup.
+            "metrics_overhead": {
+                # False means the caller exported MP4J_METRICS=0 and
+                # the "on" leg really ran off — overhead_pct is then
+                # an off-vs-off null, not a measurement
+                "default_on": tuning.metrics_enabled(),
+                "socket_collective_gbs_metrics_on": round(
+                    sock_coll_gbs, 4),
+                "socket_collective_gbs_metrics_off": round(
+                    sock_coll_gbs_nometrics, 4),
+                "overhead_pct": round(
+                    (sock_coll_gbs_nometrics - sock_coll_gbs)
+                    / sock_coll_gbs_nometrics * 100, 2),
             },
             "device_map_int_allreduce_keys_per_sec": round(dev_map_keys, 0),
             "device_map_chained_keys_per_sec": round(

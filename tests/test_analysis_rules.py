@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 11)]
+    assert ids == [f"R{i}" for i in range(1, 12)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -700,4 +700,152 @@ def test_r9_inline_suppression_and_baseline():
         def gather_map(self, d, root):
             self._send(root, d)
     """, baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R11 — wall clock feeding duration/deadline arithmetic
+# ----------------------------------------------------------------------
+def test_r11_fires_on_direct_deadline_arithmetic():
+    r = run_rule("R11", """
+        import time
+
+        def rendezvous(self):
+            deadline = time.time() + self.timeout
+            while time.time() < deadline:
+                self.accept_one()
+    """)
+    assert [f.line for f in r.findings] == [5, 6]
+    assert "perf_counter" in r.findings[0].message
+
+
+def test_r11_fires_through_assigned_name():
+    # the spans-anchor pattern: module-level wall time entering
+    # arithmetic in a function elsewhere in the file
+    r = run_rule("R11", """
+        import time
+        _epoch_wall = time.time()
+
+        def export(t0, epoch):
+            return (t0 - epoch + _epoch_wall) * 1e6
+    """, path="ytk_mp4j_tpu/obs/snippet.py")
+    [f] = r.findings
+    assert f.line == 3 and f.context == "<module>"
+    # function-local flow: assigned then subtracted
+    r = run_rule("R11", """
+        from time import time
+
+        def measure(self):
+            t0 = time()
+            self.work()
+            return time() - t0
+    """)
+    assert len(r.findings) == 2        # the Sub's call + t0's assign
+
+
+def test_r11_quiet_on_storage_and_formatting():
+    # artifact timestamps, localtime formatting, and ms extraction via
+    # % are points in time, not measurements — the _log / postmortem
+    # shapes must stay quiet
+    r = run_rule("R11", """
+        import time
+
+        def _log(self, msg):
+            now = time.time()
+            ts = (time.strftime("%H:%M:%S", time.localtime(now))
+                  + f".{int(now % 1 * 1000):03d}")
+            print(ts, msg)
+
+        def bundle(self):
+            return {"wall_time": time.time()}
+    """)
+    assert not r.findings
+
+
+def test_r11_quiet_on_monotonic_and_out_of_scope():
+    r = run_rule("R11", """
+        import time
+
+        def wait(self):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                self.step()
+                self.booked += time.perf_counter() - t0
+    """)
+    assert not r.findings
+    # same wall-clock deadline outside comm/obs/transport is out of scope
+    r = run_rule("R11", """
+        import time
+
+        def wait(self):
+            deadline = time.time() + 5.0
+    """, path="ytk_mp4j_tpu/models/snippet.py")
+    assert not r.findings
+
+
+def test_r11_local_shadow_does_not_implicate_module_name():
+    # a module-level STORED timestamp (quiet shape) plus a function
+    # whose own local of the same name does perf_counter arithmetic:
+    # the local shadows, it must not implicate the module assign
+    r = run_rule("R11", """
+        import time
+        started = time.time()          # stored artifact timestamp
+
+        def measure(self):
+            started = time.perf_counter()
+            self.work()
+            return time.perf_counter() - started
+    """, path="ytk_mp4j_tpu/obs/snippet.py")
+    assert not r.findings
+    # parameters, for-targets and with-as bindings shadow too
+    r = run_rule("R11", """
+        import time
+        started = time.time()
+
+        def lag(started):
+            return time.monotonic() - started
+
+        def scan(items):
+            for started in items:
+                if started < 5:
+                    yield started + 1
+
+        def hold(self):
+            with self.pin() as started:
+                return started - 1
+
+        def bump(xs):
+            return map(lambda started: started + 1, xs)
+
+        BUMP2 = lambda started: started + 2   # module-level lambda
+    """, path="ytk_mp4j_tpu/obs/snippet.py")
+    assert not r.findings
+
+
+def test_r11_inline_suppression_and_baseline():
+    src = """
+        import time
+        # mp4j-lint: disable=R11 (trace anchor)
+        _epoch_wall = time.time()
+
+        def export(t0, epoch):
+            return t0 - epoch + _epoch_wall
+    """
+    r = run_rule("R11", src, path="ytk_mp4j_tpu/obs/snippet.py")
+    assert not r.findings and len(r.suppressed) == 1
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R11"
+        file = "ytk_mp4j_tpu/obs/snippet.py"
+        context = "<module>"
+        reason = "trace anchor"
+    """))
+    r = run_rule("R11", """
+        import time
+        _epoch_wall = time.time()
+
+        def export(t0, epoch):
+            return t0 - epoch + _epoch_wall
+    """, path="ytk_mp4j_tpu/obs/snippet.py", baseline=bl)
     assert not r.findings and len(r.suppressed) == 1

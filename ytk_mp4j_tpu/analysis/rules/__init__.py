@@ -12,6 +12,7 @@
 | R8 | error    | chunk schedule derived from rank-local state |
 | R9 | error    | pickled dict payload on a collective map path |
 | R10 | error   | peer-channel I/O bypassing the epoch fence |
+| R11 | error   | wall clock feeding duration/deadline arithmetic |
 """
 
 from __future__ import annotations
@@ -35,6 +36,8 @@ from ytk_mp4j_tpu.analysis.rules.r9_map_payload import (
     R9PickledMapPayload)
 from ytk_mp4j_tpu.analysis.rules.r10_epoch_fence import (
     R10EpochFenceBypass)
+from ytk_mp4j_tpu.analysis.rules.r11_wall_clock import (
+    R11WallClockDuration)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -47,6 +50,7 @@ ALL_RULES = [
     R8RankLocalChunkSchedule,
     R9PickledMapPayload,
     R10EpochFenceBypass,
+    R11WallClockDuration,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

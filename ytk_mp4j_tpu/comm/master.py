@@ -37,14 +37,19 @@ never block a data-plane exchange.
 from __future__ import annotations
 
 import argparse
+import http.server
+import json
 import socket
 import sys
 import threading
 import time
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.obs import metrics as metrics_mod
+from ytk_mp4j_tpu.obs import postmortem as postmortem_mod
 from ytk_mp4j_tpu.obs import telemetry as telemetry_mod
 from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.utils import stats as stats_mod
 from ytk_mp4j_tpu.utils import tuning
 
 # control-plane message kinds (slave -> master)
@@ -66,7 +71,9 @@ class Master:
                  log_stream=None, timeout: float | None = 120.0,
                  handshake_timeout: float | None = 5.0,
                  stall_timeout: float | None = 60.0,
-                 dead_rank_secs: float | None = None):
+                 dead_rank_secs: float | None = None,
+                 metrics_port: int | None = None,
+                 postmortem_dir: str | None = None):
         """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
         bounds each accepted connection's registration message, so one
         stray dial-in stalls rendezvous briefly instead of consuming the
@@ -85,7 +92,16 @@ class Master:
         terminal abort fan-out — every surviving rank raises the same
         clean error instead of relying on its local timeout. It is
         deliberately much larger than ``stall_timeout``: the diagnosis
-        is cheap and reversible, declaring a rank dead is neither."""
+        is cheap and reversible, declaring a rank dead is neither.
+
+        ``metrics_port`` (ISSUE 6; None reads ``MP4J_METRICS_PORT``,
+        which unset keeps the endpoint off) serves the live metrics
+        plane over plain HTTP on the CONTROL plane only: ``/metrics``
+        is Prometheus text format, ``/metrics.json`` the same document
+        as JSON. ``0`` binds an ephemeral port; the bound port is
+        ``self.metrics_port``. ``postmortem_dir`` (None reads
+        ``MP4J_POSTMORTEM_DIR``; empty disables) makes a terminal
+        abort also write the flight recorder's cluster manifest."""
         self.slave_num = slave_num
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
@@ -124,11 +140,55 @@ class Master:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.final_code: int | None = None
+        # -- live metrics plane (ISSUE 6) -------------------------------
+        self._postmortem_dir = (tuning.postmortem_dir()
+                                if postmortem_dir is None
+                                else str(postmortem_dir))
+        self._metrics_window = tuning.metrics_window_secs()
+        # per-rank + cluster rate rings, fed on every heartbeat fold;
+        # cluster totals are maintained incrementally (O(1 rank) per
+        # beat), not re-summed across the fleet under the lock
+        self._rank_windows: dict[int, metrics_mod.RateWindow] = {}
+        self._rank_totals: dict[int, dict[str, float]] = {}
+        self._cluster_totals: dict[str, float] = {}
+        # cluster histogram/counter aggregate, folded incrementally
+        # from each heartbeat's metrics_delta (never re-summed across
+        # the fleet at scrape time)
+        self._cluster_metrics: dict = {"counters": {}, "gauges": {},
+                                       "histograms": {}}
+        self._cluster_window = metrics_mod.RateWindow(
+            self._metrics_window)
+        self._metrics_server: http.server.ThreadingHTTPServer | None = None
+        self.metrics_port: int | None = None
+        want_port = tuning.metrics_port(override=metrics_port)
+        if want_port is not None:
+            try:
+                self._start_metrics_server(host, want_port)
+            except BaseException:
+                # don't leak the already-bound listeners (data plane,
+                # and the metrics socket if it bound before the fail)
+                # out of a failed constructor — a retry Master on the
+                # same explicit port would hit EADDRINUSE until GC
+                self._stop_metrics_server()
+                self._server.close()
+                raise
 
     # ------------------------------------------------------------------
     def serve(self) -> int:
         """Run rendezvous then the control loop; returns aggregate exit
         code (0 iff every slave closed with 0)."""
+        try:
+            return self._serve()
+        finally:
+            # every listener must die with serve() on EVERY path — a
+            # rendezvous timeout raising past a leaked HTTP server or
+            # a still-bound data-plane socket would hold the port
+            # against the retry Master
+            self._server.close()
+            self._write_postmortem_manifest()
+            self._stop_metrics_server()
+
+    def _serve(self) -> int:
         self._rendezvous()
         threads = []
         for rank, ch in enumerate(self._channels):
@@ -157,7 +217,10 @@ class Master:
             self._stop.set()
         if watchdog is not None:
             watchdog.join(2.0)
-        self._server.close()
+        # serve()'s finally closes the listener, refreshes the
+        # flight-recorder manifest with the FINAL table (the slaves'
+        # fatal-path telemetry flushes landed after the fan-out-time
+        # write) and stops the endpoint
         codes = [self._exit_codes.get(r, 1) for r in range(self.slave_num)]
         self.final_code = max(codes) if codes else 0
         return self.final_code
@@ -177,11 +240,12 @@ class Master:
         """Accept slave registrations; assign ranks in registration order
         (pinned free choice — the reference's exact rule is unverified);
         broadcast the roster to all."""
-        deadline = None if self.timeout is None else time.time() + self.timeout
+        deadline = (None if self.timeout is None
+                    else time.monotonic() + self.timeout)
         pending = []  # (channel, (host, listen_port))
         self._server.settimeout(1.0)
         while len(pending) < self.slave_num:
-            if deadline is not None and time.time() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 got = [hp for _, hp in pending]
                 raise Mp4jError(
                     f"rendezvous timeout: {len(pending)}/{self.slave_num} "
@@ -196,7 +260,7 @@ class Master:
             # never sends must neither hang rendezvous (no timeout) nor
             # consume the whole budget while real slaves queue behind it
             remaining = (None if deadline is None
-                         else max(0.1, deadline - time.time()))
+                         else max(0.1, deadline - time.monotonic()))
             bounds = [t for t in (remaining, self.handshake_timeout)
                       if t is not None]
             ch.set_timeout(min(bounds) if bounds else None)
@@ -401,6 +465,10 @@ class Master:
         self._log("M", "ERROR", f"terminal abort: {msg}")
         for line in self.diagnose():
             self._log("M", "WARN", line)
+        # flight recorder: write the manifest NOW (survivors may be
+        # about to exit); serve() refreshes it once the slaves' final
+        # fatal-path telemetry flushes have landed
+        self._write_postmortem_manifest()
         for r in sorted(self._live_ranks()):
             self._send_to(r, ("abort_fatal", msg))
 
@@ -422,17 +490,55 @@ class Master:
 
     # -- telemetry ------------------------------------------------------
     def _record_telemetry(self, rank: int, payload: dict) -> None:
+        """Fold one heartbeat into the rolling cluster time-series.
+
+        Since ISSUE 6 the beat carries DELTAS (``stats_delta`` /
+        ``metrics_delta``) folded onto the rank's cumulative view;
+        a full ``stats`` snapshot (older senders, external tools)
+        replaces it instead. Each fold also advances the rank's and
+        the cluster's rate rings, so windowed GB/s / collectives/s /
+        keys/s stay derivable without a second pass."""
         progress = payload.get("progress") or {}
+        now = time.monotonic()
         with self._lock:
+            prev = self._telemetry.get(rank)
+            if "stats_delta" in payload:
+                stats = stats_mod.merge_snapshots(
+                    prev["stats"] if prev else {},
+                    payload.get("stats_delta") or {})
+            else:
+                stats = (payload.get("stats")
+                         or (prev["stats"] if prev else {}))
+            delta = payload.get("metrics_delta") or {}
+            metrics = metrics_mod.fold_snapshot(
+                (prev or {}).get("metrics") or {}, delta)
+            self._cluster_metrics = metrics_mod.fold_snapshot(
+                self._cluster_metrics, delta)
             self._telemetry[rank] = {
                 "seq": int(progress.get("seq", 0)),
                 "current": progress.get("current"),
                 "last": progress.get("last"),
                 "phase": progress.get("phase"),
                 "current_secs": float(progress.get("current_secs", 0.0)),
-                "stats": payload.get("stats") or {},
-                "mono": time.monotonic(),
+                "stats": stats,
+                "metrics": metrics,
+                "mono": now,
             }
+            win = self._rank_windows.get(rank)
+            if win is None:
+                win = self._rank_windows[rank] = metrics_mod.RateWindow(
+                    self._metrics_window)
+            totals = self._stats_totals(stats)
+            win.note(now, totals)
+            # running cluster totals: add this rank's movement since
+            # its last fold — O(1 rank) per beat, not a re-sum of every
+            # rank's whole stats table under the master lock
+            before = self._rank_totals.get(rank, {})
+            for k, v in totals.items():
+                self._cluster_totals[k] = (self._cluster_totals.get(k, 0)
+                                           + v - before.get(k, 0))
+            self._rank_totals[rank] = totals
+            self._cluster_window.note(now, self._cluster_totals)
 
     def _handle_diagnose(self, rank: int, payload: dict) -> None:
         """A slave's bounded collective wait expired: refresh its table
@@ -459,17 +565,23 @@ class Master:
         for line in self.diagnose():
             self._log("M", "WARN", line)
 
+    def _snapshot_table(self) -> dict[int, dict]:
+        """One heartbeat-table snapshot (progress fields + age) —
+        the shared shape behind the diagnosis, the metrics document
+        and the postmortem manifest. Caller must NOT hold the lock."""
+        now = time.monotonic()
+        with self._lock:
+            return {r: {**{k: t[k] for k in
+                           ("seq", "current", "last", "phase",
+                            "current_secs")},
+                        "age": now - t["mono"]}
+                    for r, t in self._telemetry.items()}
+
     def diagnose(self) -> list[str]:
         """Render the hang/straggler diagnosis from the heartbeat
         table (obs.telemetry.render_diagnosis)."""
-        now = time.monotonic()
-        with self._lock:
-            table = {r: {**{k: t[k] for k in
-                            ("seq", "current", "last", "phase",
-                             "current_secs")},
-                         "age": now - t["mono"]}
-                     for r, t in self._telemetry.items()}
-        return telemetry_mod.render_diagnosis(table, self.slave_num)
+        return telemetry_mod.render_diagnosis(self._snapshot_table(),
+                                              self.slave_num)
 
     def cluster_stats(self) -> dict[str, dict]:
         """Cross-rank skew per collective family from the latest
@@ -483,6 +595,123 @@ class Master:
     def format_cluster_stats(self) -> str:
         """The ``mp4j-scope report`` table, live from the master."""
         return telemetry_mod.format_skew(self.cluster_stats())
+
+    # -- live metrics plane (ISSUE 6) -----------------------------------
+    def _start_metrics_server(self, host: str, port: int) -> None:
+        """Bind the control-plane HTTP metrics endpoint. Loopback by
+        default (host "" would mean every interface for the DATA
+        master socket too, but metrics add nothing a peer needs — an
+        operator scrapes where the master runs, or passes an explicit
+        host)."""
+        master = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):         # noqa: N802
+                if self.path in ("/metrics", "/metrics/"):
+                    body = metrics_mod.to_prometheus(
+                        master.metrics_doc()).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path in ("/metrics.json", "/json"):
+                    body = json.dumps(master.metrics_doc()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes are not log lines
+                pass
+
+        srv = http.server.ThreadingHTTPServer(
+            (host or "127.0.0.1", port), Handler)
+        srv.daemon_threads = True
+        self._metrics_server = srv
+        self.metrics_port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="mp4j-metrics-http").start()
+
+    def _stop_metrics_server(self) -> None:
+        srv, self._metrics_server = self._metrics_server, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+
+    @staticmethod
+    def _stats_totals(stats: dict) -> dict[str, float]:
+        """Cumulative totals the rate windows differentiate."""
+        return {
+            "bytes": sum(e.get("bytes_sent", 0) + e.get("bytes_recv", 0)
+                         for e in stats.values()),
+            "collectives": sum(e.get("calls", 0)
+                               for e in stats.values()),
+            "keys": sum(e.get("keys", 0) for e in stats.values()),
+        }
+
+    def metrics_doc(self) -> dict:
+        """The metrics document both endpoint formats serve: per-rank
+        progress/stats/rates plus the cluster aggregate (summed stats,
+        folded histograms, windowed rates). Plain JSON-ready dicts —
+        ``obs.metrics.to_prometheus`` renders the text form."""
+        now = time.monotonic()
+        with self._lock:
+            ranks: dict[str, dict] = {}
+            for r in sorted(self._telemetry):
+                t = self._telemetry[r]
+                win = self._rank_windows.get(r)
+                # snapshots/aggregates are handed out by REFERENCE:
+                # every fold/merge builds a NEW object (the previous
+                # one is never mutated), so readers outside the lock
+                # see a consistent frozen view — no per-scrape deep
+                # copy of the whole fleet's stats under the lock
+                ranks[str(r)] = {
+                    "progress": {k: t[k] for k in
+                                 ("seq", "current", "last", "phase",
+                                  "current_secs")},
+                    "age": now - t["mono"],
+                    "stats": t["stats"],
+                    "rates": win.rates() if win is not None else {},
+                    "histograms": (t.get("metrics") or {}).get(
+                        "histograms", {}),
+                }
+            cluster_rates = self._cluster_window.rates()
+            cluster_metrics = self._cluster_metrics
+        cluster_stats = stats_mod.merge_snapshots(
+            *(info["stats"] for info in ranks.values()))
+        return {
+            "slave_num": self.slave_num,
+            "window_secs": self._metrics_window,
+            "ranks": ranks,
+            "cluster": {
+                "stats": cluster_stats,
+                "rates": cluster_rates,
+                "histograms": cluster_metrics["histograms"],
+            },
+        }
+
+    def _write_postmortem_manifest(self) -> None:
+        """Flight-recorder manifest (once per write site, idempotent
+        overwrite): only on a terminal abort — a clean job leaves no
+        postmortem."""
+        with self._lock:
+            reason = self._fatal_msg
+            departed = dict(self._departed)
+        if not self._postmortem_dir or reason is None:
+            return
+        # ONE table snapshot feeds both fields, so the manifest's
+        # diagnosis and table describe the same instant
+        table = self._snapshot_table()
+        try:
+            postmortem_mod.write_master_manifest(
+                self._postmortem_dir, slave_num=self.slave_num,
+                reason=reason, table=table, departed=departed,
+                diagnosis=telemetry_mod.render_diagnosis(
+                    table, self.slave_num))
+        except OSError:
+            pass  # best-effort: the job is already terminal
 
     def _watchdog_loop(self):
         """Diagnose stalled barriers, then ACT on them (ISSUE 5).

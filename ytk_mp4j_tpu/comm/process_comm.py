@@ -45,6 +45,8 @@ from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm import keycodec
 from ytk_mp4j_tpu.comm import master as master_mod
 from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.obs import metrics as metrics_mod
+from ytk_mp4j_tpu.obs import postmortem
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.exceptions import (
     Mp4jError, Mp4jFatalError, Mp4jTransportError)
@@ -55,6 +57,7 @@ from ytk_mp4j_tpu.resilience.recovery import RecoveryManager
 from ytk_mp4j_tpu.transport import channel as channel_mod
 from ytk_mp4j_tpu.transport.channel import Channel, connect
 from ytk_mp4j_tpu.utils import native, trace, tuning
+from ytk_mp4j_tpu.utils import stats as stats_mod
 from ytk_mp4j_tpu.utils.stats import CommStats
 
 import functools
@@ -126,7 +129,8 @@ class ProcessCommSlave(CommSlave):
                  max_retries: int | None = None,
                  reconnect_backoff: float | None = None,
                  dead_rank_secs: float | None = None,
-                 fault_plan=None):
+                 fault_plan=None,
+                 postmortem_dir: str | None = None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -160,7 +164,13 @@ class ProcessCommSlave(CommSlave):
         (``MP4J_FAULT_PLAN``; a grammar string or a
         :class:`~ytk_mp4j_tpu.resilience.faults.FaultPlan`) arms
         deterministic fault injection on this rank's data plane —
-        chaos-test machinery, never on by default."""
+        chaos-test machinery, never on by default.
+
+        ``postmortem_dir`` (None reads ``MP4J_POSTMORTEM_DIR``; empty
+        disables) arms the flight recorder (ISSUE 6): on any terminal
+        abort this rank dumps a postmortem bundle (span-ring Chrome
+        trace, stats snapshot, metric histograms, epoch/retry log)
+        there before raising."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
         self._handshake_timeout = handshake_timeout
@@ -181,6 +191,10 @@ class ProcessCommSlave(CommSlave):
         elif isinstance(fault_plan, str):
             fault_plan = faults_mod.FaultPlan.parse(fault_plan)
         self._fault_plan = fault_plan
+        self._postmortem_dir = (tuning.postmortem_dir()
+                                if postmortem_dir is None
+                                else str(postmortem_dir))
+        self._pm_done = False
         # job-wide transport tuning (env-validated here, before any
         # connection exists, so a typo'd knob fails the job cleanly)
         # and pipeline state — all of it must exist BEFORE the accept
@@ -250,6 +264,15 @@ class ProcessCommSlave(CommSlave):
             inj = faults_mod.FaultInjector(self._fault_plan, self._rank)
             if not inj.empty:
                 self._faults = inj
+        # heartbeat delta state (ISSUE 6): the last stats/metrics
+        # snapshots shipped to the master, so every beat carries only
+        # what changed since. One lock serializes the heartbeat
+        # thread, the DIAGNOSE hook and close's final flush; it NEVER
+        # nests inside _master_lock (deadlock discipline: payload
+        # first, then send).
+        self._tel_lock = threading.Lock()
+        self._tel_last_stats: dict = {}
+        self._tel_last_metrics: dict = {}
         self._recovery = RecoveryManager(
             rank=self._rank, max_retries=self._max_retries,
             dead_rank_secs=self._dead_rank_secs,
@@ -257,7 +280,8 @@ class ProcessCommSlave(CommSlave):
                 (kind, payload)),
             teardown=self._teardown_peers, stats=self._comm_stats,
             wake=self._ctl_wake, drain=self._drain_dead_channels,
-            progress=lambda: self._progress_state)
+            progress=lambda: self._progress_state,
+            terminal_hook=self._on_terminal_abort)
         self._ctl_cv = threading.Condition()
         self._barrier_released: set[int] = set()
         self._closed_ack = threading.Event()
@@ -466,8 +490,22 @@ class ProcessCommSlave(CommSlave):
 
     # -- telemetry (control plane only) --------------------------------
     def _telemetry_payload(self) -> dict:
+        """The heartbeat message: progress plus stats/metric DELTAS
+        since the last payload (ISSUE 6 satellite — a long job's beat
+        is bounded by recent activity, not by every collective family
+        ever seen). Deltas are additive, so the master may fold them
+        in any arrival order; the last-shipped state advances under
+        ``_tel_lock`` so concurrent senders never drop or double-ship
+        an interval."""
+        with self._tel_lock:
+            stats = self._comm_stats.snapshot()
+            mets = self._comm_stats.metrics.snapshot()
+            sd = stats_mod.diff_snapshots(stats, self._tel_last_stats)
+            md = metrics_mod.diff_snapshot(mets, self._tel_last_metrics)
+            self._tel_last_stats = stats
+            self._tel_last_metrics = mets
         return {"progress": self._comm_stats.progress(),
-                "stats": self._comm_stats.snapshot()}
+                "stats_delta": sd, "metrics_delta": md}
 
     def _heartbeat_loop(self) -> None:
         while True:
@@ -487,24 +525,55 @@ class ProcessCommSlave(CommSlave):
         try:
             self._master_send((master_mod.DIAGNOSE, {
                 "collective": name, "error": repr(exc)[:300],
-                "progress": self._comm_stats.progress(),
-                "stats": self._comm_stats.snapshot()}))
+                **self._telemetry_payload()}))
         except (Mp4jError, OSError):
             pass  # diagnosis is best-effort; the original exc surfaces
+
+    def _on_terminal_abort(self, msg: str) -> None:
+        """Recovery's terminal hook (runs once, before the fatal flag
+        wakes any waiter): flush the final telemetry delta — so the
+        master's last heartbeat table is fresh in postmortems, not
+        only after a clean close — then dump this rank's flight-
+        recorder bundle."""
+        try:
+            self._master_send(
+                (master_mod.TELEMETRY, self._telemetry_payload()))
+        except (Mp4jError, OSError):
+            pass  # master may be the thing that died
+        self._dump_postmortem(msg)
+
+    def _dump_postmortem(self, reason: str) -> None:
+        """Write this rank's postmortem bundle (once, best-effort)."""
+        if not self._postmortem_dir or self._pm_done:
+            return
+        self._pm_done = True
+        try:
+            postmortem.write_bundle(
+                self._postmortem_dir, self._rank, reason=reason,
+                progress=self._comm_stats.progress(),
+                stats=self._comm_stats.snapshot(),
+                metrics=self._comm_stats.metrics.snapshot(),
+                epoch=self._recovery.epoch,
+                events=self._recovery.events())
+        except OSError:
+            pass  # the recorder must never worsen a dying job
 
     def close(self, code: int = 0) -> None:
         if self._closed:
             return
         self._hb_stop.set()
         sent = False
+        # final telemetry delta computed OUTSIDE _master_lock (the
+        # heartbeat thread takes _tel_lock then _master_lock; nesting
+        # them here in the other order would be a lock-order inversion)
+        flush = self._telemetry_payload()
         with self._master_lock:
             if self._closed:
                 return
             # final telemetry flush so the master's skew table covers
             # the whole run, then the close handshake
             try:
-                self._master.send_obj(
-                    (master_mod.TELEMETRY, self._telemetry_payload()))
+                self._master.send_obj((master_mod.TELEMETRY, flush))
             except (Mp4jError, OSError):
                 pass  # master may already be gone; close proceeds
             self._closed = True

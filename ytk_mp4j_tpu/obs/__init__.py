@@ -1,6 +1,6 @@
-"""mp4j-scope — cluster-wide observability (ISSUE 3).
+"""mp4j-scope — cluster-wide observability (ISSUE 3 + ISSUE 6).
 
-Three layers on top of the PR-2 measurement substrate:
+Layers on top of the PR-2 measurement substrate:
 
 - :mod:`ytk_mp4j_tpu.obs.spans` — a bounded in-process span ring fed by
   the always-on :class:`~ytk_mp4j_tpu.utils.stats.CommStats` phase
@@ -12,7 +12,19 @@ Three layers on top of the PR-2 measurement substrate:
   (``render_diagnosis``). The master (``comm/master.py``) is the stateful
   consumer; this module deliberately imports nothing from ``comm`` so
   the CLI and the master share one implementation without a cycle.
+- :mod:`ytk_mp4j_tpu.obs.metrics` — the live metrics plane (ISSUE 6):
+  counters/gauges/log2-bucket histograms, heartbeat delta shipping,
+  sliding rate windows, and the Prometheus renderer behind the
+  master's ``MP4J_METRICS_PORT`` endpoint.
+- :mod:`ytk_mp4j_tpu.obs.postmortem` — the flight recorder (ISSUE 6):
+  per-rank crash bundles on any terminal abort
+  (``MP4J_POSTMORTEM_DIR``), the master manifest, and the merged
+  report behind ``mp4j-scope postmortem``.
+- :mod:`ytk_mp4j_tpu.obs.benchdiff` — the perf regression gate behind
+  ``mp4j-scope bench-diff`` (ISSUE 6): per-metric budgets over
+  ``bench.py`` JSON outputs.
 - :mod:`ytk_mp4j_tpu.obs.cli` — the ``mp4j-scope`` CLI: merge per-rank
   Chrome-trace files into one timeline; render the cross-rank skew
-  table from per-rank ``comm.stats()`` JSON dumps.
+  table from per-rank ``comm.stats()`` JSON dumps; ``live`` /
+  ``postmortem`` / ``bench-diff``.
 """

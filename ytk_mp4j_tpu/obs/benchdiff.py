@@ -1,0 +1,124 @@
+"""``mp4j-scope bench-diff`` — perf regression gating over BENCH files.
+
+Compares the headline figures of two ``bench.py`` JSON outputs (round
+A vs round B) against per-metric regression thresholds and reports a
+verdict per metric — the seed of perf regression gating for every
+future PR: drop two BENCH files in, get a nonzero exit when a tracked
+figure regressed past its budget.
+
+Accepted input shapes (both appear in the repo):
+
+- the raw one-line bench output: ``{"metric", "value", "extra": {...}}``;
+- the driver wrapper: ``{"n", "cmd", "rc", "tail", "parsed": {...}}``
+  (``parsed`` holds the raw form).
+
+Thresholds are PER METRIC because the noise floor is: pure-device
+figures repeat within a few percent, while the loopback socket legs on
+a shared 1-core bench host swing 10-20% run to run. Every tracked
+metric is higher-is-better; a metric missing from either file is
+skipped (rounds grow new figures), never an error.
+"""
+
+from __future__ import annotations
+
+import json
+
+# metric -> max tolerated fractional drop (new >= old * (1 - thr)).
+# Grounded in BENCH_r01..r05 run-to-run spread; tighten as the bench
+# host stabilizes. "value" is the headline GB/s/chip figure.
+THRESHOLDS: dict[str, float] = {
+    "value": 0.10,
+    "trees_per_sec": 0.10,
+    "socket_baseline_gbs": 0.25,
+    "socket_collective_gbs": 0.20,
+    "socket_native_collective_gbs": 0.20,
+    "socket_framed_collective_gbs": 0.20,
+    "socket_collective_in_workload_gbs": 0.25,
+    "ffm_sparse_steps_per_sec": 0.10,
+    "ffm_stream_rows_per_sec": 0.20,
+    "ffm_stream_rows_per_sec_serialized": 0.20,
+    "ffm_stream_text_rows_per_sec": 0.20,
+    "libsvm_reader_rows_per_sec": 0.20,
+    "socket_map_allreduce_keys_per_sec": 0.20,
+    "socket_map_int_allreduce_keys_per_sec": 0.20,
+    "socket_map_pickle_keys_per_sec": 0.25,
+    "socket_map_int_pickle_keys_per_sec": 0.25,
+    "device_map_int_allreduce_keys_per_sec": 0.20,
+    "device_map_chained_keys_per_sec": 0.20,
+    "gbdt_hist_mxu_tflops_per_sec_per_chip": 0.10,
+}
+
+
+def load_bench(path: str) -> dict[str, float]:
+    """Flat ``{metric: value}`` from a BENCH file (either shape);
+    raises ``ValueError`` on anything that is not a bench document."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict) or "value" not in doc:
+        raise ValueError(f"{path}: not a bench.py output "
+                         "(no 'value' headline)")
+    out: dict[str, float] = {}
+    if isinstance(doc.get("value"), (int, float)):
+        out["value"] = float(doc["value"])
+    for k, v in (doc.get("extra") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def compare(old: dict[str, float], new: dict[str, float],
+            threshold: float | None = None) -> list[dict]:
+    """Row per tracked metric present in BOTH files: ``{metric, old,
+    new, ratio, threshold, verdict}`` with verdict ``"REGRESSED"`` /
+    ``"ok"`` / ``"improved"`` (improved = past the same margin in the
+    good direction). ``threshold`` overrides every per-metric value."""
+    rows = []
+    for metric, thr in THRESHOLDS.items():
+        if metric not in old or metric not in new:
+            continue
+        if threshold is not None:
+            thr = threshold
+        a, b = old[metric], new[metric]
+        ratio = b / a if a else float("inf")
+        if b < a * (1.0 - thr):
+            verdict = "REGRESSED"
+        elif b > a * (1.0 + thr):
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        rows.append({"metric": metric, "old": a, "new": b,
+                     "ratio": ratio, "threshold": thr,
+                     "verdict": verdict})
+    return rows
+
+
+def format_table(rows: list[dict]) -> str:
+    if not rows:
+        return "(no tracked metrics common to both files)"
+    w = max(len(r["metric"]) for r in rows)
+    lines = [f"{'metric':<{w}}  {'old':>12}  {'new':>12}  "
+             f"{'ratio':>6}  {'budget':>6}  verdict"]
+    for r in rows:
+        lines.append(
+            f"{r['metric']:<{w}}  {r['old']:>12.4f}  {r['new']:>12.4f}  "
+            f"{r['ratio']:>6.2f}  -{r['threshold'] * 100:>4.0f}%  "
+            f"{r['verdict']}")
+    regressed = [r["metric"] for r in rows
+                 if r["verdict"] == "REGRESSED"]
+    if regressed:
+        lines.append(f"REGRESSION: {', '.join(regressed)} dropped past "
+                     "budget")
+    else:
+        lines.append(f"ok: {len(rows)} tracked metric(s) within budget")
+    return "\n".join(lines)
+
+
+def run(old_path: str, new_path: str,
+        threshold: float | None = None) -> tuple[str, bool]:
+    """(report text, regressed?) — the CLI's whole job."""
+    rows = compare(load_bench(old_path), load_bench(new_path),
+                   threshold)
+    return (format_table(rows),
+            any(r["verdict"] == "REGRESSED" for r in rows))

@@ -4,6 +4,9 @@ Usage::
 
     mp4j-scope merge -o merged.json rank0.json rank1.json ...
     mp4j-scope report [--json] stats0.json stats1.json ...
+    mp4j-scope live http://master-host:PORT [--interval 1.0] [--once]
+    mp4j-scope postmortem /path/to/MP4J_POSTMORTEM_DIR
+    mp4j-scope bench-diff BENCH_rA.json BENCH_rB.json [--threshold PCT]
     python -m ytk_mp4j_tpu.obs report ...
 
 ``merge`` combines per-rank Chrome-trace exports
@@ -17,7 +20,21 @@ min/median/max busy time, bytes, straggler ranks) from per-rank
 snapshot (``{collective: {...}}``, rank taken from the argument order)
 or an explicit ``{"rank": N, "stats": {...}}`` wrapper.
 
-Exit codes: 0 ok, 2 bad invocation / unreadable input.
+``live`` polls the master's metrics endpoint (``MP4J_METRICS_PORT``)
+and renders the per-rank throughput / current collective / sequence
+lag / retry table with straggler highlighting; ``--once`` prints a
+single frame (scripts, tests).
+
+``postmortem`` merges a flight-recorder directory (per-rank bundles +
+the master manifest, ``MP4J_POSTMORTEM_DIR``) into one report naming
+the dead and lagging ranks.
+
+``bench-diff`` compares two ``bench.py`` JSON outputs against
+per-metric regression budgets (``obs.benchdiff``); exit 1 on a
+regression — the perf gate.
+
+Exit codes: 0 ok, 1 bench-diff regression, 2 bad invocation /
+unreadable input.
 """
 
 from __future__ import annotations
@@ -25,15 +42,19 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
+import urllib.error
+import urllib.request
 
-from ytk_mp4j_tpu.obs import spans, telemetry
+from ytk_mp4j_tpu.obs import benchdiff, postmortem, spans, telemetry
 
 
 def _build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="mp4j-scope",
-        description="cluster-wide mp4j telemetry: timeline merge + "
-                    "cross-rank skew report")
+        description="cluster-wide mp4j telemetry: timeline merge, "
+                    "cross-rank skew report, live metrics view, "
+                    "postmortem merge, bench regression gate")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     mg = sub.add_parser("merge", help="merge per-rank Chrome-trace "
@@ -47,6 +68,30 @@ def _build_parser() -> argparse.ArgumentParser:
     rp.add_argument("--json", action="store_true",
                     help="emit the skew as JSON instead of a table")
     rp.add_argument("stats", nargs="+", help="per-rank stats JSON files")
+
+    lv = sub.add_parser("live", help="poll a running master's metrics "
+                                     "endpoint (MP4J_METRICS_PORT)")
+    lv.add_argument("url", help="endpoint base, e.g. "
+                                "http://127.0.0.1:9090 (scheme optional)")
+    lv.add_argument("--interval", type=float, default=1.0,
+                    help="poll period in seconds (default 1.0)")
+    lv.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clears)")
+
+    pm = sub.add_parser("postmortem",
+                        help="merge a flight-recorder directory into "
+                             "one report naming the dead/lagging rank")
+    pm.add_argument("dir", help="the job's MP4J_POSTMORTEM_DIR")
+
+    bd = sub.add_parser("bench-diff",
+                        help="compare two bench.py JSON outputs "
+                             "against per-metric regression budgets")
+    bd.add_argument("old", help="baseline BENCH file")
+    bd.add_argument("new", help="candidate BENCH file")
+    bd.add_argument("--threshold", type=float, default=None,
+                    metavar="PCT",
+                    help="override every per-metric budget with this "
+                         "max tolerated drop, in percent (e.g. 10)")
     return ap
 
 
@@ -64,6 +109,28 @@ def _load_rank_stats(paths: list[str]) -> dict[int, dict]:
     return per_rank
 
 
+def _fetch_doc(base: str) -> dict:
+    if "://" not in base:
+        base = "http://" + base
+    with urllib.request.urlopen(base.rstrip("/") + "/metrics.json",
+                                timeout=5.0) as resp:
+        return json.load(resp)
+
+
+def _live(args) -> int:
+    while True:
+        frame = telemetry.format_live(_fetch_doc(args.url))
+        if args.once:
+            print(frame)
+            return 0
+        # ANSI clear + home: a poor man's top(1); the frame is small
+        print("\x1b[2J\x1b[H" + frame, flush=True)
+        try:
+            time.sleep(max(args.interval, 0.1))
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     try:
@@ -72,13 +139,25 @@ def main(argv=None) -> int:
             print(f"mp4j-scope: merged {n} events from "
                   f"{len(args.traces)} file(s) into {args.out}")
             return 0
+        if args.cmd == "live":
+            return _live(args)
+        if args.cmd == "postmortem":
+            print(postmortem.merge_report(args.dir))
+            return 0
+        if args.cmd == "bench-diff":
+            thr = (None if args.threshold is None
+                   else args.threshold / 100.0)
+            text, regressed = benchdiff.run(args.old, args.new, thr)
+            print(text)
+            return 1 if regressed else 0
         skew = telemetry.cluster_skew(_load_rank_stats(args.stats))
         if args.json:
             print(json.dumps(skew, sort_keys=True))
         else:
             print(telemetry.format_skew(skew))
         return 0
-    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            urllib.error.URLError) as e:
         print(f"mp4j-scope: {e}", file=sys.stderr)
         return 2
 

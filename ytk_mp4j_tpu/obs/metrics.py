@@ -1,0 +1,381 @@
+"""Live metrics plane: counters, gauges, log-scale histograms, rates.
+
+This module is the measurement substrate of ISSUE 6's monitoring layer,
+sitting one level above :mod:`ytk_mp4j_tpu.utils.stats` (which keeps
+per-collective lifetime totals): it adds the quantities totals cannot
+answer —
+
+- **histograms** with fixed log2-scale buckets: per-collective-family
+  latency (``latency/<family>``, seconds) and wire frame sizes
+  (``frame_bytes``), cheap enough to stay default-on (one lock + two
+  integer bumps per observation; ``MP4J_METRICS=0`` turns every
+  observe into a no-op);
+- **delta shipping**: :func:`diff_snapshot` / :func:`fold_snapshot`
+  turn cumulative registry snapshots into bounded heartbeat payloads —
+  a slave ships only what changed since its last beat, the master
+  folds deltas back into a rolling cumulative view (counters and
+  bucket counts are additive, so out-of-order folds are harmless);
+- **rate windows**: :class:`RateWindow` keeps a bounded ring of
+  ``(time, cumulative totals)`` interval snapshots so rates (GB/s,
+  collectives/s, keys/s) are derivable over a sliding
+  ``MP4J_METRICS_WINDOW_SECS`` window instead of diluted lifetime
+  averages;
+- **rendering**: :func:`to_prometheus` serializes the master's metrics
+  document (see ``Master.metrics_doc``) as Prometheus text-format 0.0.4
+  — the same document serves as the JSON schema.
+
+Histogram bucket layout: ``n`` log2 buckets above ``lo`` plus one
+overflow bucket. Bucket ``0`` holds values ``<= lo``; bucket ``i``
+holds ``(lo * 2**(i-1), lo * 2**i]``; bucket ``n`` holds everything
+above ``lo * 2**(n-1)`` (rendered as ``le="+Inf"``). Quantile
+estimates return the UPPER edge of the bucket containing the
+nearest-rank order statistic, so an estimate is exact to one bucket
+(a factor of 2) by construction — the property the tier-1 tests pin
+against ``numpy.percentile``.
+
+Everything here is deliberately import-light (stdlib only): ``utils.
+stats`` feeds it from the comm hot path, and the ``mp4j-scope`` CLI
+consumes it offline.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+
+from ytk_mp4j_tpu.utils import tuning
+
+# Canonical bucket layouts (job-wide constants, like the stats schema:
+# the master folds per-rank histograms bucket-wise, which is only
+# meaningful when every rank uses the identical layout).
+LATENCY_LO = 1e-6          # 1 us .. ~34 s in 36 log2 buckets
+LATENCY_BUCKETS = 36
+FRAME_LO = 64.0            # 64 B .. ~4.3 GB in 27 log2 buckets
+FRAME_BUCKETS = 27
+
+
+def bucket_edges(lo: float, n: int) -> list[float]:
+    """The ``n`` finite upper edges ``[lo, 2*lo, ..., lo * 2**(n-1)]``
+    (the overflow bucket's edge is +Inf)."""
+    return [lo * 2.0 ** i for i in range(n)]
+
+
+def bucket_index(value: float, lo: float, n: int) -> int:
+    """Index of the bucket holding ``value`` (0..n, where n is the
+    overflow bucket). Exact at the edges by construction: the log2
+    guess is fixed up so ``value <= lo * 2**idx`` and
+    ``value > lo * 2**(idx-1)`` always hold."""
+    if value <= lo:
+        return 0
+    idx = int(math.ceil(math.log2(value / lo)))
+    while idx < n and value > lo * 2.0 ** idx:
+        idx += 1
+    while idx > 1 and value <= lo * 2.0 ** (idx - 1):
+        idx -= 1
+    return min(max(idx, 0), n)
+
+
+def _new_hist(lo: float, n: int) -> dict:
+    return {"lo": lo, "n": n, "counts": [0] * (n + 1),
+            "count": 0, "sum": 0.0}
+
+
+def hist_quantile(h: dict, q: float) -> float:
+    """Nearest-rank quantile estimate: the UPPER edge of the bucket
+    containing the ``ceil(q * count)``-th smallest observation (so the
+    true order statistic is within one bucket below the estimate).
+    Empty histogram -> 0.0; overflow bucket -> +Inf (the histogram
+    only knows the value exceeded its largest edge)."""
+    count = h["count"]
+    if count <= 0:
+        return 0.0
+    target = max(1, math.ceil(min(max(q, 0.0), 1.0) * count))
+    cum = 0
+    for i, c in enumerate(h["counts"]):
+        cum += c
+        if cum >= target:
+            if i >= h["n"]:
+                return math.inf
+            return h["lo"] * 2.0 ** i if i else h["lo"]
+    return math.inf
+
+
+class MetricsRegistry:
+    """Cheap thread-safe registry of counters, gauges and fixed
+    log2-bucket histograms. All names are flat strings; histogram
+    families encode their one label in the name (``latency/<family>``)
+    — the renderer splits it back out. Disabled (``MP4J_METRICS=0``)
+    every mutator is a single flag check."""
+
+    def __init__(self, enabled: bool | None = None):
+        self._enabled = (tuning.metrics_enabled() if enabled is None
+                         else bool(enabled))
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, dict] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def inc(self, name: str, value: float = 1) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float, lo: float, n: int) -> None:
+        if not self._enabled:
+            return
+        idx = bucket_index(value, lo, n)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _new_hist(lo, n)
+            h["counts"][idx] += 1
+            h["count"] += 1
+            h["sum"] += value
+
+    def snapshot(self) -> dict:
+        """Deep copy: ``{"counters": {...}, "gauges": {...},
+        "histograms": {name: {lo, n, counts, count, sum}}}``."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: {**h, "counts": list(h["counts"])}
+                               for k, h in self._hists.items()},
+            }
+
+
+def _empty_snapshot() -> dict:
+    return {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def diff_snapshot(cur: dict, prev: dict) -> dict:
+    """``cur - prev`` over registry snapshots, pruned: unchanged
+    counters/histograms are dropped so a heartbeat's payload is
+    bounded by what actually happened since the last beat, not by
+    every metric ever seen (satellite of ISSUE 6). Gauges are
+    last-value semantics and always ship whole."""
+    out = _empty_snapshot()
+    pc = prev.get("counters", {})
+    for k, v in cur.get("counters", {}).items():
+        d = v - pc.get(k, 0)
+        if d:
+            out["counters"][k] = d
+    out["gauges"] = dict(cur.get("gauges", {}))
+    ph = prev.get("histograms", {})
+    for k, h in cur.get("histograms", {}).items():
+        p = ph.get(k)
+        if p is None:
+            if h["count"]:
+                out["histograms"][k] = {**h, "counts": list(h["counts"])}
+            continue
+        if h["count"] == p["count"]:
+            continue
+        out["histograms"][k] = {
+            "lo": h["lo"], "n": h["n"],
+            "counts": [a - b for a, b in zip(h["counts"], p["counts"])],
+            "count": h["count"] - p["count"],
+            "sum": h["sum"] - p["sum"],
+        }
+    return out
+
+
+def fold_snapshot(agg: dict, delta: dict) -> dict:
+    """Fold a delta (or a whole snapshot) into a cumulative aggregate;
+    returns a NEW snapshot (inputs untouched). Counters and bucket
+    counts add; gauges take the delta's value."""
+    out = {
+        "counters": dict(agg.get("counters", {})),
+        "gauges": dict(agg.get("gauges", {})),
+        "histograms": {k: {**h, "counts": list(h["counts"])}
+                       for k, h in agg.get("histograms", {}).items()},
+    }
+    for k, v in delta.get("counters", {}).items():
+        out["counters"][k] = out["counters"].get(k, 0) + v
+    out["gauges"].update(delta.get("gauges", {}))
+    for k, h in delta.get("histograms", {}).items():
+        a = out["histograms"].get(k)
+        if a is None or a["lo"] != h["lo"] or a["n"] != h["n"]:
+            # unseen family (or a layout change across versions):
+            # the delta becomes the aggregate
+            out["histograms"][k] = {**h, "counts": list(h["counts"])}
+            continue
+        a["counts"] = [x + y for x, y in zip(a["counts"], h["counts"])]
+        a["count"] += h["count"]
+        a["sum"] += h["sum"]
+    return out
+
+
+class RateWindow:
+    """Bounded ring of ``(t, cumulative totals)`` interval snapshots;
+    rates are ``(newest - oldest) / dt`` over the points still inside
+    the window — a sliding-window derivative, immune to the lifetime
+    dilution a totals/uptime quotient suffers. Not thread-safe: the
+    owner (the master, under its lock) serializes access."""
+
+    def __init__(self, window_secs: float, maxlen: int = 512):
+        self.window = float(window_secs)
+        # minimum spacing between RETAINED points: notes arriving
+        # faster than window/(maxlen/2) replace the newest point
+        # instead of appending, so the deque always spans the full
+        # window no matter the note rate — the master feeds the
+        # cluster window once per heartbeat PER RANK, which at fleet
+        # size would otherwise shrink the effective window to
+        # maxlen/(2N) beats with no warning
+        self._min_dt = self.window / (maxlen / 2)
+        self._points: collections.deque = collections.deque(maxlen=maxlen)
+
+    def note(self, t: float, totals: dict[str, float]) -> None:
+        pts = self._points
+        if len(pts) >= 2 and t - pts[-2][0] < self._min_dt:
+            pts[-1] = (t, dict(totals))     # coalesce: keep freshest
+        else:
+            pts.append((t, dict(totals)))
+        cutoff = t - self.window
+        while len(pts) > 2 and pts[0][0] < cutoff:
+            pts.popleft()
+
+    def rates(self) -> dict[str, float]:
+        """``{key}_per_sec`` for every key in the newest totals; 0.0
+        until the window holds two points."""
+        if len(self._points) < 2:
+            keys = self._points[-1][1] if self._points else {}
+            return {f"{k}_per_sec": 0.0 for k in keys}
+        t0, first = self._points[0]
+        t1, last = self._points[-1]
+        dt = t1 - t0
+        if dt <= 0:
+            return {f"{k}_per_sec": 0.0 for k in last}
+        return {f"{k}_per_sec": (last.get(k, 0) - first.get(k, 0)) / dt
+                for k in last}
+
+
+# ----------------------------------------------------------------------
+# Prometheus text-format rendering (the /metrics endpoint)
+# ----------------------------------------------------------------------
+_STATS_COUNTER_KEYS = ("calls", "bytes_sent", "bytes_recv", "chunks",
+                       "keys", "retries", "reconnects", "aborts_seen")
+_STATS_PHASE_KEYS = ("wire_seconds", "reduce_seconds",
+                     "serialize_seconds")
+
+
+def _esc(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _hist_lines(out: list[str], metric: str, labels: str, h: dict) -> None:
+    cum = 0
+    edges = bucket_edges(h["lo"], h["n"])
+    sep = "," if labels else ""
+    for i, c in enumerate(h["counts"]):
+        cum += c
+        le = _fmt(edges[i]) if i < h["n"] else "+Inf"
+        out.append(f'{metric}_bucket{{{labels}{sep}le="{le}"}} {cum}')
+    out.append(f"{metric}_sum{{{labels}}} {_fmt(float(h['sum']))}"
+               if labels else f"{metric}_sum {_fmt(float(h['sum']))}")
+    out.append(f"{metric}_count{{{labels}}} {h['count']}"
+               if labels else f"{metric}_count {h['count']}")
+
+
+def to_prometheus(doc: dict) -> str:
+    """Render a master metrics document (``Master.metrics_doc``) as
+    Prometheus text format 0.0.4: per-rank and cluster-aggregate
+    counter series, cluster-folded latency/frame histograms, and the
+    windowed rate gauges. Every metric family is emitted as ONE
+    contiguous block (the format requires it — strict parsers like
+    promtool reject a family that reappears after another metric), so
+    samples are collected per family first and ranks vary inside the
+    block."""
+    whos = [*sorted(doc.get("ranks", {}), key=int)]
+    stats_of = {r: doc["ranks"][r].get("stats", {}) for r in whos}
+    stats_of["cluster"] = doc.get("cluster", {}).get("stats", {})
+
+    out: list[str] = []
+    out.append("# TYPE mp4j_ranks_reporting gauge")
+    out.append(f"mp4j_ranks_reporting {len(whos)}")
+    out.append("# TYPE mp4j_slave_num gauge")
+    out.append(f"mp4j_slave_num {doc.get('slave_num', 0)}")
+
+    for key in _STATS_COUNTER_KEYS:
+        block = []
+        for who in [*whos, "cluster"]:
+            for family in sorted(stats_of[who]):
+                v = stats_of[who][family].get(key, 0)
+                if v:
+                    block.append(
+                        f'mp4j_{key}_total{{rank="{_esc(who)}",'
+                        f'collective="{_esc(family)}"}} '
+                        f"{_fmt(float(v))}")
+        if block:
+            out.append(f"# TYPE mp4j_{key}_total counter")
+            out.extend(block)
+    phase_block = []
+    for who in [*whos, "cluster"]:
+        for family in sorted(stats_of[who]):
+            for key in _STATS_PHASE_KEYS:
+                v = stats_of[who][family].get(key, 0.0)
+                if v:
+                    phase_block.append(
+                        f'mp4j_phase_seconds_total{{rank="{_esc(who)}",'
+                        f'collective="{_esc(family)}",'
+                        f'phase="{key[:-len("_seconds")]}"}} '
+                        f"{_fmt(float(v))}")
+    if phase_block:
+        out.append("# TYPE mp4j_phase_seconds_total counter")
+        out.extend(phase_block)
+
+    out.append("# TYPE mp4j_rank_seq gauge")
+    for r in whos:
+        prog = doc["ranks"][r].get("progress", {})
+        out.append(f'mp4j_rank_seq{{rank="{_esc(r)}"}} '
+                   f"{prog.get('seq', 0)}")
+    out.append("# TYPE mp4j_heartbeat_age_seconds gauge")
+    for r in whos:
+        out.append(f'mp4j_heartbeat_age_seconds{{rank="{_esc(r)}"}} '
+                   f"{_fmt(float(doc['ranks'][r].get('age', 0.0)))}")
+    # per-rank rate gauges, one family (= one rate key) per block
+    rate_keys = sorted({k for r in whos
+                        for k in doc["ranks"][r].get("rates", {})})
+    for k in rate_keys:
+        out.append(f"# TYPE mp4j_rank_{k} gauge")
+        for r in whos:
+            rates = doc["ranks"][r].get("rates", {})
+            if k in rates:
+                out.append(f'mp4j_rank_{k}{{rank="{_esc(r)}"}} '
+                           f"{_fmt(float(rates[k]))}")
+
+    for k, v in sorted(doc.get("cluster", {}).get("rates", {}).items()):
+        out.append(f"# TYPE mp4j_cluster_{k} gauge")
+        out.append(f"mp4j_cluster_{k} {_fmt(float(v))}")
+
+    out.append("# TYPE mp4j_collective_latency_seconds histogram")
+    hists = doc.get("cluster", {}).get("histograms", {})
+    for name in sorted(hists):
+        h = hists[name]
+        if name.startswith("latency/"):
+            _hist_lines(out, "mp4j_collective_latency_seconds",
+                        f'collective="{_esc(name[len("latency/"):])}"', h)
+    out.append("# TYPE mp4j_frame_bytes histogram")
+    if "frame_bytes" in hists:
+        _hist_lines(out, "mp4j_frame_bytes", "", hists["frame_bytes"])
+    return "\n".join(out) + "\n"

@@ -1,0 +1,197 @@
+"""Postmortem flight recorder: per-rank crash bundles + merged report.
+
+A ``Mp4jFatalError`` used to leave nothing on disk: the job's spans,
+stats and recovery history died with the processes, and debugging a
+production incident meant reproducing it. With ``MP4J_POSTMORTEM_DIR``
+set, every rank that reaches a terminal abort dumps a **bundle** before
+it raises (hooked into the recovery engine's fatal fan-out, so the
+survivors of a dead rank all dump), and the master writes a cluster
+**manifest**; ``mp4j-scope postmortem <dir>`` merges them into one
+report that names the dead and lagging ranks.
+
+Bundle layout (``<dir>/rank_NNNN/``)::
+
+    trace.json      span ring as Chrome-trace JSON (load in Perfetto)
+    stats.json      {"rank", "reason", "epoch", "progress", "stats"}
+    metrics.json    histogram/counter registry snapshot (obs.metrics)
+    recovery.json   {"epoch", "events": [[mono_ts, kind, detail], ...]}
+    complete.json   completeness marker, written LAST: a bundle without
+                    it was torn mid-dump and the report says so
+
+Master manifest (``<dir>/manifest.json``)::
+
+    {"slave_num", "reason", "departed": {rank: why},
+     "diagnosis": [...], "table": {rank: progress+age}, "wall_time"}
+
+Everything here is best-effort by design — the job is already dying;
+a full disk must never turn a clean ``Mp4jFatalError`` into something
+worse. Writers catch ``OSError`` at the call site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ytk_mp4j_tpu.obs import spans, telemetry
+
+_BUNDLE_FILES = ("trace.json", "stats.json", "metrics.json",
+                 "recovery.json")
+
+
+def bundle_dir(root: str, rank: int) -> str:
+    return os.path.join(root, f"rank_{rank:04d}")
+
+
+def write_bundle(root: str, rank: int, *, reason: str, progress: dict,
+                 stats: dict, metrics: dict, epoch: int,
+                 events: list | None = None) -> str:
+    """Write one rank's postmortem bundle; returns the bundle dir.
+    The ``complete.json`` marker goes last so a reader can distinguish
+    a finished bundle from one torn by the dying process."""
+    d = bundle_dir(root, rank)
+    os.makedirs(d, exist_ok=True)
+    spans.export_chrome_trace(os.path.join(d, "trace.json"))
+    _dump(d, "stats.json", {"rank": rank, "reason": reason,
+                            "epoch": epoch, "progress": progress,
+                            "stats": stats})
+    _dump(d, "metrics.json", metrics)
+    _dump(d, "recovery.json", {"epoch": epoch,
+                               "events": list(events or [])})
+    _dump(d, "complete.json", {
+        "rank": rank, "files": list(_BUNDLE_FILES),
+        # wall clock: a postmortem artifact's timestamp must be
+        # human-meaningful across hosts, not a per-process counter
+        # mp4j-lint: disable=R11 (artifact timestamp, not a duration)
+        "wall_time": time.time()})
+    return d
+
+
+def _dump(d: str, name: str, obj) -> None:
+    with open(os.path.join(d, name), "w", encoding="utf-8") as fh:
+        json.dump(obj, fh)
+
+
+def write_master_manifest(root: str, *, slave_num: int, reason: str,
+                          table: dict, departed: dict,
+                          diagnosis: list[str]) -> str:
+    """The master's cluster-level half of the recorder: who the job
+    thought was alive, why it died, and the final heartbeat table
+    (fresh — the slaves' fatal-path telemetry flush lands before the
+    closing manifest refresh)."""
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, "manifest.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "slave_num": slave_num,
+            "reason": reason,
+            "departed": {str(r): why for r, why in departed.items()},
+            "diagnosis": list(diagnosis),
+            "table": {str(r): t for r, t in table.items()},
+            # mp4j-lint: disable=R11 (artifact timestamp, not a duration)
+            "wall_time": time.time(),
+        }, fh)
+    return path
+
+
+# ----------------------------------------------------------------------
+# merged report (the ``mp4j-scope postmortem`` command)
+# ----------------------------------------------------------------------
+def load_bundles(root: str) -> dict[int, dict]:
+    """Read every COMPLETE bundle under ``root``; returns
+    ``{rank: {"stats": ..., "recovery": ..., "metrics": ...,
+    "complete": ..., "torn": bool}}`` (torn bundles appear with
+    whatever files survived and ``torn=True``)."""
+    out: dict[int, dict] = {}
+    for name in sorted(os.listdir(root)):
+        if not name.startswith("rank_"):
+            continue
+        try:
+            rank = int(name[len("rank_"):])
+        except ValueError:
+            continue
+        d = os.path.join(root, name)
+        entry: dict = {"torn": not os.path.exists(
+            os.path.join(d, "complete.json"))}
+        for fname in _BUNDLE_FILES + ("complete.json",):
+            p = os.path.join(d, fname)
+            if os.path.exists(p):
+                try:
+                    with open(p, encoding="utf-8") as fh:
+                        entry[fname.rsplit(".", 1)[0]] = json.load(fh)
+                except (OSError, json.JSONDecodeError):
+                    entry["torn"] = True
+        out[rank] = entry
+    return out
+
+
+def merge_report(root: str) -> str:
+    """One report from a postmortem directory: names the dead rank(s)
+    (no bundle / departed per the manifest), the lagging rank(s)
+    (behind the max collective sequence number), the cluster skew
+    table, and each rank's last position."""
+    manifest = None
+    mpath = os.path.join(root, "manifest.json")
+    if os.path.exists(mpath):
+        with open(mpath, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    bundles = load_bundles(root)
+    if manifest is None and not bundles:
+        raise ValueError(f"{root}: no postmortem bundles or manifest")
+
+    slave_num = (manifest["slave_num"] if manifest
+                 else (max(bundles) + 1 if bundles else 0))
+    lines = [f"postmortem report: {root}"]
+    if manifest:
+        lines.append(f"reason: {manifest.get('reason')}")
+    lines.append(f"bundles: {len(bundles)}/{slave_num} ranks"
+                 + (" (+" + ", ".join(
+                     f"rank {r} TORN" for r in sorted(bundles)
+                     if bundles[r]["torn"]) + ")"
+                    if any(b["torn"] for b in bundles.values()) else ""))
+
+    departed = {int(r): why for r, why in
+                (manifest.get("departed") or {}).items()} if manifest \
+        else {}
+    # dead = left no bundle at all. A rank that dumped and THEN closed
+    # nonzero (every survivor of a fatal does) is a casualty, not the
+    # cause — the manifest's departed map only supplies the "why" for
+    # the ranks that never wrote.
+    dead = sorted(set(range(slave_num)) - set(bundles))
+    for r in dead:
+        why = departed.get(r, "no postmortem bundle written")
+        lines.append(f"DEAD rank {r}: {why}")
+
+    # sequence-number lag across the bundles that exist
+    table = {}
+    for r, b in sorted(bundles.items()):
+        prog = (b.get("stats") or {}).get("progress") or {}
+        table[r] = {"seq": int(prog.get("seq", 0)),
+                    "current": prog.get("current"),
+                    "last": prog.get("last"),
+                    "phase": prog.get("phase"),
+                    "current_secs": float(prog.get("current_secs", 0.0)),
+                    "age": 0.0}
+    if table:
+        lines.append("")
+        lines.extend(telemetry.render_diagnosis(table, slave_num))
+        per_rank = {r: (b.get("stats") or {}).get("stats") or {}
+                    for r, b in bundles.items()}
+        skew = telemetry.cluster_skew(
+            {r: s for r, s in per_rank.items() if s})
+        if skew:
+            lines.append("")
+            lines.append(telemetry.format_skew(skew))
+    for r, b in sorted(bundles.items()):
+        ev = (b.get("recovery") or {}).get("events") or []
+        if ev:
+            tail = "; ".join(f"{kind}({detail})" if detail else kind
+                             for _, kind, detail in ev[-6:])
+            lines.append(f"rank {r} recovery log (last "
+                         f"{min(len(ev), 6)}): {tail}")
+    if manifest and manifest.get("diagnosis"):
+        lines.append("")
+        lines.append("master diagnosis at abort time:")
+        lines.extend(f"  {ln}" for ln in manifest["diagnosis"])
+    return "\n".join(lines)

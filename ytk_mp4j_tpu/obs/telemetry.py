@@ -96,6 +96,59 @@ def format_skew(skew: dict[str, dict]) -> str:
     return "\n".join(lines)
 
 
+def format_live(doc: dict) -> str:
+    """The ``mp4j-scope live`` frame: one view of a master metrics
+    document (``Master.metrics_doc`` / the ``/metrics.json``
+    endpoint) — cluster rates, then one row per rank with throughput,
+    current collective, sequence lag, retry count and heartbeat age.
+    Stragglers (the busy-max ranks of any collective family, same rule
+    as :func:`cluster_skew`) are marked ``*``; ranks behind the max
+    sequence number show their lag."""
+    ranks = doc.get("ranks", {})
+    cl = doc.get("cluster", {})
+    rates = cl.get("rates", {})
+    head = (f"mp4j live — {len(ranks)}/{doc.get('slave_num', '?')} "
+            f"ranks reporting | "
+            f"{rates.get('bytes_per_sec', 0.0) / 1e9:.3f} GB/s | "
+            f"{rates.get('collectives_per_sec', 0.0):.1f} coll/s | "
+            f"{rates.get('keys_per_sec', 0.0):.0f} keys/s "
+            f"(window {doc.get('window_secs', 0):.0f}s)")
+    if not ranks:
+        return head + "\n(no rank telemetry yet)"
+    skew = cluster_skew({int(r): info.get("stats", {})
+                         for r, info in ranks.items()
+                         if info.get("stats")})
+    stragglers = {r for s in skew.values() for r in s["stragglers"]}
+    max_seq = max(info.get("progress", {}).get("seq", 0)
+                  for info in ranks.values())
+    lines = [head,
+             f"{'rank':>4}  {'seq':>5}  {'lag':>4}  "
+             f"{'state':<34}  {'MB/s':>8}  {'retries':>7}  hb age"]
+    for r in sorted(ranks, key=int):
+        info = ranks[r]
+        prog = info.get("progress", {})
+        seq = prog.get("seq", 0)
+        lag = max_seq - seq
+        if prog.get("current"):
+            state = (f"in {prog['current']} "
+                     f"({prog.get('current_secs', 0.0):.1f}s"
+                     + (f", {prog['phase']}" if prog.get("phase")
+                        else "") + ")")
+        elif prog.get("last"):
+            state = f"idle after {prog['last']}"
+        else:
+            state = "idle"
+        retries = sum(int(e.get("retries", 0))
+                      for e in info.get("stats", {}).values())
+        mark = "*" if int(r) in stragglers else " "
+        lines.append(
+            f"{mark}{r:>3}  {seq:>5}  {lag if lag else '-':>4}  "
+            f"{state:<34.34}  "
+            f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:>8.2f}  "
+            f"{retries:>7}  {info.get('age', 0.0):.1f}s")
+    return "\n".join(lines)
+
+
 def render_diagnosis(table: dict[int, dict], slave_num: int) -> list[str]:
     """Render a hang/straggler diagnosis from the master's heartbeat
     table.

@@ -42,6 +42,7 @@ check — and it is skipped entirely at ``MP4J_MAX_RETRIES=0``.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -77,7 +78,8 @@ class RecoveryManager:
 
     def __init__(self, *, rank: int, max_retries: int,
                  dead_rank_secs: float, send_ctl, teardown, stats,
-                 wake=None, drain=None, progress=None):
+                 wake=None, drain=None, progress=None,
+                 terminal_hook=None):
         self.rank = rank
         self.max_retries = max_retries
         self.dead_rank_secs = dead_rank_secs
@@ -86,6 +88,16 @@ class RecoveryManager:
         self._stats = stats
         self._wake = wake or (lambda: None)
         self._drain = drain or (lambda: None)
+        # flight-recorder hook (ISSUE 6): fired exactly once, on the
+        # FIRST terminal abort, BEFORE the fatal flag wakes any waiter
+        # — the slave's final telemetry flush + postmortem dump must
+        # land before the collective thread raises and the caller
+        # starts tearing the process down
+        self._terminal_hook = terminal_hook
+        self._terminal_fired = False
+        # bounded epoch/retry event log — the postmortem bundle's
+        # recovery.json (monotonic timestamps: deltas are what matter)
+        self._events: collections.deque = collections.deque(maxlen=256)
         # (collective ordinal, in-flight flag) for the abort ack: the
         # master refuses to release a round whose ranks sit at
         # DIFFERENT collectives — recovery is per-collective, and a
@@ -102,6 +114,13 @@ class RecoveryManager:
     # ------------------------------------------------------------------
     # control-thread side
     # ------------------------------------------------------------------
+    def _note(self, kind: str, detail: str = "") -> None:
+        self._events.append((time.monotonic(), kind, detail))
+
+    def events(self) -> list[tuple]:
+        """The bounded epoch/retry event log (postmortem bundle)."""
+        return list(self._events)
+
     def on_abort(self, target: int) -> None:
         """Master announced an abort round targeting ``target``: tear
         down the old epoch's data plane and ack. Runs on the control
@@ -112,6 +131,7 @@ class RecoveryManager:
                 return          # duplicate/stale announcement
             self._target = target
             self._cond.notify_all()
+        self._note("abort", f"epoch->{target}")
         self._teardown()
         self._stats.add("aborts_seen", 1)
         spans.mark("abort", self.rank, epoch=target)
@@ -129,12 +149,31 @@ class RecoveryManager:
             if epoch > self.epoch:
                 self.epoch = epoch
             self._cond.notify_all()
+        self._note("go", f"epoch={epoch}")
         self._wake()
 
     def on_fatal(self, msg: str) -> None:
         """Terminal abort (from the master's fan-out, or locally when
         the master is unreachable): record the one job-wide message and
-        wake every waiter."""
+        wake every waiter. The FIRST call also fires the terminal hook
+        — final telemetry flush + postmortem dump (ISSUE 6) — before
+        the fatal flag is published, so every survivor's bundle is on
+        disk before any thread raises; the hook is wrapped: a recorder
+        failure must never block the abort itself."""
+        with self._cond:
+            first = not self._terminal_fired
+            self._terminal_fired = True
+        if first:
+            self._note("fatal", msg[:120])
+            if self._terminal_hook is not None:
+                try:
+                    self._terminal_hook(msg)
+                # the job is dying with `msg`; a best-effort recorder
+                # error (full disk, dead master channel) must not
+                # replace or delay that
+                # mp4j-lint: disable=R5 (best-effort flight recorder)
+                except Exception:
+                    pass
         with self._cond:
             if self._fatal is None:
                 self._fatal = msg
@@ -243,6 +282,7 @@ class RecoveryManager:
                         f"round(s): {e}", cause=e)
                 tries += 1
                 self._stats.add("retries", 1, bucket=name)
+                self._note("retry", f"{name} attempt={tries}")
                 spans.mark("retry", self.rank, collective=name,
                            attempt=tries, error=repr(e)[:120])
                 self._request_abort(epoch0, name, e)

@@ -51,6 +51,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import spans
 
 _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
@@ -86,6 +87,10 @@ class CommStats:
         self._agg: dict[str, dict[str, float]] = {}
         self._tl = threading.local()
         self.rank: int | None = None
+        # metrics plane (ISSUE 6): per-family latency + frame-size
+        # histograms ride here; the heartbeat ships their deltas.
+        # MP4J_METRICS=0 turns every observe into a flag check.
+        self.metrics = metrics_mod.MetricsRegistry()
         # progress state for the telemetry heartbeat / hang diagnosis
         self._seq = 0                      # outermost collectives entered
         self._current: str | None = None   # collective in flight
@@ -111,11 +116,16 @@ class CommStats:
         self._tl.depth = depth + 1
         if depth == 0:
             self._tl.name = name
+            now = time.perf_counter()
+            # per-thread start time: on the shared thread-backend stats
+            # another thread's begin() can overwrite _current_since, so
+            # the latency histogram reads the thread-local copy
+            self._tl.t0 = now
             with self._lock:
                 self._seq += 1
                 seq = self._seq
                 self._current = name
-                self._current_since = time.perf_counter()
+                self._current_since = now
                 self._last_phase = None  # phase is per-collective: a
                 # rank stuck before booking any phase must not report
                 # the PREVIOUS collective's last phase in its heartbeat
@@ -130,6 +140,8 @@ class CommStats:
     def end(self, outermost: int) -> None:
         self._tl.depth = getattr(self._tl, "depth", 1) - 1
         if outermost:
+            name = getattr(self._tl, "name", None)
+            t0 = getattr(self._tl, "t0", None)
             self._tl.name = None
             with self._lock:
                 self._last = self._current or self._last
@@ -137,6 +149,12 @@ class CommStats:
                 self._shared_depth -= 1
                 if self._shared_depth <= 0:
                     self._shared_name = None
+            # per-family latency histogram (metrics plane, ISSUE 6):
+            # observed outside the lock — the registry has its own
+            if name is not None and t0 is not None:
+                self.metrics.observe(
+                    f"latency/{name}", time.perf_counter() - t0,
+                    metrics_mod.LATENCY_LO, metrics_mod.LATENCY_BUCKETS)
 
     def bucket(self) -> str:
         """The current attribution bucket: this thread's collective
@@ -209,6 +227,16 @@ class CommStats:
             spans.phase("wire", seconds, self.rank, name, seq,
                         bytes_sent=bytes_sent or None,
                         bytes_recv=bytes_recv or None, peer=peer)
+        # frame-size histogram, one observation per direction moved
+        if self.metrics.enabled:
+            if bytes_sent:
+                self.metrics.observe("frame_bytes", bytes_sent,
+                                     metrics_mod.FRAME_LO,
+                                     metrics_mod.FRAME_BUCKETS)
+            if bytes_recv:
+                self.metrics.observe("frame_bytes", bytes_recv,
+                                     metrics_mod.FRAME_LO,
+                                     metrics_mod.FRAME_BUCKETS)
 
     # -- reading -------------------------------------------------------
     def snapshot(self) -> dict[str, dict[str, float]]:
@@ -223,11 +251,32 @@ class CommStats:
 def merge_snapshots(*snaps: dict[str, dict[str, float]]
                     ) -> dict[str, dict[str, float]]:
     """Key-wise sum of snapshots (the thread backend combines its
-    intra-process counters with the shared process slave's)."""
+    intra-process counters with the shared process slave's; the master
+    folds heartbeat DELTAS back into its rolling cumulative view)."""
     out: dict[str, dict[str, float]] = {}
     for snap in snaps:
         for name, entry in snap.items():
             acc = out.setdefault(name, _zero())
             for k, v in entry.items():
                 acc[k] = acc.get(k, 0) + v
+    return out
+
+
+def diff_snapshots(cur: dict[str, dict[str, float]],
+                   prev: dict[str, dict[str, float]]
+                   ) -> dict[str, dict[str, float]]:
+    """``cur - prev``, pruned to families that actually changed —
+    the heartbeat payload (ISSUE 6 satellite): a long job's beat is
+    bounded by activity since the last beat, not by every collective
+    family ever seen. All stats are monotone accumulators, so
+    ``merge_snapshots(prev, diff_snapshots(cur, prev)) == cur``."""
+    out: dict[str, dict[str, float]] = {}
+    for name, entry in cur.items():
+        base = prev.get(name)
+        if base is None:
+            delta = dict(entry)
+        else:
+            delta = {k: v - base.get(k, 0) for k, v in entry.items()}
+        if any(delta.values()):
+            out[name] = delta
     return out

@@ -49,6 +49,21 @@ Knobs (all validated where they are consumed; garbage raises
   terminal abort (``comm/master.py``).
 - ``MP4J_FAULT_PLAN`` — deterministic fault-injection plan for chaos
   testing (``resilience/faults.py``; empty disables injection).
+- ``MP4J_METRICS`` — the live metrics plane (``obs/metrics.py``): ``1``
+  (default) records latency/frame-size histograms and ships metric
+  deltas on the heartbeat; ``0`` turns recording into a no-op (the
+  bench A/B knob).
+- ``MP4J_METRICS_PORT`` — the master's control-plane HTTP metrics
+  endpoint (``comm/master.py``): unset/empty disables it, ``0`` binds
+  an ephemeral port (``Master.metrics_port`` reports it), anything
+  else binds that port.
+- ``MP4J_METRICS_WINDOW_SECS`` — the sliding window the master derives
+  rates (GB/s, collectives/s, keys/s) over from its ring of interval
+  snapshots.
+- ``MP4J_POSTMORTEM_DIR`` — flight-recorder directory
+  (``obs/postmortem.py``): on any terminal abort every rank dumps a
+  postmortem bundle here and the master writes a cluster manifest;
+  empty disables the recorder.
 """
 
 from __future__ import annotations
@@ -81,6 +96,11 @@ DEFAULT_DEAD_RANK_SECS = 120.0
 # tax stays well under the <2% bench budget (ISSUE 3).
 DEFAULT_HEARTBEAT_SECS = 0.5
 DEFAULT_SPAN_RING = 65536
+# Metrics-plane default (ISSUE 6): the window the master's rate ring
+# covers. Heartbeats arrive every DEFAULT_HEARTBEAT_SECS, so 60 s keeps
+# ~120 interval points per rank — enough for a stable GB/s readout,
+# small enough that a stall shows within a minute.
+DEFAULT_METRICS_WINDOW_SECS = 60.0
 
 # Log-level ladder for the master's log sink (MP4J_LOG_LEVEL).
 LOG_LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "ERROR": 40}
@@ -216,6 +236,68 @@ def dead_rank_secs(override=None) -> float:
             f"dead_rank_secs={override} must be > 0 "
             f"(use float('inf') to disable the escalation)")
     return val
+
+
+def metrics_enabled() -> bool:
+    """Whether the metrics plane records (``MP4J_METRICS``): latency /
+    frame-size histograms plus the heartbeat's metric deltas. Default
+    on — recording is a lock + two integer bumps per event; ``0`` is
+    the bench's A/B knob, turning every observe into a no-op."""
+    raw = os.environ.get("MP4J_METRICS")
+    if raw is None or raw.strip() == "":
+        return True
+    val = raw.strip()
+    if val not in ("0", "1"):
+        raise Mp4jError(f"MP4J_METRICS={raw!r} must be 0 or 1")
+    return val == "1"
+
+
+def metrics_port(override=None) -> int | None:
+    """The master's HTTP metrics endpoint port (``MP4J_METRICS_PORT``).
+    ``None`` (unset/empty) disables the endpoint; ``0`` binds an
+    ephemeral port (read ``Master.metrics_port`` for the real one);
+    otherwise must be a valid TCP port. ``override`` is the explicit
+    ``Master(metrics_port=...)`` constructor value — it bypasses the
+    env read but gets the SAME validation (one validator per knob, the
+    PR 5 discipline), so a typo'd port raises a clean ``Mp4jError``
+    instead of a raw socket OverflowError at bind time."""
+    if override is not None:
+        raw = str(override)
+    else:
+        raw = os.environ.get("MP4J_METRICS_PORT")
+        if raw is None or raw.strip() == "":
+            return None
+    try:
+        val = int(raw)
+    except (TypeError, ValueError):
+        raise Mp4jError(
+            f"MP4J_METRICS_PORT={raw!r} is not an integer port") from None
+    if not 0 <= val <= 65535:
+        raise Mp4jError(
+            f"MP4J_METRICS_PORT={val} outside [0, 65535]")
+    return val
+
+
+def metrics_window_secs() -> float:
+    """Sliding window (seconds) for the master's derived rates
+    (``MP4J_METRICS_WINDOW_SECS``); must be positive — a zero window
+    can never hold two interval snapshots, so every rate would read
+    0."""
+    return env_float("MP4J_METRICS_WINDOW_SECS",
+                     DEFAULT_METRICS_WINDOW_SECS, minimum=0.001)
+
+
+def postmortem_dir() -> str:
+    """The flight-recorder directory (``MP4J_POSTMORTEM_DIR``); empty
+    disables the recorder. Validated lightly here (it must not name an
+    existing regular file — every rank is about to mkdir under it);
+    creation happens lazily at dump time."""
+    raw = os.environ.get("MP4J_POSTMORTEM_DIR", "").strip()
+    if raw and os.path.isfile(raw):
+        raise Mp4jError(
+            f"MP4J_POSTMORTEM_DIR={raw!r} names an existing regular "
+            "file, not a directory")
+    return raw
 
 
 def fault_plan_spec() -> str:
