@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 12)]
+    assert ids == [f"R{i}" for i in range(1, 13)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -848,4 +848,74 @@ def test_r11_inline_suppression_and_baseline():
         def export(t0, epoch):
             return t0 - epoch + _epoch_wall
     """, path="ytk_mp4j_tpu/obs/snippet.py", baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R12 — transport construction outside transport/ (SPI enforcement)
+# ----------------------------------------------------------------------
+def test_r12_fires_on_raw_socket_outside_transport():
+    r = run_rule("R12", """
+        import socket
+
+        def open_side_channel(self):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            return s
+    """)
+    [f] = r.findings
+    assert f.rule == "R12" and f.line == 5
+    assert "socket.socket" in f.message
+
+
+def test_r12_fires_on_channel_construction_outside_transport():
+    for ctor in ("Channel", "TcpChannel", "ShmChannel"):
+        r = run_rule("R12", f"""
+            def wrap(self, sock):
+                return {ctor}(sock)
+        """)
+        [f] = r.findings
+        assert f.rule == "R12" and ctor in f.message
+
+
+def test_r12_clean_inside_transport_and_on_connect():
+    src = """
+        import socket
+
+        def dial(self, host, port):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            return TcpChannel(s)
+    """
+    # inside transport/ the constructions ARE the SPI implementation
+    assert not run_rule(
+        "R12", src,
+        path="ytk_mp4j_tpu/transport/snippet.py").findings
+    # connect() is the sanctioned factory — never flagged anywhere
+    assert not run_rule("R12", """
+        def get_peer(self, host, port):
+            return connect(host, port, timeout=self._timeout)
+    """).findings
+    # a user-defined callable that merely ENDS in "socket" via a
+    # non-dotted name is out of scope (only the dotted repo idiom)
+    assert not run_rule("R12", """
+        def make(self):
+            return websocket("ws://x")
+    """).findings
+
+
+def test_r12_baseline_suppression_matches_rendezvous_site():
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R12"
+        file = "ytk_mp4j_tpu/comm/snippet.py"
+        context = "Master.__init__"
+        reason = "rendezvous listen socket"
+    """))
+    r = run_rule("R12", """
+        import socket
+
+        class Master:
+            def __init__(self):
+                self._server = socket.socket(socket.AF_INET,
+                                             socket.SOCK_STREAM)
+    """, baseline=bl)
     assert not r.findings and len(r.suppressed) == 1

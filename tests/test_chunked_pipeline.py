@@ -22,7 +22,7 @@ from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operands
 from ytk_mp4j_tpu.operators import Operators
-from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.transport.tcp import TcpChannel as Channel
 from ytk_mp4j_tpu.utils import tuning
 
 _DTYPES = {

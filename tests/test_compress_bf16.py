@@ -9,7 +9,7 @@ import pytest
 from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
 from ytk_mp4j_tpu.operands import Operands
 from ytk_mp4j_tpu.operators import Operators
-from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.transport.tcp import TcpChannel as Channel
 
 from helpers import expected_reduce, run_slaves
 

@@ -32,7 +32,7 @@ from ytk_mp4j_tpu.obs import spans
 from ytk_mp4j_tpu.operands import Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.resilience.faults import FaultInjector, FaultKill, FaultPlan
-from ytk_mp4j_tpu.transport.channel import connect
+from ytk_mp4j_tpu.transport.tcp import connect
 from ytk_mp4j_tpu.utils import trace, tuning
 
 N = 4
@@ -120,6 +120,15 @@ def _body(path):
     return fn, {"native_transport": path == "raw"}
 
 
+# transport dimension (ISSUE 7): the thread harness co-locates every
+# rank, so the default plane is the shm rings — "reset" faults become
+# the ring-poison analogue (the injector's invalidate() poisons the
+# shared header) and recovery must drain/re-negotiate SEGMENTS, not
+# sockets. shm=False pins the original all-TCP grid.
+def _transport_kw(transport):
+    return {} if transport == "shm" else {"shm": False}
+
+
 def _totals(stats, keys=("retries", "reconnects", "aborts_seen")):
     tot = dict.fromkeys(keys, 0)
     for snap in stats:
@@ -129,11 +138,13 @@ def _totals(stats, keys=("retries", "reconnects", "aborts_seen")):
     return tot
 
 
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
 @pytest.mark.parametrize("path", ["raw", "framed", "map"])
-def test_chaos_reset_recovers_bit_exactly(path):
+def test_chaos_reset_recovers_bit_exactly(path, transport):
     """A connection reset mid-collective recovers without operator
     intervention, bit-exact against an unfaulted run."""
     fn, kw = _body(path)
+    kw.update(_transport_kw(transport))
     want, werr, _, _ = run_chaos(N, fn, fault_plan=None, **kw)
     assert all(e is None for e in werr)
     got, errors, stats, log = run_chaos(
@@ -231,13 +242,15 @@ def test_reduce_plane_inplace_operator_values_isolated():
         assert got[0] == want[0], f"root diverged after recovery"
 
 
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
 @pytest.mark.parametrize("path", ["raw", "framed", "map"])
-def test_chaos_kill_gives_clean_identical_error(path):
+def test_chaos_kill_gives_clean_identical_error(path, transport):
     """A slave killed at collective N: the killed rank raises
     FaultKill, every SURVIVOR raises the same Mp4jFatalError naming
     the dead rank, within the bounded join — never a hang, never a
     partial result."""
     fn, kw = _body(path)
+    kw.update(_transport_kw(transport))
     _, errors, _, log = run_chaos(
         N, fn, fault_plan="kill:rank=2:nth=2", **kw)
     assert isinstance(errors[2], FaultKill)
@@ -250,11 +263,13 @@ def test_chaos_kill_gives_clean_identical_error(path):
     assert "terminal abort" in log
 
 
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
 @pytest.mark.parametrize("path", ["raw", "framed", "map"])
-def test_chaos_slow_rank_completes_bit_exactly(path):
+def test_chaos_slow_rank_completes_bit_exactly(path, transport):
     """A persistently slow rank is a performance event, not a fault:
     no retries, no aborts, bit-exact output."""
     fn, kw = _body(path)
+    kw.update(_transport_kw(transport))
     want, werr, _, _ = run_chaos(N, fn, fault_plan=None, **kw)
     assert all(e is None for e in werr)
     got, errors, stats, _ = run_chaos(

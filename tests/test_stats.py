@@ -104,3 +104,49 @@ def test_merge_snapshots_disjoint_and_overlapping():
     assert merged["barrier"]["calls"] == 1
     assert merged["barrier"]["wire_seconds"] == 0.0
     assert merge_snapshots() == {}
+
+
+def test_add_wire_transport_split_and_frame_families():
+    """ISSUE 7: wire events tagged with a transport book the
+    ``wire_bytes_{tcp,shm}`` split and land in the matching
+    ``frame_bytes/<transport>`` histogram family; untagged events keep
+    the untagged totals + legacy ``frame_bytes`` family only."""
+    cs = CommStats()
+    tok = cs.begin("allreduce_array")
+    cs.add_wire(100, 50, 0.01, transport="tcp")
+    cs.add_wire(200, 0, 0.01, transport="shm")
+    cs.add_wire(7, 7, 0.01)                       # untagged (bare test
+    cs.add_wire(9, 0, 0.01, transport="weird")    # channel / unknown)
+    cs.end(tok)
+
+    e = cs.snapshot()["allreduce_array"]
+    assert e["bytes_sent"] == 316 and e["bytes_recv"] == 57
+    assert e["wire_bytes_tcp"] == 150
+    assert e["wire_bytes_shm"] == 200
+    # the split never invents bytes: tagged <= total
+    assert (e["wire_bytes_tcp"] + e["wire_bytes_shm"]
+            <= e["bytes_sent"] + e["bytes_recv"])
+
+    hists = cs.metrics.snapshot()["histograms"]
+    assert hists["frame_bytes/tcp"]["count"] == 2   # 100 sent + 50 recv
+    assert hists["frame_bytes/shm"]["count"] == 1   # one direction moved
+    assert hists["frame_bytes"]["count"] == 3       # untagged + unknown
+
+
+def test_transport_split_renders_in_prometheus():
+    from ytk_mp4j_tpu.obs import metrics as metrics_mod
+
+    cs = CommStats()
+    tok = cs.begin("allreduce_array")
+    cs.add_wire(4096, 4096, 0.01, transport="shm")
+    cs.end(tok)
+    doc = {"slave_num": 1, "window_secs": 60.0,
+           "ranks": {"0": {"progress": {"seq": 1}, "age": 0.0,
+                           "stats": cs.snapshot(), "rates": {},
+                           "histograms": {}}},
+           "cluster": {"stats": cs.snapshot(), "rates": {},
+                       "histograms":
+                           cs.metrics.snapshot()["histograms"]}}
+    text = metrics_mod.to_prometheus(doc)
+    assert 'mp4j_wire_bytes_shm_total{rank="0",' in text
+    assert 'mp4j_frame_bytes_bucket{transport="shm",le=' in text

@@ -1,0 +1,333 @@
+"""Transport-conformance grid (ISSUE 7 satellite): ONE parametrized
+contract suite run against BOTH concrete transports, so any third
+transport gets correctness for free by joining the fixture.
+
+Covers the whole Channel SPI surface: every frame type (objects,
+arrays across dtypes, paired map columns, the unframed raw plane) ×
+compression (plain, one-shot Z, streamed ZC) × in-place receives
+(``recv_array_into`` + chunk callbacks) × protocol-violation errors ×
+timeout expiry × ``invalidate()`` under a BLOCKED receive (both local
+and remote side — the recovery teardown's wake contract) × graceful
+close (the finishing-rank drain discipline).
+
+The shm pairs deliberately run a TINY ring (8 KiB) so multi-hundred-KB
+frames wrap the ring dozens of times — the wraparound, backpressure
+and spin/nap wakeup machinery is the part a happy-path test would
+never touch.
+"""
+
+import secrets
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.exceptions import Mp4jError, Mp4jTransportError
+from ytk_mp4j_tpu.transport import shm as shm_mod
+from ytk_mp4j_tpu.transport.tcp import TcpChannel
+
+RING = 8192          # tiny on purpose: force wraparound + backpressure
+TRANSPORTS = ("tcp", "shm")
+
+
+def make_pair(kind):
+    """(channel_a, channel_b) — a connected duplex pair of ``kind``."""
+    a, b = socket.socketpair()
+    if kind == "tcp":
+        return TcpChannel(a), TcpChannel(b)
+    name = f"mp4j-test-{secrets.token_hex(4)}"
+    seg_a = shm_mod.create_segment(name, RING)
+    seg_b = shm_mod.attach_segment(seg_a.token)
+    return (shm_mod.ShmChannel(a, seg_a, RING, owner=True),
+            shm_mod.ShmChannel(b, seg_b, RING, owner=False))
+
+
+@pytest.fixture(params=TRANSPORTS)
+def pair(request):
+    ca, cb = make_pair(request.param)
+    yield ca, cb
+    for ch in (ca, cb):
+        try:
+            ch.close()
+        except Exception:
+            pass
+
+
+def pump(send_fn, recv_fn, timeout=20.0):
+    """Run ``send_fn`` on a helper thread while ``recv_fn`` runs here —
+    the duplex discipline every large transfer needs (kernel socket
+    buffers and the shm ring are both finite)."""
+    box = {}
+
+    def sender():
+        try:
+            send_fn()
+        except BaseException as e:      # surfaced below
+            box["err"] = e
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    out = recv_fn()
+    t.join(timeout)
+    assert not t.is_alive(), "sender hung"
+    if "err" in box:
+        raise box["err"]
+    return out
+
+
+# ----------------------------------------------------------------------
+# frame types × compression
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("compress", [False, True])
+def test_obj_roundtrip(pair, compress):
+    ca, cb = pair
+    payload = {"k": [1, 2.5, "s"], "nested": (None, b"bytes" * 50)}
+    out = pump(lambda: ca.send_obj(payload, compress=compress),
+               cb.recv)
+    assert out == payload
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                   np.int64, np.int8, np.uint16])
+def test_array_roundtrip_dtypes(pair, dtype):
+    ca, cb = pair
+    rng = np.random.default_rng(7)
+    arr = (rng.standard_normal(9001).astype(dtype)
+           if np.dtype(dtype).kind == "f"
+           else rng.integers(0, 100, 9001).astype(dtype))
+    out = pump(lambda: ca.send_array(arr), cb.recv_array)
+    assert out.dtype == arr.dtype
+    np.testing.assert_array_equal(out, arr)
+
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_large_array_wraps_ring(pair, compress):
+    # ~400 KiB >> the 8 KiB shm ring: dozens of wraparounds (and the
+    # compressed leg streams self-delimiting ZC chunks through it)
+    ca, cb = pair
+    arr = np.arange(100_000, dtype=np.float32)
+    out = pump(lambda: ca.send_array(arr, compress=compress),
+               cb.recv_array)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_bidirectional_simultaneous(pair):
+    # full-duplex: both sides send ~200 KiB at once — deadlocks here
+    # mean the transport serialized its directions
+    ca, cb = pair
+    x = np.arange(50_000, dtype=np.float64)
+
+    def recv_both():
+        return cb.recv_array()
+
+    out_b = pump(lambda: ca.send_array(x), recv_both)
+    out_a = pump(lambda: cb.send_array(x + 1), ca.recv_array)
+    np.testing.assert_array_equal(out_b, x)
+    np.testing.assert_array_equal(out_a, x + 1)
+
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_map_columns_roundtrip(pair, compress):
+    ca, cb = pair
+    codes = np.arange(5000, dtype=np.int32)
+    values = np.random.default_rng(3).standard_normal((5000, 2))
+    rc, rv = pump(
+        lambda: ca.send_map_columns(codes, values, compress=compress),
+        cb.recv_map_columns)
+    np.testing.assert_array_equal(rc, codes)
+    np.testing.assert_array_equal(rv, values)
+
+
+def test_malformed_map_columns_is_protocol_error(pair):
+    ca, cb = pair
+    codes = np.arange(4, dtype=np.int64)     # not int32: violation
+    values = np.zeros((4, 1))
+    with pytest.raises(Mp4jError, match="malformed map column pair"):
+        pump(lambda: (ca.send_array(codes), ca.send_array(values)),
+             cb.recv_map_columns)
+
+
+@pytest.mark.parametrize("n", [600, 60_000, 300_000])
+def test_raw_roundtrip(pair, n):
+    # 2.4 KB rides the shm carrier, 240 KB sits at the hybrid
+    # boundary, 1.2 MB takes the ring-piece path (150 pieces through
+    # the 8 KiB test ring — wraparound + sync-byte machinery)
+    ca, cb = pair
+    arr = np.arange(n, dtype=np.int32)
+    out = np.empty_like(arr)
+    pump(lambda: ca.send_raw(arr), lambda: cb.recv_raw_into(out))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_duplex_exchange_shm_bidirectional():
+    # the single-threaded cooperative duplex (the shm analogue of the
+    # native poll loop): both directions at once, ring-sized payloads
+    from ytk_mp4j_tpu.transport.shm import duplex_exchange
+
+    ca, cb = make_pair("shm")
+    try:
+        big = np.arange(400_000, dtype=np.int32)
+        out_a = np.empty_like(big)
+        out_b = np.empty_like(big)
+
+        def side_b():
+            duplex_exchange(cb, big * 3, cb, out_b)
+
+        t = threading.Thread(target=side_b, daemon=True)
+        t.start()
+        duplex_exchange(ca, big, ca, out_a)
+        t.join(10.0)
+        assert not t.is_alive()
+        np.testing.assert_array_equal(out_a, big * 3)
+        np.testing.assert_array_equal(out_b, big)
+    finally:
+        ca.close()
+        cb.close()
+
+
+# ----------------------------------------------------------------------
+# in-place receives + chunk callbacks
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("compress", [False, True])
+def test_recv_array_into_chunks_tile(pair, compress):
+    ca, cb = pair
+    arr = np.arange(700_000, dtype=np.float32)   # ~2.7 MB: >2 chunks
+    dst = np.zeros_like(arr)
+    seen = []
+    pump(lambda: ca.send_array(arr, compress=compress),
+         lambda: cb.recv_array_into(dst, on_chunk=seen.append
+                                    if False else
+                                    lambda lo, hi: seen.append((lo, hi))))
+    np.testing.assert_array_equal(dst, arr)
+    assert seen and seen[0][0] == 0 and seen[-1][1] == arr.size
+    for (alo, ahi), (blo, bhi) in zip(seen, seen[1:]):
+        assert ahi == blo and alo < ahi      # ascending, gap-free
+
+
+def test_recv_array_into_mismatch_raises(pair):
+    ca, cb = pair
+    with pytest.raises(Mp4jError, match="does not match"):
+        pump(lambda: ca.send_array(np.zeros(8, np.float64)),
+             lambda: cb.recv_array_into(np.zeros(8, np.float32)))
+
+
+def test_recv_array_into_rejects_obj_frame(pair):
+    ca, cb = pair
+    with pytest.raises(Mp4jError, match="expected an array frame"):
+        pump(lambda: ca.send_obj({"not": "array"}),
+             lambda: cb.recv_array_into(np.zeros(4, np.float32)))
+
+
+# ----------------------------------------------------------------------
+# timeouts, invalidate, close
+# ----------------------------------------------------------------------
+def test_recv_timeout_expires(pair):
+    ca, cb = pair
+    cb.set_timeout(0.2)
+    t0 = time.monotonic()
+    with pytest.raises(Mp4jTransportError, match="timed out"):
+        cb.recv()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_send_timeout_when_peer_not_draining(pair):
+    # fill the transport's buffering (kernel socket buffer / shm ring)
+    # with nobody reading: the send must expire, not hang
+    ca, cb = pair
+    ca.set_timeout(0.3)
+    big = np.zeros(4_000_000, np.uint8)
+    with pytest.raises(Mp4jTransportError, match="timed out"):
+        ca.send_array(big)
+
+
+@pytest.mark.parametrize("side", ["local", "remote"])
+def test_invalidate_unblocks_blocked_recv(pair, side):
+    # the recovery teardown's contract: invalidate() — from EITHER end
+    # — must wake a blocked receive with a transport error, promptly
+    ca, cb = pair
+    errs = []
+
+    def blocked():
+        try:
+            cb.recv()
+        except Mp4jTransportError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.15)                    # ensure it is truly blocked
+    (cb if side == "local" else ca).invalidate()
+    t.join(5.0)
+    assert not t.is_alive(), "invalidate did not wake the receive"
+    assert len(errs) == 1
+
+
+def test_invalidate_poisons_future_ops(pair):
+    ca, cb = pair
+    ca.invalidate()
+    with pytest.raises((Mp4jTransportError, OSError)):
+        ca.send_obj("x")
+        # a poisoned/shutdown channel may need a receive to observe
+        # the tear on some transports
+        cb.recv()
+
+
+def test_graceful_close_preserves_sent_frames(pair):
+    # a finishing rank's last frames must survive its close: the peer
+    # still reads them afterwards, and only the NEXT receive errors
+    ca, cb = pair
+    payload = np.arange(30_000, dtype=np.float32)
+
+    def send_and_close():
+        ca.send_array(payload)
+        ca.close(graceful=True)
+
+    out = pump(send_and_close, cb.recv_array)
+    np.testing.assert_array_equal(out, payload)
+    cb.set_timeout(5.0)
+    with pytest.raises(Mp4jTransportError):
+        cb.recv()
+
+
+def test_shm_close_releases_segment():
+    ca, cb = make_pair("shm")
+    import glob
+
+    ca.close()
+    cb.close()
+    # memfd backing leaves no name anywhere (kernel frees on last
+    # close); the shm_open fallback must have unlinked its name
+    assert not glob.glob("/dev/shm/mp4j-test-*")
+    # the mapping is released: the segment buffer is no longer usable
+    with pytest.raises((ValueError, TypeError)):
+        ca._seg.buf[0]
+
+
+def test_shm_carrier_death_unblocks_reader():
+    # kill -9 analogue: the peer can never poison the ring, so the
+    # carrier socket's EOF must surface within the liveness cadence
+    ca, cb = make_pair("shm")
+    try:
+        errs = []
+
+        def blocked():
+            try:
+                cb.recv()
+            except Mp4jTransportError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=blocked, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        ca.sock.close()                 # abrupt death, no poison
+        t.join(5.0)
+        assert not t.is_alive() and len(errs) == 1
+        assert "carrier" in str(errs[0])
+    finally:
+        for ch in (ca, cb):
+            try:
+                ch.close()
+            except Exception:
+                pass

@@ -1,0 +1,217 @@
+"""Property grid for the shm transport and the two-level collectives
+(ISSUE 7 acceptance): shm and two-level results must be BIT-IDENTICAL
+to the all-TCP reference for every numeric operand × {SUM, MAX, MIN,
+PROD} × non-pow2 rank counts — dense collectives AND columnar maps.
+
+Inputs are small exact integers (stored in each operand's dtype), so
+every merge order yields the same bits — which makes plain equality the
+right assertion across schedules that legitimately reorder merges
+(flat rhd vs intra-host tree + leader rhd).
+
+Topology: the thread harness co-locates all ranks, so the all-shm flat
+grid is the DEFAULT plane; the two-level grid builds a virtual 2-host
+roster via the ``host_fp`` seam (which ranks land on which virtual
+host is registration-order racy — deliberately: correctness may not
+depend on the grouping).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.meta import partition_range
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+NUMERIC = [Operands.DOUBLE, Operands.FLOAT, Operands.INT,
+           Operands.LONG, Operands.SHORT, Operands.BYTE]
+OPS = [Operators.SUM, Operators.MAX, Operators.MIN, Operators.PROD]
+LENGTH = 157                     # odd: uneven segments everywhere
+
+
+def run_grid(n, fn, fps=None, timeout=60.0, **slave_kwargs):
+    """Master + n slave threads; ``fps[i]`` (worker index, NOT rank —
+    rank assignment is registration-order racy, on purpose) feeds the
+    ``host_fp`` seam. Returns per-rank results."""
+    master = Master(n, timeout=timeout).serve_in_thread()
+    results = [None] * n
+    errors = []
+
+    def worker(i):
+        slave = None
+        try:
+            kw = dict(slave_kwargs)
+            if fps is not None:
+                kw["host_fp"] = fps[i]
+            slave = ProcessCommSlave("127.0.0.1", master.port,
+                                     timeout=timeout, **kw)
+            results[slave.rank] = fn(slave, slave.rank)
+            slave.close(0)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+            if slave is not None:
+                try:
+                    slave.close(1)
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "slave thread hung"
+    if errors:
+        raise errors[0]
+    master.join(timeout)
+    assert master.final_code == 0
+    return results
+
+
+def exact_inputs(n, operand, rng):
+    """Per-rank arrays of small exact integers in the operand dtype:
+    n PROD factors of magnitude <= 3 stay exact in every dtype here,
+    so ANY merge order is bit-identical."""
+    return [rng.integers(1, 4, LENGTH).astype(operand.dtype)
+            for _ in range(n)]
+
+
+def _virtual_hosts(n):
+    """Worker-index fingerprints splitting n ranks over 2 virtual
+    hosts (sizes differ for odd n — the interesting case)."""
+    return ["hostA" if i < (n + 1) // 2 else "hostB" for i in range(n)]
+
+
+@pytest.mark.parametrize("operand", NUMERIC, ids=lambda o: o.name)
+@pytest.mark.parametrize("op", OPS, ids=lambda o: o.name)
+@pytest.mark.parametrize("n", [3, 5])
+def test_allreduce_grid_shm_and_twolevel_match_tcp(operand, op, n):
+    rng = np.random.default_rng(hash((operand.name, op.name, n)) % 2**31)
+    base = exact_inputs(n, operand, rng)
+
+    def fn(slave, r):
+        arr = base[r].copy()
+        slave.allreduce_array(arr, operand, op)
+        return arr
+
+    tcp = run_grid(n, fn, shm=False)
+    flat_shm = run_grid(n, fn)
+    twolevel = run_grid(n, fn, fps=_virtual_hosts(n))
+    for r in range(n):
+        np.testing.assert_array_equal(flat_shm[r], tcp[r])
+        np.testing.assert_array_equal(twolevel[r], tcp[r])
+        np.testing.assert_array_equal(twolevel[r], tcp[0])
+
+
+@pytest.mark.parametrize("operand", [Operands.DOUBLE, Operands.INT],
+                         ids=lambda o: o.name)
+@pytest.mark.parametrize("n", [3, 5])
+def test_reduce_scatter_and_allgather_twolevel_match_tcp(operand, n):
+    rng = np.random.default_rng(5 + n)
+    base = exact_inputs(n, operand, rng)
+    ranges = partition_range(0, LENGTH, n)
+
+    def fn(slave, r):
+        rs = base[r].copy()
+        slave.reduce_scatter_array(rs, operand, Operators.SUM)
+        ag = np.zeros(LENGTH, operand.dtype)
+        s, e = ranges[slave.rank]
+        ag[s:e] = base[slave.rank][s:e]
+        slave.allgather_array(ag, operand, ranges=ranges)
+        return rs, ag
+
+    tcp = run_grid(n, fn, shm=False)
+    twolevel = run_grid(n, fn, fps=_virtual_hosts(n))
+    # reduce_scatter contract: OWN range reduced, other positions
+    # untouched — assert both, against the TCP reference
+    for r in range(n):
+        s, e = ranges[r]
+        np.testing.assert_array_equal(twolevel[r][0][s:e],
+                                      tcp[r][0][s:e])
+        np.testing.assert_array_equal(twolevel[r][0][:s],
+                                      base[r][:s])
+        np.testing.assert_array_equal(twolevel[r][0][e:],
+                                      base[r][e:])
+        np.testing.assert_array_equal(twolevel[r][1], tcp[r][1])
+
+
+@pytest.mark.parametrize("op", OPS, ids=lambda o: o.name)
+@pytest.mark.parametrize("n", [3, 5])
+def test_columnar_map_grid_shm_and_twolevel_match_tcp(op, n):
+    rng = np.random.default_rng(17 + n)
+    # overlapping + disjoint keys across ranks; exact small values
+    keys = [rng.choice(400, size=120, replace=False) for _ in range(n)]
+    vals = [rng.integers(1, 4, size=120) for _ in range(n)]
+
+    def fn(slave, r):
+        d = {int(k): np.float64(v)
+             for k, v in zip(keys[r], vals[r])}
+        slave.allreduce_map(d, Operands.DOUBLE, op)
+        return d
+
+    tcp = run_grid(n, fn, shm=False)
+    flat_shm = run_grid(n, fn)
+    twolevel = run_grid(n, fn, fps=_virtual_hosts(n))
+    for r in range(n):
+        assert flat_shm[r] == tcp[r]          # bit-exact, no tolerance
+        assert twolevel[r] == tcp[r]
+        assert twolevel[r] == twolevel[0]
+
+
+def test_twolevel_wire_split_attribution():
+    """Analytic attribution (ISSUE 7 satellite): on a virtual 2-host
+    topology every transport-tagged wire byte lands in exactly one of
+    wire_bytes_shm / wire_bytes_tcp, their sum equals the directional
+    totals, and BOTH planes moved bytes (intra-host vs inter-host)."""
+    n = 4
+
+    def fn(slave, r):
+        arr = np.ones(50_000, np.float64) * (r + 1)
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        return slave.stats()
+
+    snaps = run_grid(n, fn, fps=_virtual_hosts(n))
+    for snap in snaps:
+        sent = sum(e.get("bytes_sent", 0) for e in snap.values())
+        recv = sum(e.get("bytes_recv", 0) for e in snap.values())
+        shm_b = sum(e.get("wire_bytes_shm", 0) for e in snap.values())
+        tcp_b = sum(e.get("wire_bytes_tcp", 0) for e in snap.values())
+        # every byte of this workload rode a peer channel (tagged):
+        # the split must tile the totals exactly
+        assert shm_b + tcp_b == sent + recv
+        assert shm_b > 0
+    # the leaders' inter-host leg is TCP on at least the two leaders
+    assert sum(sum(e.get("wire_bytes_tcp", 0) for e in s.values())
+               for s in snaps) > 0
+
+
+def test_twolevel_nonnumeric_routes_to_safe_algo():
+    """Explicit algo='twolevel' with a non-numeric operand must route
+    to an object-capable schedule (allreduce/reduce_scatter: tree;
+    allgather: ring) instead of crashing the leaders' raw-plane leg —
+    regression for the review finding."""
+    n = 4
+
+    def fn(slave, r):
+        xs = [f"r{r}-{i}" for i in range(8)]
+        slave.allreduce_array(xs, Operands.STRING, Operators.SUM,
+                              algo="twolevel")
+        ag = [f"x{i}" if False else "" for i in range(8)]
+        ranges = partition_range(0, 8, n)
+        s, e = ranges[slave.rank]
+        for i in range(s, e):
+            ag[i] = f"own{slave.rank}-{i}"
+        slave.allgather_array(ag, Operands.STRING, ranges=ranges,
+                              algo="twolevel")
+        return xs, ag
+
+    out = run_grid(n, fn, fps=_virtual_hosts(n))
+    for r in range(n):
+        assert out[r][0] == out[0][0]       # allreduce agrees everywhere
+        assert out[r][1] == out[0][1]
+        for i, v in enumerate(out[r][1]):
+            assert v.startswith("own")      # every slot filled

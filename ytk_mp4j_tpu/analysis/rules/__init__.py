@@ -13,6 +13,7 @@
 | R9 | error    | pickled dict payload on a collective map path |
 | R10 | error   | peer-channel I/O bypassing the epoch fence |
 | R11 | error   | wall clock feeding duration/deadline arithmetic |
+| R12 | error   | transport construction outside transport/ (SPI) |
 """
 
 from __future__ import annotations
@@ -38,6 +39,8 @@ from ytk_mp4j_tpu.analysis.rules.r10_epoch_fence import (
     R10EpochFenceBypass)
 from ytk_mp4j_tpu.analysis.rules.r11_wall_clock import (
     R11WallClockDuration)
+from ytk_mp4j_tpu.analysis.rules.r12_transport_spi import (
+    R12TransportSpiBypass)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -51,6 +54,7 @@ ALL_RULES = [
     R9PickledMapPayload,
     R10EpochFenceBypass,
     R11WallClockDuration,
+    R12TransportSpiBypass,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

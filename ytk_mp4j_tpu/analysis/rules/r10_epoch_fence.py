@@ -38,7 +38,8 @@ _PEER_IO = frozenset({
 })
 
 # expressions that produce an UNFENCED channel
-_RAW_PRODUCERS = frozenset({"_channel", "Channel", "connect"})
+_RAW_PRODUCERS = frozenset({"_channel", "Channel", "TcpChannel",
+                            "ShmChannel", "connect"})
 
 
 def _producer(expr: ast.AST) -> str | None:

@@ -484,13 +484,29 @@ def main(argv=None) -> int:
     ap.add_argument("--length", type=int, default=97)
     args = ap.parse_args(argv)
 
-    import jax
-
     # CPU multi-process job: each process contributes --local-devices
     # virtual devices (the "multi-node without a cluster" pattern,
-    # SURVEY.md section 4)
+    # SURVEY.md section 4). The device-count config is version-gated:
+    # `jax_num_cpu_devices` only exists on newer jax; older versions
+    # (this image ships one without it) take the XLA flag instead —
+    # which must be in the environment BEFORE jax initializes any
+    # backend, hence the env check ahead of the import.
+    import os
+
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count="
+            f"{args.local_devices}").strip()
+
+    import jax
+
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", args.local_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", args.local_devices)
+    except AttributeError:
+        pass    # older jax: the XLA flag above already did the job
     # DOUBLE/LONG operands round-trip through the devices; without x64
     # they would be silently downcast (the backend raises instead)
     jax.config.update("jax_enable_x64", True)

@@ -39,6 +39,7 @@ from __future__ import annotations
 import argparse
 import http.server
 import json
+import secrets
 import socket
 import sys
 import threading
@@ -49,6 +50,7 @@ from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import postmortem as postmortem_mod
 from ytk_mp4j_tpu.obs import telemetry as telemetry_mod
 from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.transport.tcp import TcpChannel
 from ytk_mp4j_tpu.utils import stats as stats_mod
 from ytk_mp4j_tpu.utils import tuning
 
@@ -112,6 +114,13 @@ class Master:
         # MP4J_LOG_LEVEL fails the job here, not silently mid-run)
         self._min_level = tuning.LOG_LEVELS[tuning.log_level()]
         self._rank_width = max(1, len(str(max(slave_num - 1, 0))))
+        # job id (ISSUE 7): rides the rendezvous reply and namespaces
+        # every shm segment this job's peer pairs create, so two jobs
+        # on one host can never collide on a segment name
+        self.job_id = secrets.token_hex(4)
+        # rendezvous listen socket — sanctioned raw-socket site: the
+        # master IS the control plane the transport SPI is negotiated
+        # over (mp4j-lint R12 baseline)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host or "0.0.0.0", port))
@@ -255,7 +264,9 @@ class Master:
                 sock, addr = self._server.accept()
             except socket.timeout:
                 continue
-            ch = Channel(sock)
+            # sanctioned channel-construction site: rendezvous wraps
+            # the just-accepted control connection (R12 baseline)
+            ch = TcpChannel(sock)
             # bound the registration handshake: a stray connection that
             # never sends must neither hang rendezvous (no timeout) nor
             # consume the whole budget while real slaves queue behind it
@@ -273,16 +284,21 @@ class Master:
                 ok = kind == REGISTER and isinstance(payload, dict)
                 listen_port = int(payload["listen_port"]) if ok else 0
                 host = str(payload.get("host") or addr[0]) if ok else ""
+                # host fingerprint (ISSUE 7): opaque token two slaves
+                # share iff they can attach each other's shm segments;
+                # "" means the slave opted out (MP4J_SHM=0)
+                fp = str(payload.get("fp") or "") if ok else ""
             except Exception:
                 ok = False
             if not ok:
                 ch.close()
                 continue
             ch.set_timeout(None)  # control plane is fail-stop from here
-            pending.append((ch, (host, listen_port)))
+            pending.append((ch, (host, listen_port, fp)))
         roster = [hp for _, hp in pending]
         for rank, (ch, _) in enumerate(pending):
-            ch.send_obj({"rank": rank, "roster": roster})
+            ch.send_obj({"rank": rank, "roster": roster,
+                         "job": self.job_id})
             self._channels.append(ch)
             self._send_locks.append(threading.Lock())
 

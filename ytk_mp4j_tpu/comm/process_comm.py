@@ -54,8 +54,10 @@ from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.resilience import faults as faults_mod
 from ytk_mp4j_tpu.resilience.recovery import RecoveryManager
-from ytk_mp4j_tpu.transport import channel as channel_mod
-from ytk_mp4j_tpu.transport.channel import Channel, connect
+from ytk_mp4j_tpu.transport import shm as shm_mod
+from ytk_mp4j_tpu.transport import tcp as tcp_mod
+from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.transport.tcp import connect
 from ytk_mp4j_tpu.utils import native, trace, tuning
 from ytk_mp4j_tpu.utils import stats as stats_mod
 from ytk_mp4j_tpu.utils.stats import CommStats
@@ -125,6 +127,8 @@ class ProcessCommSlave(CommSlave):
                  peer_timeout: float | None = None,
                  handshake_timeout: float | None = 30.0,
                  native_transport: bool = True,
+                 shm: bool | None = None,
+                 host_fp: str | None = None,
                  map_columnar: bool | None = None,
                  max_retries: int | None = None,
                  reconnect_backoff: float | None = None,
@@ -146,6 +150,20 @@ class ProcessCommSlave(CommSlave):
         match on both ends of every exchange). False keeps the fully
         framed Python path — the frozen reference baseline bench.py
         measures against.
+
+        ``shm`` (None reads ``MP4J_SHM``, default on) lets rendezvous
+        negotiate the intra-host shared-memory transport (ISSUE 7): a
+        dialing slave whose host fingerprint matches the peer's roster
+        entry creates a shm ring pair and names it in the peer
+        handshake; every other pair keeps TCP. JOB-wide like
+        ``native_transport`` — every slave must agree on whether shm
+        may be offered (the per-pair decision then rides the
+        handshake, so both ends of one channel always agree).
+        ``host_fp`` overrides the detected host fingerprint (testing +
+        ops seam: partition co-located ranks into virtual hosts, or
+        pin two cells apart); ranks only pair over shm — and the
+        topology-aware two-level schedule only groups them — when
+        their fingerprints are EQUAL and non-empty.
 
         ``map_columnar`` selects the map-collective wire plane for
         numeric operands (None reads ``MP4J_MAP_COLUMNAR``, default
@@ -201,6 +219,17 @@ class ProcessCommSlave(CommSlave):
         # thread starts: an early peer dial-in races __init__
         self._chunk_bytes = tuning.chunk_bytes()
         self._algo_small, self._algo_large = tuning.algo_thresholds()
+        self._shm = tuning.shm_enabled() if shm is None else bool(shm)
+        self._shm_ring_bytes = tuning.shm_ring_bytes()
+        # host fingerprint (ISSUE 7): rides registration into the
+        # roster; "" (shm off) makes this rank fingerprint-match
+        # nobody, so every pair it joins keeps TCP
+        if not self._shm:
+            self._fp = ""
+        elif host_fp is not None:
+            self._fp = str(host_fp)
+        else:
+            self._fp = shm_mod.host_fingerprint()
         self._map_columnar = (tuning.map_columnar_enabled()
                               if map_columnar is None
                               else bool(map_columnar))
@@ -213,9 +242,12 @@ class ProcessCommSlave(CommSlave):
         # own listen socket on an ephemeral port. Buffer-size knobs
         # apply BEFORE listen(): accepted peer sockets inherit them,
         # and the TCP window scale is fixed at the handshake.
+        # sanctioned raw-socket site: the slave's own listen socket IS
+        # the rendezvous surface peers negotiate transports over
+        # (mp4j-lint R12 baseline)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        channel_mod.apply_socket_buf_sizes(self._server)
+        tcp_mod.apply_socket_buf_sizes(self._server)
         self._server.bind((listen_host, 0))
         self._server.listen(64)
         self._listen_port = self._server.getsockname()[1]
@@ -227,11 +259,23 @@ class ProcessCommSlave(CommSlave):
         self._master = connect(master_host, master_port, timeout=timeout)
         self._master.set_timeout(timeout)
         self._master.send_obj((master_mod.REGISTER, {
-            "listen_port": self._listen_port, "host": listen_host}))
+            "listen_port": self._listen_port, "host": listen_host,
+            "fp": self._fp}))
         reply = self._master.recv()
         self._rank = reply["rank"]
         self._roster = reply["roster"]
         self._n = len(self._roster)
+        # job id namespaces this job's shm segment names
+        self._job_id = str(reply.get("job") or "0")
+        # topology (ISSUE 7): group ranks by roster host fingerprint —
+        # a pure function of the shared roster, so every rank derives
+        # the identical grouping (R1/R8 discipline). Fingerprint-less
+        # ranks are singleton hosts (they can never ride shm).
+        self._host_groups = self._derive_host_groups(self._roster)
+        self._members = next(g for g in self._host_groups
+                             if self._rank in g)
+        self._leader = self._members[0]
+        self._leaders = [g[0] for g in self._host_groups]
         # after rendezvous the master channel is fail-stop (barrier
         # waits are unbounded by design, see barrier())
         self._master.set_timeout(None)
@@ -613,6 +657,25 @@ class ProcessCommSlave(CommSlave):
     # ------------------------------------------------------------------
     # peer transport
     # ------------------------------------------------------------------
+    @staticmethod
+    def _derive_host_groups(roster) -> list[list[int]]:
+        """Rank groups sharing a host fingerprint, ordered by first
+        appearance; each group ascending (so ``group[0]`` — the host
+        LEADER — is the smallest rank on that host). Fingerprint-less
+        entries (shm opted out, or an old-style 2-tuple roster) become
+        singleton groups. Pure function of the shared roster."""
+        groups: dict[str, list[int]] = {}
+        singles: list[list[int]] = []
+        for rank, entry in enumerate(roster):
+            fp = entry[2] if len(entry) > 2 else ""
+            if fp:
+                groups.setdefault(fp, []).append(rank)
+            else:
+                singles.append([rank])
+        out = list(groups.values()) + singles
+        out.sort(key=lambda g: g[0])
+        return out
+
     def _accept_loop(self):
         while True:
             try:
@@ -620,18 +683,36 @@ class ProcessCommSlave(CommSlave):
             except OSError:
                 return  # server closed
             try:
-                ch = Channel(sock)
+                # sanctioned channel-construction site: the inbound
+                # peer handshake must be read over SOME transport
+                # before the pair's negotiated transport exists (R12
+                # baseline, like the rendezvous sites)
+                ch = tcp_mod.TcpChannel(sock)
                 # bound the rank exchange: a stray connection that never
                 # sends must not wedge the accept loop every healthy
                 # peer depends on. The handshake carries (rank, epoch)
                 # — the dialer pins the channel's job-wide epoch here,
-                # the frame-level half of the epoch fence.
+                # the frame-level half of the epoch fence — plus, for a
+                # same-host pair, the shm segment name + ring size the
+                # dialer created (ISSUE 7 transport negotiation).
                 ch.set_timeout(self._handshake_timeout)
                 # sanctioned pre-fence receive: the handshake decides
                 # which epoch the channel BELONGS to, so the fence
                 # cannot apply yet (mp4j-lint R10 baseline)
                 hs = ch.recv()
-                peer_rank, peer_epoch = hs
+                if len(hs) == 2:
+                    peer_rank, peer_epoch = hs
+                    seg_token, ring_bytes = None, 0
+                else:
+                    peer_rank, peer_epoch, seg_token, ring_bytes = hs
+                    tok_ok = (isinstance(seg_token, tuple)
+                              and len(seg_token) >= 2
+                              and seg_token[0] in ("memfd", "shm"))
+                    if not (tok_ok and isinstance(ring_bytes, int)
+                            and not isinstance(ring_bytes, bool)
+                            and ring_bytes >= 4096):
+                        raise TypeError(
+                            f"malformed shm handshake {hs!r}")
                 # strict integer types, no coercion: int('2')/int(2.7)
                 # would let a stray dial-in claim a healthy rank's
                 # peer slot (bool is an int subclass — reject it too)
@@ -640,6 +721,26 @@ class ProcessCommSlave(CommSlave):
                         or isinstance(peer_epoch, bool)
                         or not isinstance(peer_epoch, int)):
                     raise TypeError(f"malformed peer handshake {hs!r}")
+                if seg_token is not None:
+                    # only a fingerprint-matched peer may offer a shm
+                    # segment (a stray dial-in must not make us mmap
+                    # arbitrary names/fds); attach and upgrade the
+                    # channel — the TCP socket stays as the carrier
+                    entry = (self._roster[peer_rank]
+                             if 0 <= peer_rank < self._n else ())
+                    # gate on the REGISTERED fingerprint, not the live
+                    # _shm flag: a rank that fell back to TCP after a
+                    # local segment-creation failure must still honor
+                    # inbound offers (attaching costs no creation
+                    # resources), or the offering dialer would loop
+                    # against its rejections forever
+                    if not (self._fp and len(entry) > 2
+                            and entry[2] == self._fp):
+                        raise TypeError(
+                            f"unsolicited shm offer from {peer_rank}")
+                    seg = shm_mod.attach_segment(seg_token)
+                    ch = shm_mod.ShmChannel(sock, seg, ring_bytes,
+                                            owner=False)
             except Exception:
                 # a peer (or stray connection) died mid-handshake; the
                 # accept loop must survive to serve the healthy peers
@@ -753,6 +854,15 @@ class ProcessCommSlave(CommSlave):
         raise Mp4jTransportError(
             f"peer {peer} never re-dialed after recovery")
 
+    def _shm_peer(self, peer: int) -> bool:
+        """Whether the (self, peer) pair negotiates shm: equal,
+        non-empty host fingerprints in the shared roster — a pure
+        function of job-wide state, so both ends agree before any
+        byte moves."""
+        entry = self._roster[peer]
+        return bool(self._shm and self._fp and len(entry) > 2
+                    and entry[2] == self._fp)
+
     def _dial(self, peer: int) -> Channel:
         """Dial a lower rank's listen socket with capped exponential
         backoff (``MP4J_RECONNECT_BACKOFF``): after an abort round the
@@ -760,8 +870,11 @@ class ProcessCommSlave(CommSlave):
         a refused/reset connect. Runs WITHOUT the peer cv (see
         _channel); the fence poll each iteration keeps the loop
         abort-aware. The channel's epoch is pinned HERE and rides the
-        handshake."""
-        host, port = self._roster[peer]
+        handshake — and for a same-host pair the dialer CREATES the
+        shm segment and names it in the same handshake (ISSUE 7), so
+        transport negotiation adds zero round trips."""
+        host, port = self._roster[peer][0], self._roster[peer][1]
+        use_shm = self._shm_peer(peer)
         deadline = (None if self._timeout is None
                     else time.monotonic() + self._timeout)
         backoff = max(self._reconnect_backoff, 0.001)
@@ -769,14 +882,50 @@ class ProcessCommSlave(CommSlave):
             self._recovery.poll()
             epoch = self._recovery.epoch
             ch = None
+            seg = None
             try:
                 ch = connect(host, port, timeout=self._timeout)
                 # sanctioned pre-fence send: the handshake pins the
                 # epoch the fence will enforce (mp4j-lint R10 baseline)
-                ch.send_obj((self._rank, epoch))
+                if use_shm:
+                    lo, hi = min(self._rank, peer), max(self._rank, peer)
+                    name = shm_mod.segment_name(self._job_id, lo, hi,
+                                                epoch)
+                    try:
+                        seg = shm_mod.create_segment(
+                            name, self._shm_ring_bytes)
+                    except OSError as e:
+                        # a LOCAL resource failure (fd limit, /dev/shm
+                        # full on the fallback backing) would otherwise
+                        # ride the backoff loop forever against a
+                        # healthy peer — the accepter still takes the
+                        # plain 2-tuple handshake, so stop offering shm
+                        # and keep the job alive on TCP
+                        self._shm = False
+                        use_shm = False
+                        try:
+                            self.error(
+                                f"shm segment creation failed ({e}); "
+                                "this rank falls back to TCP for all "
+                                "pairs")
+                        except (Mp4jError, OSError):
+                            pass   # pre-rendezvous error() cannot send
+                if use_shm:
+                    ch.send_obj((self._rank, epoch, seg.token,
+                                 self._shm_ring_bytes))
+                    ch = shm_mod.ShmChannel(ch.sock, seg,
+                                            self._shm_ring_bytes,
+                                            owner=True)
+                else:
+                    ch.send_obj((self._rank, epoch))
                 ch.epoch = epoch
                 return ch
             except (Mp4jTransportError, OSError):
+                if seg is not None and not isinstance(ch,
+                                                      shm_mod.ShmChannel):
+                    # created but never wrapped: free the segment here
+                    # (once wrapped, ch.close() below owns it)
+                    seg.close()
                 # OSError included: the remote can accept the TCP
                 # connection and tear it down before our handshake
                 # send lands (exactly the post-abort window this
@@ -869,20 +1018,56 @@ class ProcessCommSlave(CommSlave):
             ([f"send->{send_peer}"] if sarr is not None else [])
             + ([f"recv<-{recv_peer}"] if rarr is not None else []))
         t0 = time.perf_counter()
+        # the native C++ poll loop needs real socket fds on BOTH legs;
+        # a shm leg (native_fd() is None) routes the whole exchange
+        # through the Python raw primitives — the ring copy IS the
+        # fast path there, and the wire bytes are identical either way
+        # (the raw/framed decision stays the job-wide _raw_ok rule;
+        # native-vs-python within raw is per-exchange local, exactly
+        # like the pre-SPI fallback on hosts without the C++ build)
+        fd_s = (send_ch or recv_ch).native_fd()
+        fd_r = (recv_ch or send_ch).native_fd()
+        both_shm = (isinstance(send_ch or recv_ch, shm_mod.ShmChannel)
+                    and isinstance(recv_ch or send_ch,
+                                   shm_mod.ShmChannel))
+        if both_shm:
+            # hybrid routing (transport/shm.py): per DIRECTION, bytes
+            # ride the ring iff the transfer clears _RING_MIN — a pure
+            # function of the segment size both ends share. When BOTH
+            # directions are carrier-bound, the exchange is exactly a
+            # socket exchange, so hand the carrier fds to the same
+            # native poll loop TCP uses (kernel wakeups; wire bytes
+            # identical to the shm carrier path)
+            s_small = sarr is None or sarr.nbytes < shm_mod._RING_MIN
+            r_small = rarr is None or rarr.nbytes < shm_mod._RING_MIN
+            if s_small and r_small:
+                both_shm = False
+                fd_s = (send_ch or recv_ch).sock.fileno()
+                fd_r = (recv_ch or send_ch).sock.fileno()
         try:
-            done = native.sendrecv_raw(
-                (send_ch or recv_ch).sock.fileno(),
-                (recv_ch or send_ch).sock.fileno(),
-                sarr, rarr, self._peer_timeout)
-            if not done:
-                # pure-Python fallback: helper thread sends while we
-                # receive
-                fut = (self._pool.submit(send_ch.send_raw, sarr)
-                       if sarr is not None else None)
-                if rarr is not None:
-                    recv_ch.recv_raw_into(rarr)
-                if fut is not None:
-                    fut.result()
+            if both_shm:
+                # single-threaded cooperative duplex — the ring
+                # analogue of the native poll loop (a helper-thread
+                # send would ping-pong the GIL around user-space
+                # memcpys and pay a pool-future handoff per chunk)
+                shm_mod.duplex_exchange(send_ch, sarr, recv_ch, rarr)
+            else:
+                done = False
+                if fd_s is not None and fd_r is not None:
+                    done = native.sendrecv_raw(fd_s, fd_r, sarr, rarr,
+                                               self._peer_timeout)
+                if not done:
+                    # pure-Python fallback (no native build, or a
+                    # MIXED shm+tcp step): helper thread sends while
+                    # we receive — sockets park in the kernel, so a
+                    # second thread is what keeps both directions
+                    # moving
+                    fut = (self._pool.submit(send_ch.send_raw, sarr)
+                           if sarr is not None else None)
+                    if rarr is not None:
+                        recv_ch.recv_raw_into(rarr)
+                    if fut is not None:
+                        fut.result()
         except Exception as e:
             # also catches the fallback's raw socket errors (BrokenPipe,
             # socket.timeout from the helper-thread send) so the "dead
@@ -890,11 +1075,25 @@ class ProcessCommSlave(CommSlave):
             # typed TRANSPORT so the recovery engine may retry it
             raise Mp4jTransportError(
                 f"raw exchange ({sides}) failed: {e}") from None
-        self._comm_stats.add_wire(
-            0 if sarr is None else sarr.nbytes,
-            0 if rarr is None else rarr.nbytes,
-            time.perf_counter() - t0, chunks=1,
-            peer=recv_peer if rarr is not None else send_peer)
+        dt = time.perf_counter() - t0
+        sbytes = 0 if sarr is None else sarr.nbytes
+        rbytes = 0 if rarr is None else rarr.nbytes
+        if (send_ch is not None and recv_ch is not None
+                and send_ch.transport != recv_ch.transport):
+            # a mixed-transport full-duplex step (e.g. a ring rank
+            # with one shm and one TCP neighbor): book each direction
+            # on the plane it actually rode
+            self._comm_stats.add_wire(sbytes, 0, dt, chunks=1,
+                                      peer=send_peer,
+                                      transport=send_ch.transport)
+            self._comm_stats.add_wire(0, rbytes, 0.0, chunks=0,
+                                      peer=recv_peer,
+                                      transport=recv_ch.transport)
+        else:
+            self._comm_stats.add_wire(
+                sbytes, rbytes, dt, chunks=1,
+                peer=recv_peer if rarr is not None else send_peer,
+                transport=(recv_ch or send_ch).transport)
 
     def _recv_buf(self, operand: Operand, n: int) -> np.ndarray:
         """A pooled scratch buffer (give back via ``_give_buf`` after
@@ -1107,8 +1306,16 @@ class ProcessCommSlave(CommSlave):
         segment, which is only equivalent for commutative operators;
         list reductions (e.g. concatenation) deserve deterministic rank
         order and are latency- not bandwidth-bound anyway.
+
+        ``algo="twolevel"`` (ISSUE 7; what ``"auto"`` picks whenever
+        the roster spans multiple hosts with co-located ranks): the
+        classic topology-aware schedule — binomial reduce to each
+        host's leader over the intra-host (shm) pairs, recursive
+        halving/doubling among the leaders over TCP, binomial
+        broadcast back out — so the inter-host wire carries each byte
+        once per HOST instead of once per RANK.
         """
-        if algo not in ("auto", "rhd", "ring", "tree"):
+        if algo not in ("auto", "rhd", "ring", "tree", "twolevel"):
             raise Mp4jError(f"unknown allreduce algo {algo!r}")
         arr, lo, hi = self._norm_range(arr, operand, from_, to)
         if self._n == 1 or hi == lo:
@@ -1116,9 +1323,15 @@ class ProcessCommSlave(CommSlave):
         if not operand.is_numeric:
             algo = "tree"
         elif algo == "auto":
-            algo = tuning.select_allreduce_algo(
-                (hi - lo) * operand.dtype.itemsize, self._n,
-                self._algo_small, self._algo_large)
+            if self._use_twolevel():
+                algo = "twolevel"
+            else:
+                algo = tuning.select_allreduce_algo(
+                    (hi - lo) * operand.dtype.itemsize, self._n,
+                    self._algo_small, self._algo_large)
+        if algo == "twolevel":
+            return self._twolevel_allreduce(arr, operand, operator,
+                                            lo, hi)
         if algo == "tree":
             self.reduce_array(arr, operand, operator, root=0,
                               from_=from_, to=to)
@@ -1132,7 +1345,8 @@ class ProcessCommSlave(CommSlave):
         return arr
 
     # -- recursive halving/doubling (Rabenseifner), SURVEY.md 3b --------
-    def _rhd_allreduce(self, arr, operand, operator, lo, hi):
+    def _rhd_allreduce(self, arr, operand, operator, lo, hi,
+                       group=None):
         """MPICH-style allreduce: fold extra ranks into the largest
         power-of-2 group, reduce-scatter by recursive halving, allgather
         by recursive doubling, unfold.
@@ -1147,8 +1361,17 @@ class ProcessCommSlave(CommSlave):
           participant holds the full reduced range.
         - unfold: participants send the finished range back to their
           folded partner.
+
+        ``group`` (a sorted rank subset containing this rank) runs the
+        SAME schedule among just those ranks — the two-level engine's
+        inter-host leg (ISSUE 7: one leader per host).
         """
-        n, r = self._n, self._rank
+        if group is None:
+            n, r = self._n, self._rank
+            gmap = range(n)
+        else:
+            n, r = len(group), group.index(self._rank)
+            gmap = group
         raw = self._raw_ok(operand)
         p = 1
         while p * 2 <= n:
@@ -1156,17 +1379,18 @@ class ProcessCommSlave(CommSlave):
         extra = n - p
 
         if r >= p:  # folded rank: contribute, then wait for the result
+            fold = gmap[r - p]
             if raw:
-                self._exchange_raw(r - p, r - p, arr[lo:hi], None)
-                self._exchange_raw_into(r - p, r - p, None, arr[lo:hi],
+                self._exchange_raw(fold, fold, arr[lo:hi], None)
+                self._exchange_raw_into(fold, fold, None, arr[lo:hi],
                                         operand)
             else:
-                self._send(r - p, np.ascontiguousarray(arr[lo:hi]),
+                self._send(fold, np.ascontiguousarray(arr[lo:hi]),
                            compress=operand.compress)
-                self._recv_segment_into(r - p, arr, lo, hi, operand)
+                self._recv_segment_into(fold, arr, lo, hi, operand)
             return arr
         if r < extra:  # fold partner: merge the extra rank's data
-            self._recv_reduce(r + p, arr[lo:hi], operator, operand)
+            self._recv_reduce(gmap[r + p], arr[lo:hi], operator, operand)
 
         vr = r
         segs = meta.partition_range(lo, hi, p)
@@ -1177,7 +1401,7 @@ class ProcessCommSlave(CommSlave):
         # reduce-scatter: recursive halving (pipelined chunked merge)
         dist = p >> 1
         while dist >= 1:
-            partner = vr ^ dist
+            partner = gmap[vr ^ dist]
             block0 = (vr // (2 * dist)) * (2 * dist)
             if vr & dist:
                 keep = (block0 + dist, block0 + 2 * dist)
@@ -1195,9 +1419,10 @@ class ProcessCommSlave(CommSlave):
         # exchange is already full-duplex and lands in place)
         dist = 1
         while dist < p:
-            partner = vr ^ dist
+            pv = vr ^ dist
+            partner = gmap[pv]
             mb0 = (vr // dist) * dist
-            tb0 = (partner // dist) * dist
+            tb0 = (pv // dist) * dist
             ms, me = span(mb0, mb0 + dist)
             ts, te = span(tb0, tb0 + dist)
             if raw:
@@ -1213,10 +1438,152 @@ class ProcessCommSlave(CommSlave):
 
         if r < extra:  # unfold: ship the finished range back
             if raw:
-                self._exchange_raw(r + p, r + p, arr[lo:hi], None)
+                self._exchange_raw(gmap[r + p], gmap[r + p], arr[lo:hi],
+                                   None)
             else:
-                self._send(r + p, np.ascontiguousarray(arr[lo:hi]),
+                self._send(gmap[r + p], np.ascontiguousarray(arr[lo:hi]),
                            compress=operand.compress)
+        return arr
+
+    # -- topology-aware two-level collectives (ISSUE 7) -----------------
+    # Group ranks by roster host fingerprint; run the intra-host legs
+    # over the (shm) member pairs and ONE inter-host leg per host
+    # leader over TCP. Every schedule below is a pure function of the
+    # shared roster + call parameters (R1/R8 discipline). Numeric
+    # operands only — the callers route non-numeric operands to the
+    # rank-ordered tree before ever selecting these.
+    def _use_twolevel(self) -> bool:
+        return tuning.select_twolevel(
+            [len(g) for g in self._host_groups])
+
+    def _group_tree_reduce(self, acc, group, operand, operator) -> None:
+        """Binomial reduce of ``acc`` toward ``group[0]`` (the host
+        leader), merging IN PLACE into ``acc`` — callers pass either a
+        buffer that will be overwritten anyway (allreduce) or an
+        explicit scratch copy (reduce_scatter). One more client of THE
+        shared binomial walk (see the map-plane comment): the merge
+        mutates ``acc``, so the threaded value is just ``acc``
+        itself."""
+        self._tree_reduce_walk(
+            acc, group[0],
+            lambda peer, a: self._send_segment(peer, a, operand),
+            lambda peer, a: (self._recv_reduce(peer, a, operator,
+                                               operand), a)[1],
+            group=group)
+
+    def _group_tree_bcast(self, arr, lo, hi, group, operand) -> None:
+        """Binomial broadcast of ``group[0]``'s ``arr[lo:hi]`` to the
+        group, received in place (the walk's threaded value is unused
+        — receives land directly in ``arr[lo:hi]``)."""
+        def recv(peer):
+            self._recv_segment_into(peer, arr, lo, hi, operand)
+
+        self._tree_bcast_walk(
+            None, group[0],
+            lambda peer, _: self._send_segment(peer, arr[lo:hi],
+                                               operand),
+            recv, group=group)
+
+    def _twolevel_allreduce(self, arr, operand, operator, lo, hi):
+        """Intra-host reduce -> leaders' inter-host allreduce (RHD) ->
+        intra-host broadcast. All three legs land in ``arr[lo:hi]``
+        directly: allreduce overwrites the whole range on every rank,
+        so no scratch copy is needed anywhere."""
+        members, leaders = self._members, self._leaders
+        if len(members) > 1:
+            self._group_tree_reduce(arr[lo:hi], members, operand,
+                                    operator)
+        if self._rank == self._leader and len(leaders) > 1:
+            self._rhd_allreduce(arr, operand, operator, lo, hi,
+                                group=leaders)
+        if len(members) > 1:
+            self._group_tree_bcast(arr, lo, hi, members, operand)
+        return arr
+
+    def _twolevel_reduce_scatter(self, arr, ranges, operand, operator):
+        """Intra-host reduce of the full span into a pooled scratch
+        accumulator (the caller's positions outside each rank's owned
+        range must stay untouched), leaders' inter-host allreduce,
+        then the leader hands every member exactly its range. The
+        scratch copy mirrors the tree path's reduce_array acc copy —
+        same budget, but the heavy legs ride shm."""
+        members, leaders = self._members, self._leaders
+        acc = self._recv_buf(operand, len(arr))
+        try:
+            np.copyto(acc, arr)
+            if len(members) > 1:
+                self._group_tree_reduce(acc, members, operand, operator)
+            if self._rank == self._leader and len(leaders) > 1:
+                self._rhd_allreduce(acc, operand, operator, 0, len(acc),
+                                    group=leaders)
+            if self._rank == self._leader:
+                for m in members:
+                    s, e = ranges[m]
+                    if m == self._rank:
+                        arr[s:e] = acc[s:e]
+                    else:
+                        self._send_segment(m, acc[s:e], operand)
+            else:
+                s, e = ranges[self._rank]
+                self._recv_segment_into(self._leader, arr, s, e,
+                                        operand)
+        finally:
+            self._give_buf(acc)
+        return arr
+
+    def _twolevel_allgather(self, arr, ranges, operand):
+        """Intra-host gather to the leader, ring over HOST BLOCKS among
+        the leaders (step s ships host block (h-s) right while host
+        block (h-1-s) arrives from the left — each member range is one
+        transfer, so the inter-host wire carries every byte exactly
+        once per host), then intra-host broadcast of the tiled span.
+        Caller guarantees the ranges tile contiguously (the same
+        precondition the tree path enforces)."""
+        members, leaders = self._members, self._leaders
+        groups = self._host_groups
+        if len(members) > 1:
+            if self._rank == self._leader:
+                for m in members:
+                    if m != self._rank:
+                        s, e = ranges[m]
+                        self._recv_segment_into(m, arr, s, e, operand)
+            else:
+                s, e = ranges[self._rank]
+                self._send_segment(self._leader, arr[s:e], operand)
+        if self._rank == self._leader and len(leaders) > 1:
+            raw = (self._raw_ok(operand)
+                   and isinstance(arr, np.ndarray))
+            H = len(leaders)
+            h = leaders.index(self._rank)
+            right, left = leaders[(h + 1) % H], leaders[(h - 1) % H]
+            for step in range(H - 1):
+                sblock = groups[(h - step) % H]
+                rblock = groups[(h - 1 - step) % H]
+                for i in range(max(len(sblock), len(rblock))):
+                    sseg = ranges[sblock[i]] if i < len(sblock) else None
+                    rseg = ranges[rblock[i]] if i < len(rblock) else None
+                    sarr = (arr[sseg[0]:sseg[1]] if sseg is not None
+                            else None)
+                    if raw:
+                        if rseg is not None:
+                            self._exchange_raw_into(
+                                right, left, sarr,
+                                arr[rseg[0]:rseg[1]], operand)
+                        elif sarr is not None:
+                            self._exchange_raw(right, left, sarr, None)
+                    else:
+                        fut = (self._submit_send(
+                            right, np.ascontiguousarray(sarr),
+                            operand.compress)
+                            if sarr is not None else None)
+                        if rseg is not None:
+                            self._recv_segment_into(left, arr, rseg[0],
+                                                    rseg[1], operand)
+                        if fut is not None:
+                            fut.result()
+        if len(members) > 1:
+            lo, hi, _ = self._ranges_span(ranges)
+            self._group_tree_bcast(arr, lo, hi, members, operand)
         return arr
 
     @staticmethod
@@ -1236,10 +1603,14 @@ class ProcessCommSlave(CommSlave):
 
         ``algo="auto"`` (default): rank-ordered binomial tree
         (reduce + scatter) below the latency threshold, pipelined ring
-        otherwise — the same job-wide size rule as allreduce. ``"ring"``
-        / ``"tree"`` force a path; non-numeric operands always take the
-        tree (deterministic rank order, see allreduce_array)."""
-        if algo not in ("auto", "ring", "tree"):
+        otherwise — the same job-wide size rule as allreduce; on a
+        multi-host roster with co-located ranks it picks the two-level
+        schedule instead (``"twolevel"``: intra-host reduce over shm,
+        leaders' inter-host allreduce, leader scatters each member its
+        range — ISSUE 7). ``"ring"`` / ``"tree"`` / ``"twolevel"``
+        force a path; non-numeric operands always take the tree
+        (deterministic rank order, see allreduce_array)."""
+        if algo not in ("auto", "ring", "tree", "twolevel"):
             raise Mp4jError(f"unknown reduce_scatter algo {algo!r}")
         arr, lo, hi = self._norm_range(arr, operand, 0, None)
         if ranges is None:
@@ -1249,9 +1620,15 @@ class ProcessCommSlave(CommSlave):
         if not operand.is_numeric:
             algo = "tree"
         elif algo == "auto":
-            algo = tuning.select_partitioned_algo(
-                len(arr) * operand.dtype.itemsize, self._n,
-                self._algo_small, self._algo_large)
+            if self._use_twolevel():
+                algo = "twolevel"
+            else:
+                algo = tuning.select_partitioned_algo(
+                    len(arr) * operand.dtype.itemsize, self._n,
+                    self._algo_small, self._algo_large)
+        if algo == "twolevel":
+            return self._twolevel_reduce_scatter(arr, ranges, operand,
+                                                 operator)
         if algo == "tree":
             # rank-ordered tree + scatter (see allreduce_array). Rank
             # 0's buffer is the tree root, so its positions OUTSIDE its
@@ -1277,10 +1654,14 @@ class ProcessCommSlave(CommSlave):
 
         ``algo="auto"`` (default): rooted binomial tree
         (gather + broadcast) below the latency threshold when the
-        ranges tile a contiguous span, pipelined ring otherwise.
-        ``"tree"`` requires contiguous ranges (the broadcast covers the
-        tiled span exactly); ``"ring"`` accepts any ranges."""
-        if algo not in ("auto", "ring", "tree"):
+        ranges tile a contiguous span, pipelined ring otherwise; on a
+        multi-host roster with co-located ranks (and contiguous
+        ranges) it picks ``"twolevel"`` — intra-host gather over shm,
+        a leaders' ring over whole HOST blocks, intra-host broadcast
+        (ISSUE 7). ``"tree"``/``"twolevel"`` require contiguous ranges
+        (their broadcast covers the tiled span exactly); ``"ring"``
+        accepts any ranges."""
+        if algo not in ("auto", "ring", "tree", "twolevel"):
             raise Mp4jError(f"unknown allgather algo {algo!r}")
         arr, _, _ = self._norm_range(arr, operand, 0, None)
         if ranges is None:
@@ -1291,10 +1672,21 @@ class ProcessCommSlave(CommSlave):
         if algo == "auto":
             if not contiguous or not operand.is_numeric:
                 algo = "ring"
+            elif self._use_twolevel():
+                algo = "twolevel"
             else:
                 algo = tuning.select_partitioned_algo(
                     (hi - lo) * operand.dtype.itemsize, self._n,
                     self._algo_small, self._algo_large)
+        if algo == "twolevel" and not operand.is_numeric:
+            # the two-level engine is numeric-only (it rides the raw
+            # segment plane); the ring handles object operands
+            algo = "ring"
+        if algo == "twolevel":
+            if not contiguous:
+                raise Mp4jError(
+                    "allgather algo='twolevel' needs contiguous ranges")
+            return self._twolevel_allgather(arr, ranges, operand)
         if algo == "tree":
             if not contiguous:
                 raise Mp4jError(
@@ -1543,38 +1935,58 @@ class ProcessCommSlave(CommSlave):
     # ONE copy of each walk, parameterized by the per-plane send/recv
     # callables: a protocol tweak (rank math, timeouts) lands on every
     # plane at once instead of needing six synchronized edits.
-    def _tree_reduce_walk(self, value, root: int, send, recv_merge):
+    def _walk_coords(self, root: int, group) -> tuple[int, int, list]:
+        """(n, vr, rankmap) for a binomial walk over ``group`` (None =
+        all ranks): ``vr`` is this rank's virtual index relative to
+        ``root``; ``rankmap[v]`` the global rank at virtual index v.
+        Group walks are the two-level engine's substrate (ISSUE 7):
+        the SAME walk code serves the whole job, one host's members,
+        or the host-leader set — the mapping is the only difference."""
+        if group is None:
+            n = self._n
+            vr = (self._rank - root) % n
+            return n, vr, [(v + root) % n for v in range(n)]
+        n = len(group)
+        ri = group.index(root)
+        vr = (group.index(self._rank) - ri) % n
+        return n, vr, [group[(v + ri) % n] for v in range(n)]
+
+    def _tree_reduce_walk(self, value, root: int, send, recv_merge,
+                          group=None):
         """Up-sweep: ``value`` merges toward ``root``. ``send(peer,
         value)`` ships this rank's merged value to its parent;
         ``recv_merge(peer, value) -> value`` receives a child's
         contribution and merges it in. Returns the full merge at
-        ``root`` (a partial merge elsewhere)."""
-        vr = (self._rank - root) % self._n
+        ``root`` (a partial merge elsewhere). ``group`` restricts the
+        walk to a rank subset (this rank and ``root`` must belong)."""
+        n, vr, rankmap = self._walk_coords(root, group)
         mask = 1
-        while mask < self._n:
+        while mask < n:
             if vr & mask:
-                send(((vr - mask) + root) % self._n, value)
+                send(rankmap[vr - mask], value)
                 break
             src_vr = vr + mask
-            if src_vr < self._n:
-                value = recv_merge((src_vr + root) % self._n, value)
+            if src_vr < n:
+                value = recv_merge(rankmap[src_vr], value)
             mask <<= 1
         return value
 
-    def _tree_bcast_walk(self, value, root: int, send, recv):
-        """Down-sweep: ``root``'s ``value`` reaches every rank.
-        ``recv(peer) -> value`` replaces the local value on first
-        receipt; holders forward with ``send(peer, value)``."""
-        vr = (self._rank - root) % self._n
+    def _tree_bcast_walk(self, value, root: int, send, recv,
+                         group=None):
+        """Down-sweep: ``root``'s ``value`` reaches every rank (of
+        ``group``, when given). ``recv(peer) -> value`` replaces the
+        local value on first receipt; holders forward with
+        ``send(peer, value)``."""
+        n, vr, rankmap = self._walk_coords(root, group)
         mask = 1
         have = vr == 0
-        while mask < self._n:
+        while mask < n:
             if have:
                 dst_vr = vr + mask
-                if dst_vr < self._n:
-                    send((dst_vr + root) % self._n, value)
+                if dst_vr < n:
+                    send(rankmap[dst_vr], value)
             elif mask <= vr < 2 * mask:
-                value = recv(((vr - mask) + root) % self._n)
+                value = recv(rankmap[vr - mask])
                 have = True
             mask <<= 1
         return value
@@ -1736,21 +2148,56 @@ class ProcessCommSlave(CommSlave):
         return out
 
     def _reduce_map_columns(self, d: dict, vals, operand: Operand,
-                            operator: Operator, root: int, decision):
-        """Binomial-tree columnar reduce; the returned columns are the
-        full union at ``root`` (partial elsewhere)."""
+                            operator: Operator, root: int, decision,
+                            group=None, cols=None):
+        """Binomial-tree columnar reduce (over ``group`` when given);
+        the returned columns are the full union at ``root`` (partial
+        elsewhere). ``cols`` skips the encode for callers chaining
+        walks over already-encoded columns (the two-level legs)."""
+        if cols is None:
+            cols = self._encode_map_columns(d, decision, vals, operand)
         return self._tree_reduce_walk(
-            self._encode_map_columns(d, decision, vals, operand), root,
+            cols, root,
             lambda peer, acc: self._send_map_columns(peer, acc, operand),
             lambda peer, acc: self._merge_map_columns(
-                acc, self._recv_map_columns(peer), operator))
+                acc, self._recv_map_columns(peer), operator),
+            group=group)
 
-    def _bcast_map_columns(self, cols, root: int, operand: Operand):
-        """Binomial-tree broadcast of ``root``'s columns."""
+    def _bcast_map_columns(self, cols, root: int, operand: Operand,
+                           group=None):
+        """Binomial-tree broadcast of ``root``'s columns (over
+        ``group`` when given)."""
         return self._tree_bcast_walk(
             cols, root,
             lambda peer, c: self._send_map_columns(peer, c, operand),
-            self._recv_map_columns)
+            self._recv_map_columns, group=group)
+
+    def _twolevel_allreduce_map_columns(self, d: dict, vals,
+                                        operand: Operand,
+                                        operator: Operator, decision):
+        """Two-level columnar map allreduce (ISSUE 7): merge columns to
+        each host leader over the intra-host (shm) pairs, tree-
+        allreduce among the leaders over TCP, broadcast back out —
+        same merge operand order as the flat walk (acc side first), so
+        results are bit-identical for order-insensitive operator/value
+        combinations and the inter-host wire carries each column set
+        once per host."""
+        members, leaders = self._members, self._leaders
+        cols = self._encode_map_columns(d, decision, vals, operand)
+        if len(members) > 1:
+            cols = self._reduce_map_columns(
+                d, vals, operand, operator, self._leader, decision,
+                group=members, cols=cols)
+        if self._rank == self._leader and len(leaders) > 1:
+            cols = self._reduce_map_columns(
+                d, vals, operand, operator, leaders[0], decision,
+                group=leaders, cols=cols)
+            cols = self._bcast_map_columns(cols, leaders[0], operand,
+                                           group=leaders)
+        if len(members) > 1:
+            cols = self._bcast_map_columns(cols, self._leader, operand,
+                                           group=members)
+        return cols
 
     # -- pickled plane (the sanctioned fallback) ------------------------
     def _send_map_obj(self, peer: int, d, operand: Operand) -> None:
@@ -1821,9 +2268,14 @@ class ProcessCommSlave(CommSlave):
             if decision[0] == "nop":
                 return d
             if decision[0] == "col":
-                cols = self._reduce_map_columns(d, vals, operand,
-                                                operator, 0, decision)
-                cols = self._bcast_map_columns(cols, 0, operand)
+                if self._use_twolevel():
+                    cols = self._twolevel_allreduce_map_columns(
+                        d, vals, operand, operator, decision)
+                else:
+                    cols = self._reduce_map_columns(d, vals, operand,
+                                                    operator, 0,
+                                                    decision)
+                    cols = self._bcast_map_columns(cols, 0, operand)
                 merged = self._decode_map_columns(decision, *cols)
                 d.clear()
                 d.update(merged)

@@ -32,6 +32,11 @@ THRESHOLDS: dict[str, float] = {
     "socket_baseline_gbs": 0.25,
     "socket_collective_gbs": 0.20,
     "socket_native_collective_gbs": 0.20,
+    # ISSUE 7: the intra-host shared-memory plane and the forced
+    # two-level schedule over it; same loopback-leg noise floor as the
+    # other socket figures on the shared 1-core bench host
+    "socket_shm_collective_gbs": 0.25,
+    "socket_twolevel_gbs": 0.25,
     "socket_framed_collective_gbs": 0.20,
     "socket_collective_in_workload_gbs": 0.25,
     "ffm_sparse_steps_per_sec": 0.10,

@@ -265,7 +265,8 @@ class RateWindow:
 # Prometheus text-format rendering (the /metrics endpoint)
 # ----------------------------------------------------------------------
 _STATS_COUNTER_KEYS = ("calls", "bytes_sent", "bytes_recv", "chunks",
-                       "keys", "retries", "reconnects", "aborts_seen")
+                       "keys", "retries", "reconnects", "aborts_seen",
+                       "wire_bytes_tcp", "wire_bytes_shm")
 _STATS_PHASE_KEYS = ("wire_seconds", "reduce_seconds",
                      "serialize_seconds")
 
@@ -376,6 +377,15 @@ def to_prometheus(doc: dict) -> str:
             _hist_lines(out, "mp4j_collective_latency_seconds",
                         f'collective="{_esc(name[len("latency/"):])}"', h)
     out.append("# TYPE mp4j_frame_bytes histogram")
-    if "frame_bytes" in hists:
-        _hist_lines(out, "mp4j_frame_bytes", "", hists["frame_bytes"])
+    for name in sorted(hists):
+        # transport-labelled families (frame_bytes/tcp, frame_bytes/
+        # shm — ISSUE 7) next to the legacy unlabelled series, all one
+        # contiguous mp4j_frame_bytes block
+        if name == "frame_bytes":
+            _hist_lines(out, "mp4j_frame_bytes", "", hists[name])
+        elif name.startswith("frame_bytes/"):
+            _hist_lines(
+                out, "mp4j_frame_bytes",
+                f'transport="{_esc(name[len("frame_bytes/"):])}"',
+                hists[name])
     return "\n".join(out) + "\n"

@@ -123,7 +123,8 @@ def format_live(doc: dict) -> str:
                   for info in ranks.values())
     lines = [head,
              f"{'rank':>4}  {'seq':>5}  {'lag':>4}  "
-             f"{'state':<34}  {'MB/s':>8}  {'retries':>7}  hb age"]
+             f"{'state':<34}  {'MB/s':>8}  {'shm%':>5}  "
+             f"{'retries':>7}  hb age"]
     for r in sorted(ranks, key=int):
         info = ranks[r]
         prog = info.get("progress", {})
@@ -140,11 +141,19 @@ def format_live(doc: dict) -> str:
             state = "idle"
         retries = sum(int(e.get("retries", 0))
                       for e in info.get("stats", {}).values())
+        # which plane the bytes rode (ISSUE 7): shm share of the
+        # transport-tagged wire bytes; "-" before any tagged byte moved
+        shm_b = sum(e.get("wire_bytes_shm", 0)
+                    for e in info.get("stats", {}).values())
+        tagged = shm_b + sum(e.get("wire_bytes_tcp", 0)
+                             for e in info.get("stats", {}).values())
+        shm_pct = f"{100.0 * shm_b / tagged:.0f}" if tagged else "-"
         mark = "*" if int(r) in stragglers else " "
         lines.append(
             f"{mark}{r:>3}  {seq:>5}  {lag if lag else '-':>4}  "
             f"{state:<34.34}  "
             f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:>8.2f}  "
+            f"{shm_pct:>5}  "
             f"{retries:>7}  {info.get('age', 0.0):.1f}s")
     return "\n".join(lines)
 

@@ -1,3 +1,11 @@
-from ytk_mp4j_tpu.transport.channel import Channel
+"""Transport SPI package: the abstract :class:`Channel` contract plus
+the concrete transports — TCP (:mod:`.tcp`) and intra-host shared
+memory (:mod:`.shm`). Constructing a concrete channel (or a raw
+socket) outside this package is an mp4j-lint R12 violation; rendezvous
+holds the only baselined sites."""
 
-__all__ = ["Channel"]
+from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.transport.shm import ShmChannel
+from ytk_mp4j_tpu.transport.tcp import TcpChannel, connect
+
+__all__ = ["Channel", "TcpChannel", "ShmChannel", "connect"]

@@ -1,38 +1,61 @@
-"""Socket message framing for the CPU reference path.
+"""Transport SPI: the abstract ``Channel`` contract + shared framing.
 
-The reference frames messages over raw ``java.net.Socket`` streams with
-Kryo for objects and raw ``DataOutputStream`` writes for primitive arrays
-(SURVEY.md section 2 "Serialization" [U]). Here:
+ytk-mp4j's design premise is that ONE comm API spans in-process
+threads, co-located processes and cross-machine sockets. This module is
+the seam that makes that true below the collective layer: a
+:class:`Channel` is a framed, blocking, bidirectional, order-preserving
+byte channel to one peer, and everything the collectives need — object
+frames, array frames, paired columnar map frames, the unframed raw
+plane, epoch pinning, fault hooks, stats attribution — is implemented
+HERE, once, against two transport primitives:
 
-- numeric numpy arrays take the fast path: a small dtype/shape header,
-  then the raw buffer (no pickling; zero-copy on receive into a
-  preallocated array),
-- everything else (maps, strings, objects, control tuples) is pickled —
-  pickle stands in for Kryo,
-- either kind may be zlib-compressed on the wire (``compress=True`` on
-  send; the receiver auto-detects by frame tag). Compression is
-  per-operand (``Operands.compressed(...)``): a bandwidth/CPU trade the
-  caller makes for highly-compressible payloads. Compressed ARRAYS
-  stream in ``MP4J_CHUNK_BYTES`` pieces (``TAG_ARRAY_ZC``) so the
-  sender's zlib work on chunk k+1 overlaps the wire transfer of chunk
-  k, and the receiver decompresses chunk k while k+1 is in flight.
+- ``_io_send(buf)`` — blocking write of one buffer, honoring the
+  channel's transfer timeout; raises ``Mp4jTransportError`` on a dead
+  or stalled peer;
+- ``_io_recv_into(view)`` — blocking exact fill of ``view``, same
+  contract.
 
-Frame layout: ``u8 tag | u64 payload_len | payload``. For
-``TAG_ARRAY_ZC`` the declared payload covers only the dtype/shape
-header; a self-delimiting chunk stream follows (``u32 clen | cbytes``
-repeated, terminated by ``u32 0``) so compressed sizes never need to be
-known up front.
+Concrete transports implement just those plus lifecycle
+(``set_timeout`` / ``invalidate`` / ``close``):
 
-Env knobs applied at channel setup (see :mod:`ytk_mp4j_tpu.utils.tuning`
-— JOB-wide settings, every rank must agree): ``MP4J_SO_SNDBUF`` /
-``MP4J_SO_RCVBUF`` size the kernel socket buffers (unset keeps kernel
-defaults); ``MP4J_CHUNK_BYTES`` sizes the streaming-compression chunks.
+- :mod:`ytk_mp4j_tpu.transport.tcp` — the reference socket transport
+  (framing over ``java.net.Socket`` streams in the reference, SURVEY.md
+  section 2);
+- :mod:`ytk_mp4j_tpu.transport.shm` — the intra-host shared-memory
+  ring transport (ISSUE 7): same frames, but the "wire" is a lock-free
+  ring in a ``multiprocessing.shared_memory`` segment.
+
+Faults, epoch fencing, stats/metrics attribution and recovery compose
+as LAYERS over this contract instead of special cases per transport:
+the fault injector hooks ride ``_send_all`` / ``_recv_into`` (shared),
+``invalidate()`` has one meaning everywhere (wake every blocked
+operation with a transport error WITHOUT releasing OS resources — the
+owner frees them later, from the collective thread, mirroring the
+deferred-close discipline of ``_drain_dead_channels``), and wire stats
+carry the channel's ``transport`` tag (tcp|shm) so every byte is
+attributable to the plane it rode.
+
+Frame layout (identical on every transport): ``u8 tag | u64
+payload_len | payload``. Numeric numpy arrays take the fast path (a
+small dtype/shape header, then the raw buffer — no pickling; zero-copy
+on receive into a preallocated array); everything else (maps, strings,
+objects, control tuples) is pickled — pickle stands in for Kryo. Either
+kind may be zlib-compressed on the wire (``compress=True`` on send; the
+receiver auto-detects by frame tag). Compressed ARRAYS stream in
+``MP4J_CHUNK_BYTES`` pieces (``TAG_ARRAY_ZC``) so the sender's zlib
+work on chunk k+1 overlaps the transfer of chunk k; the chunk stream is
+self-delimiting (``u32 clen | cbytes`` repeated, ``u32 0`` terminator),
+so compressed sizes never need to be known up front.
+
+SPI enforcement: constructing a concrete channel (or a raw
+``socket.socket``) outside ``transport/`` is an mp4j-lint R12 error —
+rendezvous code paths hold the only baselined exceptions.
 """
 
 from __future__ import annotations
 
+import abc
 import pickle
-import socket
 import struct
 import time
 import zlib
@@ -40,7 +63,7 @@ import zlib
 import numpy as np
 
 from ytk_mp4j_tpu.utils import tuning
-from ytk_mp4j_tpu.exceptions import Mp4jError, Mp4jTransportError
+from ytk_mp4j_tpu.exceptions import Mp4jError
 
 TAG_OBJ = 0
 TAG_ARRAY = 1
@@ -70,90 +93,87 @@ def _raw_view(arr: np.ndarray):
         return arr.view(np.uint8)
 
 
-def apply_socket_buf_sizes(sock: socket.socket) -> None:
-    """Apply ``MP4J_SO_SNDBUF`` / ``MP4J_SO_RCVBUF`` (validated; unset
-    keeps the kernel's autotuned defaults). Must run BEFORE
-    ``connect()`` on dialing sockets and before ``listen()`` on server
-    sockets (accepted sockets inherit): TCP fixes the window-scale
-    factor at the SYN/SYN-ACK from the buffer size at that moment, so
-    a post-handshake resize cannot widen the advertised window."""
-    for env, opt in (("MP4J_SO_SNDBUF", socket.SO_SNDBUF),
-                     ("MP4J_SO_RCVBUF", socket.SO_RCVBUF)):
-        size = tuning.env_bytes(env, 0, minimum=0)
-        if size > 0:
-            try:
-                sock.setsockopt(socket.SOL_SOCKET, opt, size)
-            except OSError as e:
-                raise Mp4jError(f"{env}={size} rejected by the "
-                                f"kernel: {e}") from None
-
-
-class Channel:
-    """A framed, blocking, bidirectional message channel over a socket.
+class Channel(abc.ABC):
+    """A framed, blocking, bidirectional message channel to one peer —
+    THE transport contract (see the module docstring).
 
     ``stats`` (optional, set by the owning slave on peer channels) is a
     :class:`ytk_mp4j_tpu.utils.stats.CommStats`; when present the
     channel books wire seconds/bytes and serialize (pickle/zlib)
-    seconds into the current collective's bucket. ``peer_rank``
-    (likewise set by the owning slave) tags the booked wire spans with
-    the remote rank, so a timeline span reads "wire recv<-2" instead of
-    an anonymous transfer.
+    seconds into the current collective's bucket, tagged with this
+    channel's ``transport``. ``peer_rank`` (likewise set by the owning
+    slave) tags the booked wire spans with the remote rank, so a
+    timeline span reads "wire recv<-2" instead of an anonymous
+    transfer. ``faults`` is the resilience fault injector; ``epoch``
+    the job-wide recovery epoch the channel was established in.
     """
 
     # class-level defaults so partially-constructed channels (tests
-    # build bare instances around socket stand-ins) still frame
+    # build bare instances around transport stand-ins) still frame
     stats = None
     peer_rank = None
     faults = None     # resilience.faults.FaultInjector on peer channels
     epoch = 0         # the job-wide epoch this channel was dialed in
+    transport = "?"   # wire-plane tag for stats/metrics (tcp|shm)
     _chunk_bytes = tuning.DEFAULT_CHUNK_BYTES
 
-    def __init__(self, sock: socket.socket):
-        self.sock = sock
-        self.stats = None
-        self.peer_rank = None
-        self.faults = None
-        self.epoch = 0
-        self._chunk_bytes = tuning.chunk_bytes()
-        try:
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        except OSError:
-            pass  # non-TCP transport (e.g. a UNIX socketpair)
-        # also applied here for non-TCP/odd transports; for TCP the
-        # load-bearing application happens BEFORE connect()/listen()
-        # (see apply_socket_buf_sizes) — the window scale is fixed at
-        # the handshake, so a post-connect resize cannot widen it
-        apply_socket_buf_sizes(sock)
+    # -- transport primitives (the whole SPI surface) -------------------
+    @abc.abstractmethod
+    def _io_send(self, buf) -> None:
+        """Blocking write of one buffer (bytes/memoryview), honoring
+        the transfer timeout; ``Mp4jTransportError`` on a dead peer."""
 
-    # -- low level ------------------------------------------------------
-    def _send_all(self, *bufs: bytes | memoryview):
-        # a socket timeout (set_timeout) applies to sends too: a peer
-        # that stops draining must surface as Mp4jError like a dead
-        # receiver does, not as a raw socket.timeout
+    @abc.abstractmethod
+    def _io_recv_into(self, view: memoryview) -> None:
+        """Blocking exact fill of ``view``, honoring the transfer
+        timeout; ``Mp4jTransportError`` on EOF/teardown/expiry."""
+
+    @abc.abstractmethod
+    def set_timeout(self, timeout: float | None) -> None:
+        """Transfer timeout, both directions. ``None`` (default) is the
+        reference's fail-stop behavior — a dead peer blocks forever; a
+        finite value turns that hang into a diagnosable error."""
+
+    @abc.abstractmethod
+    def invalidate(self) -> None:
+        """Tear the channel down WITHOUT releasing OS resources: every
+        blocked (and future) operation on either end must fail with a
+        transport error, but fds / shared segments stay allocated — the
+        recovery teardown runs on the control thread while the
+        collective thread may still sit inside an I/O primitive, and
+        releasing a resource under a live operation lets a re-dial
+        recycle it into the wrong exchange. The owner frees invalidated
+        channels later, from the collective thread, once no operation
+        can be in flight (``ProcessCommSlave._drain_dead_channels``)."""
+
+    @abc.abstractmethod
+    def close(self, graceful: bool = False) -> None:
+        """Release the channel's resources. ``graceful`` flushes and
+        drains first where the transport needs it (TCP must not RST a
+        slower peer mid-read; the shm ring's bytes outlive the name, so
+        graceful is free there)."""
+
+    def native_fd(self) -> int | None:
+        """The raw socket fd for the native C++ poll loop, or ``None``
+        when this transport has no socket data plane (the caller falls
+        back to the Python raw path, which is wire-identical)."""
+        return None
+
+    # -- shared low level -----------------------------------------------
+    def _send_all(self, *bufs: bytes | memoryview) -> None:
         t0 = time.perf_counter() if self.stats is not None else 0.0
-        try:
-            for b in bufs:
-                # per-buffer hook so an injected cut lands BETWEEN the
-                # header and payload of one frame — a true mid-frame
-                # tear, the hardest drain case for the receiver
-                if self.faults is not None:
-                    self.faults.on_io(self, "send")
-                self.sock.sendall(b)
-        except socket.timeout:
-            raise Mp4jTransportError(
-                "send timed out (peer dead or not draining?)") from None
+        for b in bufs:
+            # per-buffer hook so an injected cut lands BETWEEN the
+            # header and payload of one frame — a true mid-frame
+            # tear, the hardest drain case for the receiver
+            if self.faults is not None:
+                self.faults.on_io(self, "send")
+            self._io_send(b)
         if self.stats is not None:
             self.stats.add_wire(sum(len(b) for b in bufs), 0,
                                 time.perf_counter() - t0, chunks=0,
-                                peer=self.peer_rank)
-
-    def set_timeout(self, timeout: float | None) -> None:
-        """Transfer timeout, both directions: receives AND sends (a
-        peer that stops draining stalls sendall the same way a dead
-        sender stalls recv). ``None`` (default) is the reference's
-        fail-stop behavior — a dead peer blocks forever; a finite value
-        turns that hang into a diagnosable Mp4jError."""
-        self.sock.settimeout(timeout)
+                                peer=self.peer_rank,
+                                transport=self.transport)
 
     def _whom(self) -> str:
         """Peer tag for error messages (empty off the peer plane)."""
@@ -161,28 +181,16 @@ class Channel:
             else ""
 
     def _recv_into(self, view: memoryview) -> None:
-        """Fill ``view`` from the socket (timeout-aware, fail-stop on a
-        closed peer); the building block of every framed receive."""
-        n = len(view)
+        """Fill ``view`` (timeout-aware, fail-stop on a closed peer);
+        the building block of every framed receive."""
         t0 = time.perf_counter() if self.stats is not None else 0.0
         if self.faults is not None:
             self.faults.on_io(self, "recv")
-        got = 0
-        while got < n:
-            try:
-                r = self.sock.recv_into(view[got:], n - got)
-            except socket.timeout:
-                raise Mp4jTransportError(
-                    f"receive timed out with {n - got} bytes pending"
-                    f"{self._whom()} (peer dead or stalled?)") from None
-            if r == 0:
-                raise Mp4jTransportError(
-                    f"peer closed connection mid-message{self._whom()} "
-                    f"({n - got}/{n} bytes short)")
-            got += r
+        self._io_recv_into(view)
         if self.stats is not None:
-            self.stats.add_wire(0, n, time.perf_counter() - t0, chunks=0,
-                                peer=self.peer_rank)
+            self.stats.add_wire(0, len(view), time.perf_counter() - t0,
+                                chunks=0, peer=self.peer_rank,
+                                transport=self.transport)
 
     def _recv_exact(self, n: int) -> bytearray:
         out = bytearray(n)
@@ -229,12 +237,11 @@ class Channel:
     def _send_array_zc(self, arr: np.ndarray, header: bytes) -> None:
         """Streamed compressed array send (TAG_ARRAY_ZC): compress in
         ``MP4J_CHUNK_BYTES`` pieces and put each on the wire as soon as
-        it exists, so zlib work on chunk k+1 overlaps the kernel's
-        transmission of chunk k (and the peer's inflate of chunk k).
-        The declared frame payload covers only the header; the chunk
-        stream is self-delimiting (u32 length prefixes, 0 terminator),
-        so the total compressed size never needs to be known up front.
-        """
+        it exists, so zlib work on chunk k+1 overlaps the transfer of
+        chunk k (and the peer's inflate of chunk k). The declared frame
+        payload covers only the header; the chunk stream is
+        self-delimiting (u32 length prefixes, 0 terminator), so the
+        total compressed size never needs to be known up front."""
         self._send_all(_HDR.pack(TAG_ARRAY_ZC, len(header) + 4),
                        struct.pack("<I", len(header)), header)
         comp = zlib.compressobj(_ZLEVEL)
@@ -286,39 +293,20 @@ class Channel:
                 "disagreement between sender and receiver?)")
         return codes, values
 
-    # -- raw (unframed) fast path ----------------------------------------
+    # -- raw (unframed) fast path --------------------------------------
     # Sizes never travel on the wire: both peers derive them from the
     # collective's segment metadata, like the reference's primitive
     # DataOutputStream fast path. Used by ProcessCommSlave's numeric
     # collectives (native poll loop when available, these when not).
+    # No injector hook here: the raw plane hooks at EXCHANGE
+    # granularity (_exchange_raw) so the native poll loop and these
+    # fallbacks see identical fault schedules — a second hook here
+    # would double-fire slow directives on fallback transports only.
     def send_raw(self, arr: np.ndarray) -> None:
-        # no injector hook here: the raw plane hooks at EXCHANGE
-        # granularity (_exchange_raw) so the native poll loop and this
-        # fallback see identical fault schedules — a second hook here
-        # would double-fire slow directives on fallback hosts only
-        try:
-            self.sock.sendall(_raw_view(arr))
-        except socket.timeout:
-            raise Mp4jTransportError(
-                "raw send timed out (peer dead or not draining?)") from None
+        self._io_send(_raw_view(arr))
 
     def recv_raw_into(self, arr: np.ndarray) -> None:
-        # no injector hook: see send_raw
-        view = memoryview(_raw_view(arr))
-        n = len(view)
-        got = 0
-        while got < n:
-            try:
-                r = self.sock.recv_into(view[got:], n - got)
-            except socket.timeout:
-                raise Mp4jTransportError(
-                    f"receive timed out with {n - got} raw bytes pending"
-                    f"{self._whom()} (peer dead or stalled?)") from None
-            if r == 0:
-                raise Mp4jTransportError(
-                    f"peer closed connection mid-message{self._whom()} "
-                    f"({n - got}/{n} raw bytes short)")
-            got += r
+        self._io_recv_into(memoryview(_raw_view(arr)))
 
     # -- unified receive ------------------------------------------------
     @staticmethod
@@ -417,11 +405,11 @@ class Channel:
 
         ``on_chunk(lo, hi)`` (element range) fires as each
         ``MP4J_CHUNK_BYTES`` piece lands, so the caller's merge of
-        chunk k runs cache-hot and overlaps the wire transfer of chunk
-        k+1 — the framed path's half of the pipelined collective
-        engine. Uncompressed frames are received in chunked pieces;
-        compressed frames inflate piece-by-piece and report progress on
-        element boundaries.
+        chunk k runs cache-hot and overlaps the transfer of chunk k+1 —
+        the framed path's half of the pipelined collective engine.
+        Uncompressed frames are received in chunked pieces; compressed
+        frames inflate piece-by-piece and report progress on element
+        boundaries.
         """
         hdr = self._recv_exact(_HDR.size)
         tag, ln = _HDR.unpack(bytes(hdr))
@@ -480,61 +468,3 @@ class Channel:
         if not isinstance(out, np.ndarray):
             raise Mp4jError(f"expected array frame, got {type(out)}")
         return out
-
-    def invalidate(self) -> None:
-        """Shut the connection down WITHOUT releasing the fd. The
-        recovery teardown runs on the control thread while the
-        collective thread may sit inside the native poll loop on this
-        channel's raw fd number: ``shutdown`` wakes that poller with
-        EOF/HUP, but an immediate ``close`` would free the fd number
-        for reuse — a re-dialed channel could then recycle it and the
-        still-unwinding native call would poll (or read!) the wrong
-        socket. The owner closes invalidated channels later, from the
-        collective thread, once no native call can be in flight
-        (:meth:`ProcessCommSlave._drain_dead_channels`)."""
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-
-    def close(self, graceful: bool = False) -> None:
-        """Close the channel. ``graceful`` half-closes first (FIN after
-        flushing our send queue, then a bounded drain of inbound bytes
-        until the peer's FIN): a rank finishing its LAST collective
-        must not hard-close while a slower peer is still reading our
-        buffered bytes — a close with unread inbound data turns into a
-        TCP RST that discards our send queue and truncates the peer's
-        stream mid-message. Recovery teardown keeps the abrupt default:
-        there the hard cut IS the drain (stale frames must die)."""
-        if graceful:
-            try:
-                self.sock.shutdown(socket.SHUT_WR)
-                self.sock.settimeout(1.0)
-                while self.sock.recv(65536):
-                    pass
-            except OSError:
-                pass   # timeout/reset: fall through to the hard close
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self.sock.close()
-
-
-def connect(host: str, port: int, timeout: float | None = None) -> Channel:
-    # buffer sizes must be in place before the TCP handshake (window
-    # scale negotiation) — so no create_connection() shortcut here
-    err: Exception | None = None
-    for family, socktype, proto, _, addr in socket.getaddrinfo(
-            host, port, type=socket.SOCK_STREAM):
-        sock = socket.socket(family, socktype, proto)
-        try:
-            apply_socket_buf_sizes(sock)
-            sock.settimeout(timeout)
-            sock.connect(addr)
-            sock.settimeout(None)
-            return Channel(sock)
-        except OSError as e:
-            sock.close()
-            err = e
-    raise Mp4jTransportError(f"cannot connect to {host}:{port}: {err}")

@@ -29,12 +29,22 @@ Schema of one snapshot entry (all keys always present)::
 
     {"calls": int, "bytes_sent": int, "bytes_recv": int,
      "chunks": int, "keys": int, "retries": int, "reconnects": int,
-     "aborts_seen": int, "wire_seconds": float,
-     "reduce_seconds": float, "serialize_seconds": float}
+     "aborts_seen": int, "wire_bytes_tcp": int, "wire_bytes_shm": int,
+     "wire_seconds": float, "reduce_seconds": float,
+     "serialize_seconds": float}
 
 Phase seconds are BUSY times and may overlap in wall time (the whole
 point of the pipelined engine is that wire and reduce overlap), so
 their sum can exceed the collective's wall time.
+
+``wire_bytes_tcp`` / ``wire_bytes_shm`` (ISSUE 7) split the wire
+bytes (both directions summed) by the transport they rode, so
+``mp4j-scope live`` and postmortem bundles show which plane moved a
+collective's data; events whose channel does not declare a transport
+(bare test channels) book into neither, so the split is a lower bound
+that equals the total whenever every byte rode a tagged channel. The
+frame-size histogram splits the same way (``frame_bytes/tcp`` /
+``frame_bytes/shm`` metric families).
 
 ``keys`` counts map entries this rank encoded into columnar frames
 (the socket map plane, ISSUE 4) — per call it equals the local map
@@ -60,7 +70,12 @@ _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
 # were re-dialed into a fresh epoch, and how many abort fan-outs this
 # rank observed (control-plane events, booked wherever the rank stood)
 _COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys",
-             "retries", "reconnects", "aborts_seen")
+             "retries", "reconnects", "aborts_seen",
+             "wire_bytes_tcp", "wire_bytes_shm")
+
+# transports the wire split books (ISSUE 7); anything else (bare test
+# channels, transport-agnostic callers) keeps the untagged totals only
+_TRANSPORTS = ("tcp", "shm")
 
 
 def _zero() -> dict[str, float]:
@@ -211,30 +226,37 @@ class CommStats:
 
     def add_wire(self, bytes_sent: int, bytes_recv: int, seconds: float,
                  chunks: int = 1, bucket: str | None = None,
-                 peer: int | None = None) -> None:
+                 peer: int | None = None,
+                 transport: str | None = None) -> None:
         if bucket is None:
             name, seq = self._attribution()
         else:
             name, seq = bucket, self._seq
+        tagged = transport if transport in _TRANSPORTS else None
         with self._lock:
             e = self._bucket_locked(name)
             e["bytes_sent"] += bytes_sent
             e["bytes_recv"] += bytes_recv
             e["wire_seconds"] += seconds
             e["chunks"] += chunks
+            if tagged is not None:
+                e[f"wire_bytes_{tagged}"] += bytes_sent + bytes_recv
             self._last_phase = "wire"
         if spans._enabled:
             spans.phase("wire", seconds, self.rank, name, seq,
                         bytes_sent=bytes_sent or None,
                         bytes_recv=bytes_recv or None, peer=peer)
-        # frame-size histogram, one observation per direction moved
+        # frame-size histogram, one observation per direction moved,
+        # split per transport (the ISSUE 7 attribution satellite)
         if self.metrics.enabled:
+            fam = (f"frame_bytes/{tagged}" if tagged is not None
+                   else "frame_bytes")
             if bytes_sent:
-                self.metrics.observe("frame_bytes", bytes_sent,
+                self.metrics.observe(fam, bytes_sent,
                                      metrics_mod.FRAME_LO,
                                      metrics_mod.FRAME_BUCKETS)
             if bytes_recv:
-                self.metrics.observe("frame_bytes", bytes_recv,
+                self.metrics.observe(fam, bytes_recv,
                                      metrics_mod.FRAME_LO,
                                      metrics_mod.FRAME_BUCKETS)
 

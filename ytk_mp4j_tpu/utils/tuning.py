@@ -25,8 +25,18 @@ Knobs (all validated where they are consumed; garbage raises
   Defaults are grounded in ``bench.py``'s ``socket_allreduce_sweep``
   (see BENCH JSON ``extra``).
 - ``MP4J_SO_SNDBUF`` / ``MP4J_SO_RCVBUF`` — socket buffer sizes applied
-  at channel setup (``transport/channel.py``); unset keeps the kernel
+  at channel setup (``transport/tcp.py``); unset keeps the kernel
   defaults.
+- ``MP4J_SHM`` — the intra-host shared-memory transport
+  (``transport/shm.py``): ``1`` (default) lets rendezvous negotiate a
+  shm ring pair for every SAME-host peer pair (host fingerprints
+  compared from the roster; cross-host pairs always keep TCP); ``0``
+  forces TCP everywhere. JOB-wide like ``native_transport`` — the
+  handshake carries the decision, but every rank must agree on whether
+  to offer it.
+- ``MP4J_SHM_RING_BYTES`` — bytes per DIRECTION of each shm peer
+  pair's ring buffer (default 1 MiB, matching ``MP4J_CHUNK_BYTES`` so
+  a pipeline chunk fits the ring in one pass).
 - ``MP4J_HEARTBEAT_SECS`` — period of the slave->master telemetry
   heartbeat (``comm/process_comm.py``); ``0`` disables heartbeats.
 - ``MP4J_SPAN_RING`` — capacity of the in-process span ring buffer
@@ -81,6 +91,10 @@ DEFAULT_CHUNK_BYTES = 1024 * 1024
 # core counts / NICs tune via env.
 DEFAULT_ALGO_SMALL_BYTES = 256 * 1024
 DEFAULT_ALGO_LARGE_BYTES = 4 * 1024 * 1024
+# Shared-memory transport defaults (ISSUE 7): ring sized to one
+# pipeline chunk so a chunked exchange streams through without an
+# intermediate wait in the common case.
+DEFAULT_SHM_RING_BYTES = 1024 * 1024
 # Resilience defaults (ISSUE 5): recovery is ON by default — two
 # epoch-fenced retry rounds per failed collective — because the fence
 # itself is a flag check (~0 steady-state cost; the input-preservation
@@ -186,6 +200,29 @@ def log_level() -> str:
             f"MP4J_LOG_LEVEL={raw!r} is not one of "
             f"{sorted(LOG_LEVELS)}")
     return name
+
+
+def shm_enabled() -> bool:
+    """Whether rendezvous may negotiate the shared-memory transport for
+    same-host peer pairs (``MP4J_SHM``). JOB-wide like
+    ``native_transport``: the dialer offers shm in the peer handshake
+    and the accepter attaches, so every rank must run with the same
+    value or a pair could disagree about its data plane."""
+    raw = os.environ.get("MP4J_SHM")
+    if raw is None or raw.strip() == "":
+        return True
+    val = raw.strip()
+    if val not in ("0", "1"):
+        raise Mp4jError(f"MP4J_SHM={raw!r} must be 0 or 1")
+    return val == "1"
+
+
+def shm_ring_bytes() -> int:
+    """Bytes per direction of each shm peer pair's ring
+    (``MP4J_SHM_RING_BYTES``). The floor keeps one frame header plus a
+    compressed chunk length always ring-transitable."""
+    return env_bytes("MP4J_SHM_RING_BYTES", DEFAULT_SHM_RING_BYTES,
+                     minimum=4096)
 
 
 def map_columnar_enabled() -> bool:
@@ -338,6 +375,18 @@ def select_allreduce_algo(nbytes: int, n: int, small: int,
     if nbytes >= large:
         return "ring"
     return "rhd"
+
+
+def select_twolevel(host_sizes: list[int]) -> bool:
+    """Whether ``algo="auto"`` should take the topology-aware two-level
+    schedule (intra-host reduce over shm -> one inter-host exchange per
+    host leader -> intra-host broadcast): true exactly when there are
+    MULTIPLE hosts and at least one host co-locates ranks — otherwise
+    the flat schedule is already optimal (single host: every pair rides
+    shm anyway; one rank per host: there is no intra level). A pure
+    function of the roster-derived host grouping (identical on every
+    rank — mp4j-lint R1/R8 discipline)."""
+    return len(host_sizes) > 1 and any(s > 1 for s in host_sizes)
 
 
 def select_partitioned_algo(nbytes: int, n: int, small: int,
