@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 13)]
+    assert ids == [f"R{i}" for i in range(1, 14)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -917,5 +917,76 @@ def test_r12_baseline_suppression_matches_rendezvous_site():
             def __init__(self):
                 self._server = socket.socket(socket.AF_INET,
                                              socket.SOCK_STREAM)
+    """, baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R13 — raw-byte read of a possibly non-contiguous array
+# ----------------------------------------------------------------------
+def test_r13_fires_on_unpinned_memoryview_and_tobytes():
+    r = run_rule("R13", """
+        def digest(arr):
+            h = crc32(memoryview(arr))
+            return h ^ crc32(arr.tobytes())
+    """)
+    assert [f.line for f in r.findings] == [3, 4]
+    assert all("contiguity" in f.message or "pin" in f.message
+               for f in r.findings)
+
+
+def test_r13_quiet_when_pinned_or_constructed():
+    assert not run_rule("R13", """
+        import numpy as np
+
+        def digest(arr):
+            arr = np.ascontiguousarray(arr)
+            return crc32(memoryview(arr)) ^ crc32(arr.tobytes())
+    """).findings
+    # contiguous-by-construction buffers: bytearray/np.empty, and
+    # slices of them (the frombuffer-tail idiom in obs/audit.py)
+    assert not run_rule("R13", """
+        import numpy as np
+
+        def recv(n):
+            out = bytearray(n)
+            fill(memoryview(out))
+            u8 = np.frombuffer(out, np.uint8)
+            tail = u8[8:]
+            return tail.tobytes()
+    """).findings
+    # a call-expression argument is the callee's contract, not this
+    # site's (memoryview(_raw_view(x)) — _raw_view is the baselined
+    # sanctioned site whose callers pin)
+    assert not run_rule("R13", """
+        def frame(arr):
+            return memoryview(_raw_view(arr)).cast("B")
+    """).findings
+
+
+def test_r13_scoped_to_comm_obs_transport():
+    assert not run_rule("R13", """
+        def digest(arr):
+            return crc32(memoryview(arr))
+    """, path="ytk_mp4j_tpu/models/snippet.py").findings
+
+
+def test_r13_inline_and_baseline_suppression():
+    r = run_rule("R13", """
+        def nbytes_of(b):
+            # mp4j-lint: disable=R13 (length read, not serialization)
+            return memoryview(b).nbytes
+    """)
+    assert not r.findings and len(r.suppressed) == 1
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R13"
+        file = "ytk_mp4j_tpu/comm/snippet.py"
+        context = "_raw_view"
+        reason = "callers pin"
+    """))
+    r = run_rule("R13", """
+        def _raw_view(arr):
+            return memoryview(arr).cast("B")
     """, baseline=bl)
     assert not r.findings and len(r.suppressed) == 1

@@ -1,0 +1,820 @@
+"""mp4j-audit (ISSUE 8): digest semantics, cross-rank verification,
+corruption detection, record/replay, and the audit satellites.
+
+The acceptance grid: injected ``corrupt`` faults across {tcp, shm} x
+{raw, framed, columnar-map} must be detected and NAMED (collective
+ordinal + ranks) under ``MP4J_AUDIT=verify`` — including the
+consistent-wrong case where every rank's output is equal-but-wrong and
+only the pairwise wire digests disagree; a clean multi-collective grid
+must report ZERO false divergences; and ``mp4j-scope replay`` on a
+captured bundle must reproduce an injected divergence digest-for-digest
+offline while reporting an unfaulted bundle all-clean.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm import process_comm as pc
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.obs import audit as audit_mod
+from ytk_mp4j_tpu.obs import cli as obs_cli
+from ytk_mp4j_tpu.obs import metrics as metrics_mod
+from ytk_mp4j_tpu.obs import postmortem as postmortem_mod
+from ytk_mp4j_tpu.obs import telemetry
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operator, Operators
+from ytk_mp4j_tpu.resilience import faults as faults_mod
+from ytk_mp4j_tpu.utils import tuning
+
+N = 4
+JOIN = 45.0
+
+
+def run_audited(n, fn, fault_plan=None, audit="verify", join=JOIN,
+                hold=None, master_kwargs=None, **slave_kwargs):
+    """Master + n thread-hosted slaves under a hard join deadline
+    (the test_resilience harness shape). Returns (results, errors,
+    master, log). ``hold`` is an optional (ready, release) event pair:
+    workers set ``ready`` after ``fn`` and block on ``release`` before
+    closing, so the main thread can interrogate the LIVE master."""
+    log = io.StringIO()
+    master = Master(n, timeout=join, log_stream=log,
+                    **(master_kwargs or {})).serve_in_thread()
+    results = [None] * n
+    errors: list = [None] * n
+
+    def worker(i):
+        slave = None
+        try:
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=join,
+                fault_plan=fault_plan, audit=audit,
+                dead_rank_secs=20.0, **slave_kwargs)
+            results[slave.rank] = fn(slave, slave.rank)
+            if hold is not None:
+                ready, release = hold
+                ready.set()
+                release.wait(join)
+            slave.close(0)
+        except Exception as e:
+            r = slave.rank if slave is not None else i
+            errors[r] = e
+            if slave is not None:
+                try:
+                    slave.close(1)
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + join
+    for t in threads:
+        t.join(max(0.1, deadline - time.monotonic()))
+    hung = [i for i, t in enumerate(threads) if t.is_alive()]
+    assert not hung, f"ranks {hung} hung past the join deadline:\n" \
+                     + log.getvalue()
+    master.join(10.0)
+    return results, errors, master, log.getvalue()
+
+
+# ----------------------------------------------------------------------
+# digest semantics (pure)
+# ----------------------------------------------------------------------
+def test_digest_bytes_sensitivity():
+    base = bytes(range(256)) * 64
+    h = audit_mod.digest_bytes(base)
+    flipped = bytearray(base)
+    flipped[1234] ^= 0x01           # one BIT
+    assert audit_mod.digest_bytes(bytes(flipped)) != h
+    assert audit_mod.digest_bytes(base + b"\0") != h
+    assert audit_mod.digest_bytes(base[:-1]) != h
+    assert audit_mod.digest_bytes(base) == h    # deterministic
+
+
+def test_digest_bytes_every_byte_position_matters():
+    # xor-block hashing must detect a flip at ANY offset: body words,
+    # the block remainder, and the sub-8-byte tail
+    base = bytes(range(251)) * 9   # 2259 bytes: blocks + rem + tail
+    h = audit_mod.digest_bytes(base)
+    for pos in (0, 7, 8, 1024, 2048, 2255, 2258):
+        b = bytearray(base)
+        b[pos] ^= 0x80
+        assert audit_mod.digest_bytes(bytes(b)) != h, pos
+
+
+def test_digest_array_layout_canonical():
+    """Equal VALUES must digest equally whatever the memory layout —
+    the false-divergence hazard (mp4j-lint R13)."""
+    a = np.arange(4096, dtype=np.float64)
+    strided = np.empty(8192, np.float64)[::2]
+    strided[:] = a
+    assert not strided.flags.c_contiguous
+    assert audit_mod.digest_array(strided) == audit_mod.digest_array(a)
+    big = a.astype(a.dtype.newbyteorder(">"))
+    assert audit_mod.digest_array(big) == audit_mod.digest_array(a)
+
+
+def test_digest_array_dtype_and_shape_distinguish():
+    a = np.zeros(64, np.float32)
+    assert audit_mod.digest_array(a) != audit_mod.digest_array(
+        a.view(np.int32))
+    assert audit_mod.digest_array(a) != audit_mod.digest_array(a[:32])
+
+
+def test_digest_payload_map_order_insensitive():
+    d1 = {f"k{i}": float(i) for i in range(100)}
+    d2 = dict(reversed(list(d1.items())))
+    assert list(d1) != list(d2)
+    h1, sig1 = audit_mod.digest_payload(d1)
+    h2, sig2 = audit_mod.digest_payload(d2)
+    assert h1 == h2 and sig1 == sig2 == "map[100]"
+    d2["k3"] = 999.0
+    assert audit_mod.digest_payload(d2)[0] != h1
+
+
+def test_digest_payload_list_positional():
+    h1, _ = audit_mod.digest_payload(["a", "b"])
+    h2, _ = audit_mod.digest_payload(["b", "a"])
+    assert h1 != h2
+
+
+def test_fold_wire_is_boundary_invariant():
+    data = bytes(range(256)) * 100
+    whole = audit_mod.fold_wire(0, data)
+    split = audit_mod.fold_wire(audit_mod.fold_wire(0, data[:777]),
+                                data[777:])
+    assert whole == split
+
+
+# ----------------------------------------------------------------------
+# knobs / ring mechanics
+# ----------------------------------------------------------------------
+def test_audit_knobs_validated(monkeypatch):
+    monkeypatch.setenv("MP4J_AUDIT", "bogus")
+    with pytest.raises(Mp4jError):
+        tuning.audit_mode()
+    monkeypatch.setenv("MP4J_AUDIT", "VERIFY")
+    assert tuning.audit_mode() == "verify"
+    monkeypatch.delenv("MP4J_AUDIT")
+    assert tuning.audit_mode() == "digest"
+    assert tuning.audit_mode("off") == "off"
+    with pytest.raises(Mp4jError):
+        tuning.audit_mode("sometimes")
+    monkeypatch.setenv("MP4J_AUDIT_RING", "0")
+    with pytest.raises(Mp4jError):
+        tuning.audit_ring()
+    monkeypatch.setenv("MP4J_AUDIT_RING", "16")
+    assert tuning.audit_ring() == 16
+    with pytest.raises(Mp4jError):
+        audit_mod.AuditRing("off")
+
+
+def test_audit_ring_delta_cursor_and_drop_accounting():
+    ring = audit_mod.AuditRing("verify", rank=0, capacity=4)
+    for seq in range(1, 4):
+        rec = ring.begin(seq, "allreduce_array", np.zeros(4), {})
+        ring.commit(rec, np.ones(4))
+    d1 = ring.take_delta()
+    assert [r["seq"] for r in d1["records"]] == [1, 2, 3]
+    assert ring.take_delta() is None          # nothing new
+    # overflow unshipped records: the drop is REPORTED, never silent
+    for seq in range(4, 10):
+        rec = ring.begin(seq, "allreduce_array", np.zeros(4), {})
+        ring.commit(rec, np.ones(4))
+    d2 = ring.take_delta()
+    assert d2["dropped"] == 2                 # 4 and 5 fell off
+    assert [r["seq"] for r in d2["records"]] == [6, 7, 8, 9]
+
+
+def test_digest_mode_ships_nothing_capture_strips_payload():
+    ring = audit_mod.AuditRing("digest", rank=0, capacity=8)
+    rec = ring.begin(1, "allreduce_array", np.zeros(4), {})
+    ring.commit(rec, np.ones(4))
+    assert ring.take_delta() is None          # record-only mode
+    cap = audit_mod.AuditRing("capture", rank=0, capacity=8)
+    rec = cap.begin(1, "allreduce_array", np.arange(4.0), {})
+    cap.commit(rec, np.ones(4))
+    delta = cap.take_delta()
+    assert "cap" not in delta["records"][0]   # bytes stay off the wire
+    assert "cap" in cap.records()[0]          # ...but in the bundle
+
+
+# ----------------------------------------------------------------------
+# ClusterAuditor (pure state machine)
+# ----------------------------------------------------------------------
+def _rec(seq, fam="allreduce_array", out=7, wire=None, **kw):
+    return {"seq": seq, "fam": fam, "sig": "x", "in": 1, "out": out,
+            **({"wire": wire} if wire else {}), **kw}
+
+
+def test_cluster_auditor_verifies_and_flags_minority():
+    a = audit_mod.ClusterAuditor(3)
+    live = {0, 1, 2}
+    assert a.fold(0, {"records": [_rec(1)]}, live) == []
+    assert a.fold(1, {"records": [_rec(1)]}, live) == []
+    lines = a.fold(2, {"records": [_rec(1)]}, live)
+    assert lines == [] and a.verified_seq == 1
+    # seq 2: rank 1 diverges
+    a.fold(0, {"records": [_rec(2)]}, live)
+    a.fold(1, {"records": [_rec(2, out=99)]}, live)
+    lines = a.fold(2, {"records": [_rec(2)]}, live)
+    assert len(lines) == 1
+    msg = lines[0]
+    assert "collective #2" in msg and "allreduce_array" in msg
+    assert "[1]" in msg                      # minority rank named
+    assert a.divergence_total == 1
+    assert a.verified_seq == 1               # watermark did not advance
+
+
+def test_cluster_auditor_wire_mismatch_names_pair_and_transport():
+    a = audit_mod.ClusterAuditor(2)
+    live = {0, 1}
+    # outputs AGREE (consistent-wrong) — only the wire folds disagree
+    a.fold(0, {"records": [_rec(
+        1, wire={"1": {"t": "shm", "s": [111, 64], "r": [222, 64]}})]},
+        live)
+    lines = a.fold(1, {"records": [_rec(
+        1, wire={"0": {"t": "shm", "s": [222, 64], "r": [999, 64]}})]},
+        live)
+    assert len(lines) == 1
+    assert "rank 0 -> rank 1" in lines[0] and "shm" in lines[0]
+
+
+def test_cluster_auditor_schedule_divergence_and_rooted_families():
+    a = audit_mod.ClusterAuditor(2)
+    live = {0, 1}
+    a.fold(0, {"records": [_rec(1, fam="reduce_array", out=1)]}, live)
+    # rooted family with differing outputs: legitimately NOT compared
+    assert a.fold(1, {"records": [_rec(1, fam="reduce_array", out=2)]},
+                  live) == []
+    assert a.verified_seq == 1
+    a.fold(0, {"records": [_rec(2, fam="allreduce_array")]}, live)
+    lines = a.fold(1, {"records": [_rec(2, fam="broadcast_array")]},
+                   live)
+    assert len(lines) == 1 and "schedule" in lines[0]
+
+
+def test_cluster_auditor_bounds_pending():
+    a = audit_mod.ClusterAuditor(2)
+    live = {0, 1}
+    # rank 1 never reports: pending must stay bounded, with the loss
+    # counted — not grow for the job's lifetime
+    recs = [_rec(s) for s in range(1, 600)]
+    a.fold(0, {"records": recs}, live)
+    assert len(a._pending) <= 512
+    assert a.unverified_dropped >= 80
+
+
+# ----------------------------------------------------------------------
+# the corrupt fault kind (satellite)
+# ----------------------------------------------------------------------
+def test_corrupt_fault_parses_and_is_one_shot():
+    plan = faults_mod.FaultPlan.parse("corrupt:rank=1:nth=2")
+    assert plan.faults[0].action == "corrupt"
+    inj = faults_mod.FaultInjector(plan, 1)
+
+    class _Ch:
+        peer_rank = 3
+
+    inj.on_collective(1)
+    assert inj.take_corrupt(_Ch(), 1 << 20) is None   # not armed yet
+    inj.on_collective(2)
+    assert inj.take_corrupt(_Ch(), 1024) is None      # below CORRUPT_MIN
+    assert inj.take_corrupt(_Ch(), 1 << 20) is not None
+    assert inj.take_corrupt(_Ch(), 1 << 20) is None   # one-shot
+
+
+def test_corrupt_copy_is_deterministic_and_never_mutates():
+    buf = bytes(range(256)) * 64
+    out1 = faults_mod.corrupt_copy(buf)
+    out2 = faults_mod.corrupt_copy(buf)
+    assert out1 == out2 and out1 != buf
+    assert buf == bytes(range(256)) * 64
+    arr = np.arange(4096, dtype=np.float64)
+    keep = arr.copy()
+    flipped = faults_mod.corrupt_copy(arr)
+    assert np.array_equal(arr, keep)            # caller untouched
+    assert not np.array_equal(flipped, arr)
+    assert (flipped != arr).sum() == 1          # exactly one element
+
+
+# ----------------------------------------------------------------------
+# the acceptance grid: corrupt detection across transports and planes
+# ----------------------------------------------------------------------
+def _grid_body(path):
+    if path == "map":
+        def fn(slave, r):
+            d = {int(k): np.float64((r + 1) * k) for k in range(1200)}
+            slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+            slave.barrier()
+            slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+            return d
+        return fn, {}
+
+    def fn(slave, r):
+        arr = np.arange(120_000, dtype=np.float64) * (r + 1)
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        slave.barrier()
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        return arr
+    return fn, {"native_transport": path == "raw"}
+
+
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
+@pytest.mark.parametrize("path", ["raw", "framed", "map"])
+def test_corrupt_fault_detected_and_named(path, transport):
+    """A flipped payload byte must be flagged with the collective
+    ordinal and the ranks involved — even when the corrupted
+    contribution folds into a reduce and every rank's OUTPUT is
+    equal-but-wrong (the wire-digest check's whole reason to exist)."""
+    fn, kw = _grid_body(path)
+    kw.update({} if transport == "shm" else {"shm": False})
+    _, errors, master, log = run_audited(
+        N, fn, fault_plan="corrupt:rank=1:nth=2", **kw)
+    assert all(e is None for e in errors), (errors, log)
+    st = master.audit_status()
+    assert st["divergences"] >= 1, (st, log)
+    msgs = " | ".join(d["msg"] for d in st["last_divergences"])
+    assert "collective #2" in msgs, msgs
+    assert "rank 1" in msgs, msgs        # the corrupting rank named
+    assert transport in msgs, msgs       # transport attribution
+    assert "DIVERGENCE" in log
+
+
+def test_corrupt_detected_on_live_master_within_heartbeat():
+    """Detection is LIVE, not a close-time artifact: with the job
+    still running (ranks parked before close), the master flags the
+    divergence within ~one heartbeat interval of the faulted
+    collective."""
+    fn, kw = _grid_body("raw")
+    ready, release = threading.Event(), threading.Event()
+    holder = {}
+
+    def wrapped(slave, r):
+        out = fn(slave, r)
+        holder.setdefault("t0", time.monotonic())
+        return out
+
+    def check():
+        ready.wait(JOIN)
+        deadline = time.monotonic() + 5 * tuning.heartbeat_secs() + 2.0
+        while time.monotonic() < deadline:
+            if holder.get("master").audit_status()["divergences"]:
+                holder["latency"] = time.monotonic() - holder["t0"]
+                break
+            time.sleep(0.05)
+        release.set()
+
+    checker = threading.Thread(target=check, daemon=True)
+    checker.start()
+
+    # run_audited sets hold=(ready, release): workers park after fn
+    # until the checker observed the live master
+    log = io.StringIO()
+    master = Master(N, timeout=JOIN, log_stream=log).serve_in_thread()
+    holder["master"] = master
+    errors = [None] * N
+
+    def worker(i):
+        slave = None
+        try:
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=JOIN,
+                fault_plan="corrupt:rank=1:nth=2", audit="verify",
+                dead_rank_secs=20.0, **kw)
+            wrapped(slave, slave.rank)
+            ready.set()
+            release.wait(JOIN)
+            slave.close(0)
+        except Exception as e:
+            errors[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(JOIN)
+    assert not any(t.is_alive() for t in threads), log.getvalue()
+    checker.join(5.0)
+    master.join(10.0)
+    assert all(e is None for e in errors), errors
+    assert "latency" in holder, "divergence never observed live"
+    assert holder["latency"] <= 5 * tuning.heartbeat_secs() + 2.0
+
+
+# ----------------------------------------------------------------------
+# zero false divergences: clean grid + recovery interaction
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
+def test_clean_property_grid_zero_false_divergences(transport):
+    """A clean multi-collective, multi-operand, multi-plane run under
+    MP4J_AUDIT=verify must verify every seq and flag nothing."""
+    rng = np.random.default_rng(5)
+    base = rng.integers(1, 100, 30_000)
+
+    def fn(slave, r):
+        n_coll = 0
+        for operand, operator in ((Operands.DOUBLE, Operators.SUM),
+                                  (Operands.INT, Operators.MAX),
+                                  (Operands.FLOAT, Operators.MIN)):
+            arr = (base % 97).astype(operand.dtype) * (r + 1)
+            slave.allreduce_array(arr, operand, operator)
+            n_coll += 1
+        arr = base.astype(np.float64)
+        slave.broadcast_array(arr, Operands.DOUBLE, root=1)
+        slave.reduce_array(arr, Operands.DOUBLE, Operators.SUM, root=2)
+        slave.allgather_array(arr, Operands.DOUBLE)
+        n_coll += 3
+        d = {int(k): np.float64((r + 1) * (k % 31)) for k in range(900)}
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        slave.broadcast_map(d, Operands.DOUBLE, root=3)
+        n_coll += 2
+        return n_coll
+
+    kw = {} if transport == "shm" else {"shm": False}
+    results, errors, master, log = run_audited(N, fn, **kw)
+    assert all(e is None for e in errors), (errors, log)
+    st = master.audit_status()
+    assert st["divergences"] == 0, (st, log)
+    assert st["verified_seq"] == results[0], st
+    assert st["dropped_records"] == 0
+
+
+def test_reset_recovery_under_verify_no_false_divergence():
+    """An epoch-fenced retry resends everything on a fresh wire; the
+    failed attempt's folds must be reset on BOTH sides or every
+    recovered seq would false-diverge."""
+    fn, kw = _grid_body("raw")
+    want, werr, _, _ = run_audited(N, fn, fault_plan=None, **kw)
+    assert all(e is None for e in werr)
+    got, errors, master, log = run_audited(
+        N, fn, fault_plan="reset:rank=1:nth=2", **kw)
+    assert all(e is None for e in errors), (errors, log)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    st = master.audit_status()
+    assert st["divergences"] == 0, (st, log)
+    assert st["verified_seq"] == 2, st
+
+
+def test_restored_snapshot_digest_mismatch_is_machine_checked():
+    """Reintroduce the PR 5 snapshot-corruption bug (shallow value
+    copies + an in-place operator) and inject a reset AFTER a merge
+    has mutated the shared values: the retry must be REFUSED with an
+    error naming the snapshot digest mismatch — never silently wrong
+    'recovered' results."""
+    iadd = Operator.custom(
+        "IADD", lambda a, b: (a.__setitem__(0, a[0] + b[0]), a)[1],
+        [0.0])
+
+    def fn(slave, r):
+        d = {k: [float((r + 1) * k)] for k in range(60)}
+        slave.allreduce_map(d, Operands.OBJECT_OPERAND(), iadd)
+        slave.barrier()
+        slave.allreduce_map(d, Operands.OBJECT_OPERAND(), iadd)
+        return d
+
+    orig = pc._copy_value
+    pc._copy_value = lambda v: v
+    try:
+        # peer=2 pin: rank 0 merges rank 1's contribution FIRST, then
+        # the cut on the rank-2 channel triggers the retry from the
+        # (now tainted) shallow snapshot. Two rarer interleavings are
+        # also legitimate — the abort teardown can kill the rank-1
+        # recv before any merge (snapshot never tainted, clean retry),
+        # or the job can go terminal before a restore runs — so retry
+        # the scenario until the tainted path materializes; it does on
+        # the first run in the overwhelming majority of runs.
+        named = []
+        for _ in range(4):
+            _, errors, _, log = run_audited(
+                N, fn, fault_plan="reset:rank=0:nth=2:peer=2")
+            named = [e for e in errors if e is not None
+                     and "snapshot" in str(e) and "digest" in str(e)]
+            if named:
+                break
+    finally:
+        pc._copy_value = orig
+    assert named, (errors, log)
+    assert "collective #2" in str(named[0])
+
+
+# ----------------------------------------------------------------------
+# record/replay (tentpole second half)
+# ----------------------------------------------------------------------
+def _replay_body(slave, r):
+    # exact-value floats: the thread-backend replay must reproduce the
+    # socket schedules bit-for-bit (order-insensitive value/operator
+    # combos, the cross-backend property-grid guarantee)
+    arr = (np.arange(60_000) % 97).astype(np.float64) * (r + 1)
+    slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+    slave.barrier()
+    d = {int(k): np.float64((r + 1) * (k % 31)) for k in range(800)}
+    slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+    slave.barrier()
+    slave.broadcast_array(arr, Operands.DOUBLE, root=2)
+    return arr
+
+
+def _dump_body(dump_dir):
+    def fn(slave, r):
+        out = _replay_body(slave, r)
+        slave.dump_audit(dump_dir)
+        return out
+    return fn
+
+
+def test_replay_clean_bundle_all_clean(tmp_path, capsys):
+    d = str(tmp_path / "bundle")
+    _, errors, _, log = run_audited(N, _dump_body(d), audit="capture")
+    assert all(e is None for e in errors), (errors, log)
+    assert obs_cli.main(["replay", d]) == 0
+    out = capsys.readouterr().out
+    assert "all records clean" in out
+    assert "#1 allreduce_array: ok" in out
+    assert "#2 allreduce_map: ok" in out
+    assert "#3 broadcast_array: ok" in out
+
+
+def test_replay_reproduces_injected_divergence(tmp_path, capsys):
+    d = str(tmp_path / "bundle")
+    _, errors, master, log = run_audited(
+        N, _dump_body(d), audit="capture",
+        fault_plan="corrupt:rank=1:nth=1")
+    assert all(e is None for e in errors), (errors, log)
+    # the live plane flagged it...
+    assert master.audit_status()["divergences"] >= 1
+    # ...and the offline replay reproduces it digest-for-digest, with
+    # no cluster: the recorded (corrupted) output digests disagree
+    # with the clean re-execution at exactly the faulted record
+    assert obs_cli.main(["replay", d]) == 1
+    out = capsys.readouterr().out
+    assert "#1 allreduce_array: DIVERGED" in out
+    assert "recorded" in out and "replayed" in out
+    assert "#2 allreduce_map: ok" in out
+
+
+def test_replay_without_capture_skips_not_crashes(tmp_path, capsys):
+    d = str(tmp_path / "bundle")
+    _, errors, _, _ = run_audited(N, _dump_body(d), audit="verify")
+    assert all(e is None for e in errors)
+    assert obs_cli.main(["replay", d]) == 0
+    out = capsys.readouterr().out
+    assert "SKIP" in out and "capture" in out
+
+
+def test_replay_nonstd_call_marked(tmp_path):
+    """A call with non-default ranges must be recorded as
+    non-replayable, not replayed as a different call."""
+    def fn(slave, r):
+        arr = np.arange(4096, dtype=np.float64)
+        ranges = [(i * 1024, (i + 1) * 1024) for i in range(N)]
+        slave.allgather_array(arr, Operands.DOUBLE, ranges=ranges)
+        slave.dump_audit(str(tmp_path))
+        return arr
+
+    _, errors, _, _ = run_audited(N, fn, audit="capture")
+    assert all(e is None for e in errors)
+    docs = audit_mod.load_audit_bundles(str(tmp_path))
+    assert all(doc["records"][0].get("nonstd")
+               for doc in docs.values())
+    assert all(doc["slave_num"] == N for doc in docs.values())
+    text, diverged = audit_mod.replay_bundle(str(tmp_path))
+    assert diverged == 0 and "non-default args" in text
+
+
+def test_replay_survives_corrupt_capture_and_bad_records(tmp_path):
+    """Torn capture bytes are the artifact replay exists to diagnose:
+    a record whose payload fails to DECODE reports CAPTURE CORRUPT
+    (never a traceback), a record whose re-execution RAISES reports
+    REPLAY ERROR with the exception text and a fresh thread group —
+    and the remaining records still replay cleanly."""
+    d = str(tmp_path / "bundle")
+    _, errors, _, _ = run_audited(N, _dump_body(d), audit="capture")
+    assert all(e is None for e in errors)
+    for rank in range(N):
+        p = tmp_path / "bundle" / f"rank_{rank:04d}" / "audit.json"
+        doc = json.loads(p.read_text())
+        doc["records"][0]["root"] = 99          # execution raises
+        doc["records"][1]["cap"] = "AAAA"       # valid b64, torn zlib
+        p.write_text(json.dumps(doc))
+    text, diverged = audit_mod.replay_bundle(str(tmp_path / "bundle"))
+    assert diverged == 2, text
+    assert "#1 allreduce_array: REPLAY ERROR" in text
+    assert "TypeError" in text                  # real diagnosis kept
+    assert "#2 allreduce_map: CAPTURE CORRUPT" in text
+    assert "#3 broadcast_array: ok" in text     # fresh group works
+
+
+def test_capture_skips_oversized_payload_without_pickling():
+    ring = audit_mod.AuditRing("capture", rank=0, capacity=4)
+    big = np.zeros(audit_mod.CAPTURE_MAX_BYTES // 8 + 16, np.float64)
+    t0 = time.perf_counter()
+    rec = ring.begin(1, "allreduce_array", big, {})
+    dt = time.perf_counter() - t0
+    assert rec.get("capskip") and "cap" not in rec
+    # the size floor must short-circuit BEFORE the full pickle pass
+    # (a serialize of 8 MiB takes far longer than the digest alone)
+    assert dt < 0.2, dt
+
+
+def test_replay_degrades_when_ranks_left_no_bundle(tmp_path):
+    """A dead rank's bundle is gone: replay must degrade to the
+    recorded cross-rank comparison — including when the DEAD rank is
+    the highest one, which rank-contiguity alone cannot detect (the
+    dump's slave_num is the load-bearing signal; re-executing with
+    the wrong group size would flag every record of a run whose only
+    fault was the kill)."""
+    import shutil
+
+    d = str(tmp_path / "bundle")
+    _, errors, _, _ = run_audited(N, _dump_body(d), audit="capture")
+    assert all(e is None for e in errors)
+    # dead MIDDLE rank
+    mid = str(tmp_path / "mid")
+    shutil.copytree(d, mid)
+    shutil.rmtree(mid + "/rank_0001")
+    text, diverged = audit_mod.replay_bundle(mid)
+    assert diverged == 0, text
+    assert "cannot re-execute" in text and "[1]" in text
+    assert "ok (recorded)" in text
+    # dead HIGHEST rank: bundles 0..2 look contiguous
+    hi = str(tmp_path / "hi")
+    shutil.copytree(d, hi)
+    shutil.rmtree(hi + f"/rank_{N - 1:04d}")
+    text2, diverged2 = audit_mod.replay_bundle(hi)
+    assert diverged2 == 0, text2
+    assert "cannot re-execute" in text2 and f"[{N - 1}]" in text2
+
+
+def test_ranged_collective_under_verify_no_false_divergence():
+    """Explicit from_/to sub-range calls digest the whole payload but
+    replicate only the range — bytes outside it legitimately differ
+    per rank and must NOT trip the output comparison (the wire check
+    still covers the range that moved)."""
+    def fn(slave, r):
+        arr = np.arange(30_000, dtype=np.float64) * (r + 1)
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM,
+                              from_=1000, to=20_000)
+        return arr
+
+    _, errors, master, log = run_audited(N, fn)
+    assert all(e is None for e in errors), (errors, log)
+    st = master.audit_status()
+    assert st["divergences"] == 0, (st, log)
+
+
+# ----------------------------------------------------------------------
+# postmortem integration: audit.json + known-good watermark (satellite)
+# ----------------------------------------------------------------------
+def test_postmortem_carries_audit_and_watermark(tmp_path):
+    pm = str(tmp_path / "pm")
+
+    def fn(slave, r):
+        arr = (np.arange(30_000) % 97).astype(np.float64)
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        slave.barrier()
+        # let the heartbeat ship seq 1's records before the kill, so
+        # the master's watermark has something to stand on
+        time.sleep(3 * tuning.heartbeat_secs())
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        return arr
+
+    _, errors, master, log = run_audited(
+        N, fn, fault_plan="kill:rank=2:nth=2", audit="verify",
+        postmortem_dir=pm, master_kwargs={"postmortem_dir": pm})
+    survivors = [e for i, e in enumerate(errors) if i != 2]
+    assert all(e is not None for e in survivors), (errors, log)
+    # survivors' bundles carry audit.json
+    bundles = audit_mod.load_audit_bundles(pm)
+    assert set(bundles) >= {0, 1, 3}
+    with open(str(tmp_path / "pm" / "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["audit"]["verified_seq"] == 1
+    report = postmortem_mod.merge_report(pm)
+    assert "known-good watermark: collective #1" in report
+    assert "DEAD rank 2" in report
+
+
+# ----------------------------------------------------------------------
+# live view + Prometheus families (satellite)
+# ----------------------------------------------------------------------
+def test_prometheus_audit_families_and_live_column():
+    doc = {
+        "slave_num": 2, "window_secs": 60.0,
+        "ranks": {
+            "0": {"progress": {"seq": 5, "current": None, "last": "x",
+                               "phase": None, "current_secs": 0.0},
+                  "age": 0.1, "stats": {}, "rates": {}, "histograms": {},
+                  "audit_seq": 5},
+            "1": {"progress": {"seq": 5, "current": None, "last": "x",
+                               "phase": None, "current_secs": 0.0},
+                  "age": 0.1, "stats": {}, "rates": {}, "histograms": {},
+                  "audit_seq": 4},
+        },
+        "cluster": {"stats": {}, "rates": {}, "histograms": {},
+                    "audit": {"verified_seq": 4, "verified_total": 4,
+                              "divergences": 2,
+                              "last_divergences": [
+                                  {"seq": 5, "kind": "output",
+                                   "msg": "collective #5 diverged"}],
+                              "dropped_records": 0,
+                              "unverified_dropped": 0,
+                              "rank_seq": {"0": 5, "1": 4}}},
+    }
+    text = metrics_mod.to_prometheus(doc)
+    assert "mp4j_audit_divergences_total 2" in text
+    assert "mp4j_audit_verified_seqs 4" in text
+    assert "mp4j_audit_verified_seq_watermark 4" in text
+    live = telemetry.format_live(doc)
+    assert "audit: verified through collective #4" in live
+    assert "2 divergence(s)" in live
+    assert "collective #5 diverged" in live
+    assert "aud" in live.splitlines()[3]      # column header
+    # live metrics doc from a real master run wires audit_seq per rank
+    rows = [ln for ln in live.splitlines() if ln.lstrip().startswith(
+        ("0 ", "1 "))]
+    assert any(" 5 " in r for r in rows)
+
+
+def test_live_master_doc_carries_audit():
+    """End-to-end: the verify-mode master's metrics document includes
+    the audit section, and the analytic families render."""
+    fn, kw = _grid_body("raw")
+    log = io.StringIO()
+    master = Master(N, timeout=JOIN, log_stream=log,
+                    metrics_port=0).serve_in_thread()
+    errors = []
+
+    def worker(i):
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port, timeout=JOIN,
+                                 audit="verify", **kw)
+            fn(s, s.rank)
+            s.close(0)
+        except Exception as e:
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(N)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(JOIN)
+    master.join(10.0)
+    assert not errors, errors
+    doc = master.metrics_doc()
+    audit = doc["cluster"]["audit"]
+    assert audit["verified_seq"] == 2 and audit["divergences"] == 0
+    text = metrics_mod.to_prometheus(doc)
+    assert "mp4j_audit_divergences_total 0" in text
+    assert "mp4j_audit_verified_seq_watermark 2" in text
+
+
+# ----------------------------------------------------------------------
+# hybrid (thread-backend) pass-through
+# ----------------------------------------------------------------------
+def test_thread_group_audit_passthrough(tmp_path):
+    from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
+
+    log = io.StringIO()
+    master = Master(1, timeout=JOIN, log_stream=log).serve_in_thread()
+    slaves = ThreadCommSlave.spawn_group(
+        2, "127.0.0.1", master.port, audit="digest")
+    errors = []
+
+    def worker(s):
+        try:
+            arr = np.arange(1024, dtype=np.float64) * (s.rank + 1)
+            s.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+            s.close(0)
+        except Exception as e:
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(s,), daemon=True)
+          for s in slaves]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(JOIN)
+    master.join(10.0)
+    assert not errors, errors
+    # n=1 process job: the process-level collective never runs (no
+    # peers), so the ring may be empty — the API contract is that the
+    # accessor works and standalone groups return []
+    assert isinstance(slaves[0].audit_records(), list)
+    standalone = ThreadCommSlave.spawn_group(2)
+    assert standalone[0].audit_records() == []
+    assert standalone[0].dump_audit(str(tmp_path)) is None
+    for s in standalone:
+        s.close(0)

@@ -14,6 +14,7 @@
 | R10 | error   | peer-channel I/O bypassing the epoch fence |
 | R11 | error   | wall clock feeding duration/deadline arithmetic |
 | R12 | error   | transport construction outside transport/ (SPI) |
+| R13 | error   | raw-byte read of a possibly non-contiguous array |
 """
 
 from __future__ import annotations
@@ -41,6 +42,8 @@ from ytk_mp4j_tpu.analysis.rules.r11_wall_clock import (
     R11WallClockDuration)
 from ytk_mp4j_tpu.analysis.rules.r12_transport_spi import (
     R12TransportSpiBypass)
+from ytk_mp4j_tpu.analysis.rules.r13_digest_contiguity import (
+    R13DigestContiguity)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -55,6 +58,7 @@ ALL_RULES = [
     R10EpochFenceBypass,
     R11WallClockDuration,
     R12TransportSpiBypass,
+    R13DigestContiguity,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

@@ -46,6 +46,7 @@ import threading
 import time
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.obs import audit as audit_mod
 from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import postmortem as postmortem_mod
 from ytk_mp4j_tpu.obs import telemetry as telemetry_mod
@@ -145,6 +146,11 @@ class Master:
         self._fatal_msg: str | None = None      # terminal abort, once
         # rank -> last heartbeat: progress fields + stats + arrival time
         self._telemetry: dict[int, dict] = {}
+        # audit plane (ISSUE 8): folds heartbeat digest-record deltas
+        # and flags cross-rank divergences (obs.audit.ClusterAuditor);
+        # passive — it only ever sees records when slaves run
+        # MP4J_AUDIT=verify|capture
+        self._auditor = audit_mod.ClusterAuditor(slave_num)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -516,7 +522,16 @@ class Master:
         keys/s stay derivable without a second pass."""
         progress = payload.get("progress") or {}
         now = time.monotonic()
+        audit_lines: list[str] = []
         with self._lock:
+            if "audit_delta" in payload:
+                # verification happens as records complete — a flagged
+                # divergence is logged within one heartbeat of the last
+                # rank's record arriving; log lines emitted OUTSIDE the
+                # lock below
+                live = set(range(self.slave_num)) - set(self._departed)
+                audit_lines = self._auditor.fold(
+                    rank, payload.get("audit_delta"), live)
             prev = self._telemetry.get(rank)
             if "stats_delta" in payload:
                 stats = stats_mod.merge_snapshots(
@@ -555,6 +570,8 @@ class Master:
                                            + v - before.get(k, 0))
             self._rank_totals[rank] = totals
             self._cluster_window.note(now, self._cluster_totals)
+        for line in audit_lines:
+            self._log("M", "ERROR", line)
 
     def _handle_diagnose(self, rank: int, payload: dict) -> None:
         """A slave's bounded collective wait expired: refresh its table
@@ -695,8 +712,12 @@ class Master:
                 }
             cluster_rates = self._cluster_window.rates()
             cluster_metrics = self._cluster_metrics
+            audit_status = self._auditor.status()
         cluster_stats = stats_mod.merge_snapshots(
             *(info["stats"] for info in ranks.values()))
+        for r, info in ranks.items():
+            info["audit_seq"] = int(
+                audit_status["rank_seq"].get(r, 0))
         return {
             "slave_num": self.slave_num,
             "window_secs": self._metrics_window,
@@ -705,8 +726,17 @@ class Master:
                 "stats": cluster_stats,
                 "rates": cluster_rates,
                 "histograms": cluster_metrics["histograms"],
+                "audit": audit_status,
             },
         }
+
+    def audit_status(self) -> dict:
+        """The cluster audit document (ISSUE 8): last cross-rank-
+        verified collective ordinal, divergence count, recent
+        divergence details (schema: obs.audit.ClusterAuditor.status).
+        All zeros unless slaves run ``MP4J_AUDIT=verify|capture``."""
+        with self._lock:
+            return self._auditor.status()
 
     def _write_postmortem_manifest(self) -> None:
         """Flight-recorder manifest (once per write site, idempotent
@@ -715,6 +745,7 @@ class Master:
         with self._lock:
             reason = self._fatal_msg
             departed = dict(self._departed)
+            audit_status = self._auditor.status()
         if not self._postmortem_dir or reason is None:
             return
         # ONE table snapshot feeds both fields, so the manifest's
@@ -725,7 +756,8 @@ class Master:
                 self._postmortem_dir, slave_num=self.slave_num,
                 reason=reason, table=table, departed=departed,
                 diagnosis=telemetry_mod.render_diagnosis(
-                    table, self.slave_num))
+                    table, self.slave_num),
+                audit=audit_status)
         except OSError:
             pass  # best-effort: the job is already terminal
 

@@ -45,6 +45,7 @@ from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm import keycodec
 from ytk_mp4j_tpu.comm import master as master_mod
 from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.obs import audit as audit_mod
 from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import postmortem
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
@@ -56,7 +57,7 @@ from ytk_mp4j_tpu.resilience import faults as faults_mod
 from ytk_mp4j_tpu.resilience.recovery import RecoveryManager
 from ytk_mp4j_tpu.transport import shm as shm_mod
 from ytk_mp4j_tpu.transport import tcp as tcp_mod
-from ytk_mp4j_tpu.transport.channel import Channel
+from ytk_mp4j_tpu.transport.channel import Channel, _raw_view
 from ytk_mp4j_tpu.transport.tcp import connect
 from ytk_mp4j_tpu.utils import native, trace, tuning
 from ytk_mp4j_tpu.utils import stats as stats_mod
@@ -134,7 +135,8 @@ class ProcessCommSlave(CommSlave):
                  reconnect_backoff: float | None = None,
                  dead_rank_secs: float | None = None,
                  fault_plan=None,
-                 postmortem_dir: str | None = None):
+                 postmortem_dir: str | None = None,
+                 audit: str | None = None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -188,7 +190,14 @@ class ProcessCommSlave(CommSlave):
         disables) arms the flight recorder (ISSUE 6): on any terminal
         abort this rank dumps a postmortem bundle (span-ring Chrome
         trace, stats snapshot, metric histograms, epoch/retry log)
-        there before raising."""
+        there before raising.
+
+        ``audit`` (ISSUE 8; None reads ``MP4J_AUDIT``, default
+        ``digest``) selects the correctness-auditing mode —
+        ``off|digest|verify|capture`` (:mod:`ytk_mp4j_tpu.obs.audit`).
+        JOB-wide like ``native_transport``: cross-rank digest
+        comparison assumes every rank digests the same schedule the
+        same way."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
         self._handshake_timeout = handshake_timeout
@@ -239,6 +248,13 @@ class ProcessCommSlave(CommSlave):
         self._map_codecs: dict[str, object] = {}
         self._scratch = _ScratchPool()
         self._comm_stats = CommStats()
+        # audit plane (ISSUE 8): mode validated up front like every
+        # other job-wide knob; ``off`` keeps _audit None so the hot
+        # path pays one attribute check
+        audit_mode = tuning.audit_mode(audit)
+        self._audit = (None if audit_mode == "off"
+                       else audit_mod.AuditRing(audit_mode))
+        self._comm_stats.audit = self._audit  # channels reach it here
         # own listen socket on an ephemeral port. Buffer-size knobs
         # apply BEFORE listen(): accepted peer sockets inherit them,
         # and the TCP window scale is fixed at the handshake.
@@ -284,6 +300,9 @@ class ProcessCommSlave(CommSlave):
         # log send would corrupt the control plane
         self._master_lock = threading.Lock()
         self._comm_stats.rank = self._rank  # tags spans + heartbeats
+        if self._audit is not None:
+            self._audit.rank = self._rank   # tags the audit bundle
+            self._audit.slave_num = self._n  # replay's dead-rank guard
 
         # peer channels: canonical rule — the HIGHER rank connects to the
         # lower rank's listen socket; one duplex channel per pair.
@@ -548,8 +567,16 @@ class ProcessCommSlave(CommSlave):
             md = metrics_mod.diff_snapshot(mets, self._tel_last_metrics)
             self._tel_last_stats = stats
             self._tel_last_metrics = mets
-        return {"progress": self._comm_stats.progress(),
-                "stats_delta": sd, "metrics_delta": md}
+        payload = {"progress": self._comm_stats.progress(),
+                   "stats_delta": sd, "metrics_delta": md}
+        if self._audit is not None:
+            # verify/capture ship digest records as deltas (the audit
+            # ring keeps its own cursor, bounded like the stats delta);
+            # digest mode is record-only and ships nothing
+            ad = self._audit.take_delta()
+            if ad is not None:
+                payload["audit_delta"] = ad
+        return payload
 
     def _heartbeat_loop(self) -> None:
         while True:
@@ -598,7 +625,9 @@ class ProcessCommSlave(CommSlave):
                 stats=self._comm_stats.snapshot(),
                 metrics=self._comm_stats.metrics.snapshot(),
                 epoch=self._recovery.epoch,
-                events=self._recovery.events())
+                events=self._recovery.events(),
+                audit=(self._audit.dump() if self._audit is not None
+                       else None))
         except OSError:
             pass  # the recorder must never worsen a dying job
 
@@ -653,6 +682,23 @@ class ProcessCommSlave(CommSlave):
         phase (schema: :mod:`ytk_mp4j_tpu.obs.telemetry`). The same
         record the heartbeat ships to the master."""
         return self._comm_stats.progress()
+
+    def audit_records(self) -> list[dict]:
+        """This rank's audit record ring (ISSUE 8; empty when
+        ``MP4J_AUDIT=off``): one record per outermost collective —
+        ordinal, family, operand signature, input/output digests,
+        wire folds (verify) and captured payloads (capture)."""
+        return [] if self._audit is None else self._audit.records()
+
+    def dump_audit(self, root: str) -> str | None:
+        """Write this rank's ``rank_NNNN/audit.json`` under ``root``
+        — the replay-bundle layout (``mp4j-scope replay``); the same
+        file joins the postmortem bundle automatically on a terminal
+        abort. Returns the path, or None with auditing off."""
+        if self._audit is None:
+            return None
+        return audit_mod.write_rank_audit(root, self._rank,
+                                          self._audit.dump())
 
     # ------------------------------------------------------------------
     # peer transport
@@ -1014,6 +1060,22 @@ class ProcessCommSlave(CommSlave):
                 self._faults.on_io(recv_ch, "recv")
         if sarr is not None:
             sarr = np.ascontiguousarray(sarr)
+        # audit wire folds at EXCHANGE granularity (ISSUE 8): the
+        # native poll loop and the shm rings move raw bytes below the
+        # Python channel primitives, so the raw plane digests whole
+        # segments here — crc composability makes these folds
+        # comparable with the peer's, whatever its chunking
+        wire_audit = (self._audit if self._audit is not None
+                      and self._audit.wire_on else None)
+        if wire_audit is not None and sarr is not None:
+            # fold BEFORE any injected corruption: the sender's record
+            # describes what it meant to send (see resilience.faults)
+            wire_audit.on_wire(send_peer, "send", (_raw_view(sarr),),
+                               send_ch.transport)
+        if self._faults is not None and sarr is not None:
+            f = self._faults.take_corrupt(send_ch, sarr.nbytes)
+            if f is not None:
+                sarr = faults_mod.corrupt_copy(sarr)
         sides = " ".join(
             ([f"send->{send_peer}"] if sarr is not None else [])
             + ([f"recv<-{recv_peer}"] if rarr is not None else []))
@@ -1076,6 +1138,9 @@ class ProcessCommSlave(CommSlave):
             raise Mp4jTransportError(
                 f"raw exchange ({sides}) failed: {e}") from None
         dt = time.perf_counter() - t0
+        if wire_audit is not None and rarr is not None:
+            wire_audit.on_wire(recv_peer, "recv", (_raw_view(rarr),),
+                               recv_ch.transport)
         sbytes = 0 if sarr is None else sarr.nbytes
         rbytes = 0 if rarr is None else rarr.nbytes
         if (send_ch is not None and recv_ch is not None
@@ -2615,7 +2680,12 @@ def _restore_payload(x, saved) -> None:
 
 def _recovered(fn, snapshot: bool):
     """Wrap a collective method with the abort/retry engine (outermost
-    frame only — composed collectives recover as one unit)."""
+    frame only — composed collectives recover as one unit) and, since
+    ISSUE 8, with the audit plane's per-collective digest record: the
+    input digests at entry (before any wire byte moves), the output at
+    return, and every retry's restored snapshot is digest-compared
+    against the original attempt's input — the snapshot-corruption
+    class PR 5 fixed by hand is machine-checked here."""
     import inspect
 
     sig = inspect.signature(fn)
@@ -2625,6 +2695,48 @@ def _recovered(fn, snapshot: bool):
     if fn.__name__ in _SNAPSHOT_ROOT_ONLY and "root" in params:
         root_skip = (params.index("root") - 1,
                      sig.parameters["root"].default)
+    # audit metadata extraction (replay needs operand/operator/root
+    # by NAME): arg position + default per interesting param, plus the
+    # length of the leading (payload, operand/operator/root...) run —
+    # positional args past it (ranges, from_) mark the record
+    # non-replayable rather than replaying a different call
+    aud_params = {}
+    for _nm in ("operand", "operator", "root", "algo"):
+        if _nm in params:
+            aud_params[_nm] = (params.index(_nm) - 1,
+                               sig.parameters[_nm].default)
+    lead = 1
+    for _p in params[2:]:
+        if _p in ("operand", "operator", "root"):
+            lead += 1
+        else:
+            break
+    _STD_KW = frozenset({"operand", "operator", "root", "algo",
+                         payload_name})
+    _defaults = {p: sig.parameters[p].default for p in params[1:]}
+
+    def _aud_meta(args, kwargs) -> dict:
+        def pick(nm):
+            if nm not in aud_params:
+                return None
+            i, dflt = aud_params[nm]
+            return args[i] if len(args) > i else kwargs.get(nm, dflt)
+
+        meta: dict = {}
+        operand = pick("operand")
+        if operand is not None:
+            meta["operand"] = operand.name
+        operator = pick("operator")
+        if operator is not None:
+            meta["operator"] = operator.name
+        if "root" in aud_params:
+            meta["root"] = int(pick("root"))
+        nonstd_kw = any(kwargs[k] is not _defaults.get(k, None)
+                        and kwargs[k] != _defaults.get(k, None)
+                        for k in set(kwargs) - _STD_KW)
+        if len(args) > lead or nonstd_kw:
+            meta["nonstd"] = True
+        return meta
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
@@ -2642,12 +2754,20 @@ def _recovered(fn, snapshot: bool):
                 # (on_collective runs once per CALL), so a one-shot
                 # fault cannot re-fire into its own recovery
                 self._faults.on_collective(ordinal, self._fault_kill)
+            # the audit payload is extracted unconditionally (digest
+            # records cover every collective); the SNAPSHOT payload
+            # below keeps its own tighter rules
+            payload_a = args[0] if args else kwargs.get(payload_name)
+            audit = self._audit
+            arec = None
+            if audit is not None:
+                arec = audit.begin(ordinal, fn.__name__, payload_a,
+                                   _aud_meta(args, kwargs))
             payload = None
             if snapshot:
                 # by position OR keyword: a kwarg call must not skip
                 # the snapshot and silently retry on mutated input
-                payload = (args[0] if args
-                           else kwargs.get(payload_name))
+                payload = payload_a
                 if root_skip is not None:
                     ri, rdefault = root_skip
                     root = (args[ri] if len(args) > ri
@@ -2676,12 +2796,43 @@ def _recovered(fn, snapshot: bool):
                     for k, c in self._map_codecs.items():
                         c.truncate(sizes.get(k, 0))
                 _restore_payload(payload, saved)
+                if arec is None:
+                    return
+                # failed attempt's wire folds died in the drain on the
+                # peer side too — carrying them into the record would
+                # false-diverge every recovered seq
+                audit.reset_wire()
+                if payload is not None and saved is not None:
+                    # the machine check for PR 5's snapshot-corruption
+                    # class: the restored input must digest exactly as
+                    # the original attempt's input did — anything else
+                    # means the snapshot was mutated (shared mutable
+                    # values, a buggy operator) and a retry would
+                    # produce silently wrong 'recovered' results
+                    h, _sig = audit_mod.digest_payload(payload)
+                    if h != arec["in"]:
+                        raise Mp4jError(
+                            f"audit: restored retry snapshot of "
+                            f"'{fn.__name__}' (collective #{ordinal}) "
+                            f"digests {h:#018x}, original input was "
+                            f"{arec['in']:#018x} — the snapshot was "
+                            "corrupted (in-place operator mutating "
+                            "shared values?); refusing to retry from "
+                            "tainted input")
 
             try:
-                return rec.run(
-                    fn.__name__,
-                    lambda: fn(self, *args, **kwargs),
-                    preserve, restore)
+                try:
+                    out = rec.run(
+                        fn.__name__,
+                        lambda: fn(self, *args, **kwargs),
+                        preserve, restore)
+                except BaseException as e:
+                    if arec is not None:
+                        audit.abandon(arec, e)
+                    raise
+                if arec is not None:
+                    audit.commit(arec, payload_a)
+                return out
             finally:
                 self._progress_state = (ordinal, False)
                 # pooled snapshot buffers go back for the next call

@@ -169,6 +169,27 @@ class ThreadCommSlave(CommSlave):
         monotonically increasing, sequence number."""
         return self._g.comm_stats.progress()
 
+    def audit_records(self) -> list[dict]:
+        """The shared process slave's audit record ring (ISSUE 8).
+        In a hybrid job every thread-level collective funnels through
+        ONE process-level collective on thread 0, and THAT call is
+        what the audit plane records (the process slave owns the wire)
+        — so any thread may read/dump the group's audit state, exactly
+        like :meth:`stats`. Standalone groups have no wire and no
+        audit ring; they return []."""
+        if self._g.proc is not None:
+            return self._g.proc.audit_records()
+        return []
+
+    def dump_audit(self, root: str) -> str | None:
+        """Write the group's replay bundle file (see
+        ``ProcessCommSlave.dump_audit``); None for standalone groups
+        or ``MP4J_AUDIT=off``. Idempotent across threads — every
+        thread writes the same process-rank file."""
+        if self._g.proc is not None:
+            return self._g.proc.dump_audit(root)
+        return None
+
     def _on_collective_error(self, name: str, exc: BaseException) -> None:
         """Forward a failed collective to the process slave's DIAGNOSE
         path so the master's hang diagnosis also covers hybrid jobs."""

@@ -37,6 +37,10 @@ THRESHOLDS: dict[str, float] = {
     # other socket figures on the shared 1-core bench host
     "socket_shm_collective_gbs": 0.25,
     "socket_twolevel_gbs": 0.25,
+    # ISSUE 8: the audit plane's default (digest) mode on the headline
+    # leg — gated so the always-on digest tax cannot silently creep;
+    # same loopback noise floor as the other socket figures
+    "socket_collective_gbs_audit_digest": 0.25,
     "socket_framed_collective_gbs": 0.20,
     "socket_collective_in_workload_gbs": 0.25,
     "ffm_sparse_steps_per_sec": 0.10,

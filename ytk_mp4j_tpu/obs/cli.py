@@ -6,6 +6,7 @@ Usage::
     mp4j-scope report [--json] stats0.json stats1.json ...
     mp4j-scope live http://master-host:PORT [--interval 1.0] [--once]
     mp4j-scope postmortem /path/to/MP4J_POSTMORTEM_DIR
+    mp4j-scope replay /path/to/BUNDLE_DIR
     mp4j-scope bench-diff BENCH_rA.json BENCH_rB.json [--threshold PCT]
     python -m ytk_mp4j_tpu.obs report ...
 
@@ -27,14 +28,21 @@ single frame (scripts, tests).
 
 ``postmortem`` merges a flight-recorder directory (per-rank bundles +
 the master manifest, ``MP4J_POSTMORTEM_DIR``) into one report naming
-the dead and lagging ranks.
+the dead and lagging ranks, plus the audit plane's known-good
+watermark (the last cross-rank-verified collective before the fatal).
+
+``replay`` (ISSUE 8) re-executes a captured schedule
+(``MP4J_AUDIT=capture`` bundles: postmortem dirs or
+``ProcessCommSlave.dump_audit`` dumps) in-process on the thread
+backend and diffs digests record-by-record — offline reproduction of
+a divergence with no cluster. Exit 1 when any record diverges.
 
 ``bench-diff`` compares two ``bench.py`` JSON outputs against
 per-metric regression budgets (``obs.benchdiff``); exit 1 on a
 regression — the perf gate.
 
-Exit codes: 0 ok, 1 bench-diff regression, 2 bad invocation /
-unreadable input.
+Exit codes: 0 ok, 1 bench-diff regression / replay divergence, 2 bad
+invocation / unreadable input.
 """
 
 from __future__ import annotations
@@ -46,7 +54,7 @@ import time
 import urllib.error
 import urllib.request
 
-from ytk_mp4j_tpu.obs import benchdiff, postmortem, spans, telemetry
+from ytk_mp4j_tpu.obs import audit, benchdiff, postmortem, spans, telemetry
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -82,6 +90,12 @@ def _build_parser() -> argparse.ArgumentParser:
                         help="merge a flight-recorder directory into "
                              "one report naming the dead/lagging rank")
     pm.add_argument("dir", help="the job's MP4J_POSTMORTEM_DIR")
+
+    rp2 = sub.add_parser("replay",
+                         help="re-execute a captured audit bundle on "
+                              "the thread backend and diff digests "
+                              "record-by-record (MP4J_AUDIT=capture)")
+    rp2.add_argument("dir", help="bundle dir (rank_*/audit.json)")
 
     bd = sub.add_parser("bench-diff",
                         help="compare two bench.py JSON outputs "
@@ -144,6 +158,10 @@ def main(argv=None) -> int:
         if args.cmd == "postmortem":
             print(postmortem.merge_report(args.dir))
             return 0
+        if args.cmd == "replay":
+            text, diverged = audit.replay_bundle(args.dir)
+            print(text)
+            return 1 if diverged else 0
         if args.cmd == "bench-diff":
             thr = (None if args.threshold is None
                    else args.threshold / 100.0)

@@ -369,6 +369,22 @@ def to_prometheus(doc: dict) -> str:
         out.append(f"# TYPE mp4j_cluster_{k} gauge")
         out.append(f"mp4j_cluster_{k} {_fmt(float(v))}")
 
+    # audit plane (ISSUE 8): divergence counter + verification
+    # watermark — present whenever the master carries an auditor (the
+    # series stay at 0 unless slaves run MP4J_AUDIT=verify|capture,
+    # so dashboards can alert on `> 0` unconditionally)
+    audit = doc.get("cluster", {}).get("audit")
+    if audit is not None:
+        out.append("# TYPE mp4j_audit_divergences_total counter")
+        out.append("mp4j_audit_divergences_total "
+                   f"{int(audit.get('divergences', 0))}")
+        out.append("# TYPE mp4j_audit_verified_seqs gauge")
+        out.append("mp4j_audit_verified_seqs "
+                   f"{int(audit.get('verified_total', 0))}")
+        out.append("# TYPE mp4j_audit_verified_seq_watermark gauge")
+        out.append("mp4j_audit_verified_seq_watermark "
+                   f"{int(audit.get('verified_seq', 0))}")
+
     out.append("# TYPE mp4j_collective_latency_seconds histogram")
     hists = doc.get("cluster", {}).get("histograms", {})
     for name in sorted(hists):

@@ -37,7 +37,7 @@ import time
 from ytk_mp4j_tpu.obs import spans, telemetry
 
 _BUNDLE_FILES = ("trace.json", "stats.json", "metrics.json",
-                 "recovery.json")
+                 "recovery.json", "audit.json")
 
 
 def bundle_dir(root: str, rank: int) -> str:
@@ -46,10 +46,13 @@ def bundle_dir(root: str, rank: int) -> str:
 
 def write_bundle(root: str, rank: int, *, reason: str, progress: dict,
                  stats: dict, metrics: dict, epoch: int,
-                 events: list | None = None) -> str:
+                 events: list | None = None,
+                 audit: dict | None = None) -> str:
     """Write one rank's postmortem bundle; returns the bundle dir.
     The ``complete.json`` marker goes last so a reader can distinguish
-    a finished bundle from one torn by the dying process."""
+    a finished bundle from one torn by the dying process. ``audit``
+    (ISSUE 8) is the rank's audit-ring dump — the record ring that
+    makes the bundle replayable offline (``mp4j-scope replay``)."""
     d = bundle_dir(root, rank)
     os.makedirs(d, exist_ok=True)
     spans.export_chrome_trace(os.path.join(d, "trace.json"))
@@ -59,6 +62,8 @@ def write_bundle(root: str, rank: int, *, reason: str, progress: dict,
     _dump(d, "metrics.json", metrics)
     _dump(d, "recovery.json", {"epoch": epoch,
                                "events": list(events or [])})
+    if audit is not None:
+        _dump(d, "audit.json", audit)
     _dump(d, "complete.json", {
         "rank": rank, "files": list(_BUNDLE_FILES),
         # wall clock: a postmortem artifact's timestamp must be
@@ -75,11 +80,14 @@ def _dump(d: str, name: str, obj) -> None:
 
 def write_master_manifest(root: str, *, slave_num: int, reason: str,
                           table: dict, departed: dict,
-                          diagnosis: list[str]) -> str:
+                          diagnosis: list[str],
+                          audit: dict | None = None) -> str:
     """The master's cluster-level half of the recorder: who the job
     thought was alive, why it died, and the final heartbeat table
     (fresh — the slaves' fatal-path telemetry flush lands before the
-    closing manifest refresh)."""
+    closing manifest refresh). ``audit`` (ISSUE 8) carries the
+    cluster audit status — the last cross-rank-verified collective
+    ordinal is the report's known-good watermark."""
     os.makedirs(root, exist_ok=True)
     path = os.path.join(root, "manifest.json")
     with open(path, "w", encoding="utf-8") as fh:
@@ -88,6 +96,7 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
             "reason": reason,
             "departed": {str(r): why for r, why in departed.items()},
             "diagnosis": list(diagnosis),
+            "audit": audit,
             "table": {str(r): t for r, t in table.items()},
             # mp4j-lint: disable=R11 (artifact timestamp, not a duration)
             "wall_time": time.time(),
@@ -162,6 +171,25 @@ def merge_report(root: str) -> str:
     for r in dead:
         why = departed.get(r, "no postmortem bundle written")
         lines.append(f"DEAD rank {r}: {why}")
+
+    # known-good watermark (ISSUE 8): the last collective ordinal the
+    # master cross-rank-verified before the fatal — everything up to
+    # it is PROVEN bit-identical across ranks, so the search space for
+    # "when did it go wrong" starts there, not at step 0
+    audit = (manifest or {}).get("audit") or {}
+    if audit.get("verified_seq"):
+        lines.append(
+            f"known-good watermark: collective #{audit['verified_seq']} "
+            "was the last cross-rank-verified seq before the fatal "
+            f"({audit.get('verified_total', 0)} seq(s) verified, "
+            f"{audit.get('divergences', 0)} divergence(s))")
+    elif audit:
+        lines.append(
+            "known-good watermark: none — no collective was cross-rank-"
+            "verified before the fatal (audit mode below 'verify', or "
+            "the job died before the first complete round)")
+    for d in audit.get("last_divergences") or []:
+        lines.append(f"audit divergence: {d.get('msg')}")
 
     # sequence-number lag across the bundles that exist
     table = {}

@@ -113,6 +113,16 @@ def format_live(doc: dict) -> str:
             f"{rates.get('collectives_per_sec', 0.0):.1f} coll/s | "
             f"{rates.get('keys_per_sec', 0.0):.0f} keys/s "
             f"(window {doc.get('window_secs', 0):.0f}s)")
+    audit = cl.get("audit") or {}
+    if audit.get("rank_seq") or audit.get("divergences"):
+        # the audit plane only reports under MP4J_AUDIT=verify|capture;
+        # a nonzero divergence count is the headline of the whole view
+        head += (f"\naudit: verified through collective "
+                 f"#{audit.get('verified_seq', 0)}, "
+                 f"{audit.get('divergences', 0)} divergence(s)")
+        if audit.get("divergences"):
+            last = (audit.get("last_divergences") or [{}])[-1]
+            head += f"\n  last: {last.get('msg', '?')}"
     if not ranks:
         return head + "\n(no rank telemetry yet)"
     skew = cluster_skew({int(r): info.get("stats", {})
@@ -124,7 +134,7 @@ def format_live(doc: dict) -> str:
     lines = [head,
              f"{'rank':>4}  {'seq':>5}  {'lag':>4}  "
              f"{'state':<34}  {'MB/s':>8}  {'shm%':>5}  "
-             f"{'retries':>7}  hb age"]
+             f"{'aud':>5}  {'retries':>7}  hb age"]
     for r in sorted(ranks, key=int):
         info = ranks[r]
         prog = info.get("progress", {})
@@ -148,12 +158,16 @@ def format_live(doc: dict) -> str:
         tagged = shm_b + sum(e.get("wire_bytes_tcp", 0)
                              for e in info.get("stats", {}).values())
         shm_pct = f"{100.0 * shm_b / tagged:.0f}" if tagged else "-"
+        # audit column (ISSUE 8): the rank's last audited collective
+        # ordinal; "-" until the rank ships audit records
+        aud = info.get("audit_seq", 0)
         mark = "*" if int(r) in stragglers else " "
         lines.append(
             f"{mark}{r:>3}  {seq:>5}  {lag if lag else '-':>4}  "
             f"{state:<34.34}  "
             f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:>8.2f}  "
             f"{shm_pct:>5}  "
+            f"{aud if aud else '-':>5}  "
             f"{retries:>7}  {info.get('age', 0.0):.1f}s")
     return "\n".join(lines)
 

@@ -30,6 +30,17 @@ Actions:
   :class:`FaultKill` — the closest a thread-hosted test rank can get
   to ``kill -9``. The master sees the control connection die and fans
   out the terminal abort.
+- ``corrupt`` — flip one byte of the next payload frame (>=
+  ``CORRUPT_MIN`` bytes, so frame headers and tiny control tuples are
+  never hit — a desynced frame stream would be a crash, not the
+  silent corruption this directive exists to simulate) sent at
+  collective ``nth``. The flip happens in a COPY below the audit
+  plane's sender-side digests, never in the caller's buffer, so the
+  wire carries corrupted bytes while the sender's records stay clean —
+  exactly the shape ``MP4J_AUDIT=verify`` must detect (ISSUE 8).
+  Hooked at the same channel primitives as ``reset`` (and at the raw
+  exchange for the native/shm data planes). The flipped byte is the
+  frame's middle byte XOR 0xFF — deterministic, like every directive.
 
 Every directive fires at most once except ``slow``, which persists
 once armed. ``prob`` (0..1, default 1) gates arming through the seeded
@@ -45,8 +56,14 @@ import time
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
 
-_ACTIONS = ("delay", "slow", "reset", "kill")
+_ACTIONS = ("delay", "slow", "reset", "kill", "corrupt")
 _ONCE = ("delay", "reset", "kill")
+
+# a corrupt directive only fires on buffers at least this large:
+# payload frames, never the u8/u64 frame headers or small pickled
+# control tuples whose corruption would desync the framing (a crash,
+# not a silent wrong answer)
+CORRUPT_MIN = 4096
 
 
 class FaultKill(Mp4jError):
@@ -139,6 +156,24 @@ class FaultPlan:
         return [f for f in self.faults if f.rank == rank]
 
 
+def corrupt_copy(buf):
+    """A COPY of ``buf`` with its middle byte flipped (XOR 0xFF) —
+    deterministic, and never mutating the caller's buffer: the frame
+    on the wire lies while every local record stays truthful, the
+    exact hazard shape the audit plane must catch. Accepts bytes-likes
+    and numpy arrays; returns the matching kind."""
+    import numpy as np
+
+    if isinstance(buf, np.ndarray):
+        out = buf.copy()
+        flat = out.view(np.uint8).reshape(-1)
+        flat[flat.size // 2] ^= 0xFF
+        return out
+    out = bytearray(buf)
+    out[len(out) // 2] ^= 0xFF
+    return bytes(out)
+
+
 class FaultInjector:
     """Per-rank evaluator of a :class:`FaultPlan`.
 
@@ -199,6 +234,23 @@ class FaultInjector:
             raise FaultKill(
                 f"fault injection: rank {self._rank} killed at "
                 f"collective {ordinal}")
+
+    def take_corrupt(self, channel, nbytes: int):
+        """Pop one armed ``corrupt`` directive for this channel's peer
+        if ``nbytes`` clears :data:`CORRUPT_MIN`; returns the
+        :class:`Fault` or ``None``. Separate from :meth:`on_io`
+        because the caller must know BEFORE the write whether to
+        substitute a flipped copy — and only payload-sized buffers are
+        eligible (see the grammar note)."""
+        if nbytes < CORRUPT_MIN:
+            return None
+        with self._lock:
+            for f in self._armed:
+                if f.action == "corrupt" and (
+                        f.peer is None or f.peer == channel.peer_rank):
+                    self._armed.remove(f)
+                    return f
+        return None
 
     def on_io(self, channel, op: str) -> None:
         """Channel I/O hook (``op`` is ``"send"`` or ``"recv"``). At

@@ -159,15 +159,39 @@ class Channel(abc.ABC):
         back to the Python raw path, which is wire-identical)."""
         return None
 
+    def _audit(self):
+        """The owning slave's audit ring when wire folds are armed
+        (``MP4J_AUDIT=verify|capture``), else None — rides the stats
+        attachment so every peer channel (tcp AND shm) gets per-frame
+        wire digests for free, with transport attribution (ISSUE 8)."""
+        st = self.stats
+        if st is not None:
+            audit = st.audit
+            if audit is not None and audit.wire_on:
+                return audit
+        return None
+
     # -- shared low level -----------------------------------------------
     def _send_all(self, *bufs: bytes | memoryview) -> None:
         t0 = time.perf_counter() if self.stats is not None else 0.0
+        audit = self._audit()
+        if audit is not None:
+            # fold BEFORE any fault injection: the sender's record
+            # must describe what it MEANT to send, so a flipped byte
+            # below shows up as a sender/receiver digest mismatch
+            audit.on_wire(self.peer_rank, "send", bufs, self.transport)
         for b in bufs:
             # per-buffer hook so an injected cut lands BETWEEN the
             # header and payload of one frame — a true mid-frame
             # tear, the hardest drain case for the receiver
             if self.faults is not None:
                 self.faults.on_io(self, "send")
+                # mp4j-lint: disable=R13 (length read, not a byte serialization)
+                f = self.faults.take_corrupt(self, memoryview(b).nbytes)
+                if f is not None:
+                    from ytk_mp4j_tpu.resilience import faults as _fm
+
+                    b = _fm.corrupt_copy(b)
             self._io_send(b)
         if self.stats is not None:
             self.stats.add_wire(sum(len(b) for b in bufs), 0,
@@ -187,6 +211,14 @@ class Channel(abc.ABC):
         if self.faults is not None:
             self.faults.on_io(self, "recv")
         self._io_recv_into(view)
+        audit = self._audit()
+        if audit is not None:
+            # fold AFTER the fill: the receiver's record describes
+            # what actually arrived; crc composability makes the
+            # chunked receive boundaries irrelevant vs the sender's
+            # per-buffer folds
+            audit.on_wire(self.peer_rank, "recv", (view,),
+                          self.transport)
         if self.stats is not None:
             self.stats.add_wire(0, len(view), time.perf_counter() - t0,
                                 chunks=0, peer=self.peer_rank,

@@ -106,6 +106,12 @@ class CommStats:
         # histograms ride here; the heartbeat ships their deltas.
         # MP4J_METRICS=0 turns every observe into a flag check.
         self.metrics = metrics_mod.MetricsRegistry()
+        # audit plane (ISSUE 8): the owning slave's AuditRing, set
+        # alongside ``rank`` — channels reach it through their
+        # ``stats`` attachment for the per-frame wire digests
+        # (MP4J_AUDIT=verify|capture); None when auditing is off or
+        # the stats belong to a non-audited backend
+        self.audit = None
         # progress state for the telemetry heartbeat / hang diagnosis
         self._seq = 0                      # outermost collectives entered
         self._current: str | None = None   # collective in flight

@@ -74,6 +74,18 @@ Knobs (all validated where they are consumed; garbage raises
   (``obs/postmortem.py``): on any terminal abort every rank dumps a
   postmortem bundle here and the master writes a cluster manifest;
   empty disables the recorder.
+- ``MP4J_AUDIT`` — the collective correctness auditing plane
+  (``obs/audit.py``): ``off`` | ``digest`` (default: record per-
+  collective input/output digests in a bounded ring, record-only) |
+  ``verify`` (also ship digest records on the heartbeat and fold
+  per-frame wire digests so the master can flag cross-rank
+  divergences) | ``capture`` (verify + capture input payloads for
+  offline ``mp4j-scope replay``). JOB-wide like ``native_transport``:
+  cross-rank digest comparison is only meaningful when every rank
+  computes digests the same way over the same schedule.
+- ``MP4J_AUDIT_RING`` — capacity (records) of the per-rank audit
+  record ring; bounds postmortem/replay coverage and, under
+  ``capture``, the payload memory held per rank.
 """
 
 from __future__ import annotations
@@ -110,6 +122,14 @@ DEFAULT_DEAD_RANK_SECS = 120.0
 # tax stays well under the <2% bench budget (ISSUE 3).
 DEFAULT_HEARTBEAT_SECS = 0.5
 DEFAULT_SPAN_RING = 65536
+# Audit-plane defaults (ISSUE 8): digest-mode recording is default-on
+# (one vectorized hash pass per collective input/output — the wire
+# crc folds and heartbeat shipping only arm in verify/capture); the
+# ring bounds postmortem/replay coverage at a fixed memory cost, like
+# the span ring.
+DEFAULT_AUDIT_MODE = "digest"
+DEFAULT_AUDIT_RING = 1024
+AUDIT_MODES = ("off", "digest", "verify", "capture")
 # Metrics-plane default (ISSUE 6): the window the master's rate ring
 # covers. Heartbeats arrive every DEFAULT_HEARTBEAT_SECS, so 60 s keeps
 # ~120 interval points per rank — enough for a stable GB/s readout,
@@ -335,6 +355,33 @@ def postmortem_dir() -> str:
             f"MP4J_POSTMORTEM_DIR={raw!r} names an existing regular "
             "file, not a directory")
     return raw
+
+
+def audit_mode(override=None) -> str:
+    """The audit plane's mode (``MP4J_AUDIT``): one of
+    :data:`AUDIT_MODES`. ``override`` is the explicit constructor arg
+    (``ProcessCommSlave(audit=...)``) — it bypasses the env read but
+    gets the SAME validation (one validator per knob, the PR 5
+    discipline). JOB-wide: every rank must run the same mode or
+    cross-rank digest comparison would flag healthy seqs."""
+    if override is not None:
+        raw = str(override)
+    else:
+        raw = os.environ.get("MP4J_AUDIT")
+        if raw is None or raw.strip() == "":
+            return DEFAULT_AUDIT_MODE
+    name = raw.strip().lower()
+    if name not in AUDIT_MODES:
+        raise Mp4jError(
+            f"MP4J_AUDIT={raw!r} is not one of {list(AUDIT_MODES)}")
+    return name
+
+
+def audit_ring() -> int:
+    """Capacity (records) of the per-rank audit record ring
+    (``MP4J_AUDIT_RING``); must be >= 1 — disabling the plane is
+    ``MP4J_AUDIT=off``, not a zero ring."""
+    return env_int("MP4J_AUDIT_RING", DEFAULT_AUDIT_RING, minimum=1)
 
 
 def fault_plan_spec() -> str:
