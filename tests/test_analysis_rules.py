@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 14)]
+    assert ids == [f"R{i}" for i in range(1, 15)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -989,4 +989,86 @@ def test_r13_inline_and_baseline_suppression():
         def _raw_view(arr):
             return memoryview(arr).cast("B")
     """, baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R14 — telemetry artifact write without tmp + os.replace
+# ----------------------------------------------------------------------
+OBS_PATH = "ytk_mp4j_tpu/obs/snippet.py"
+
+
+def test_r14_fires_on_plain_write_and_append():
+    r = run_rule("R14", """
+        import json
+
+        def dump(path, obj):
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(obj, fh)
+
+        def log(path, line):
+            with open(path, mode="ab") as fh:
+                fh.write(line)
+    """, path=OBS_PATH)
+    assert [f.line for f in r.findings] == [5, 9]
+    assert all("os.replace" in f.message for f in r.findings)
+
+
+def test_r14_quiet_on_tmp_replace_discipline_and_reads():
+    assert not run_rule("R14", """
+        import json, os
+
+        def dump(path, obj):
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(obj, fh)
+            os.replace(tmp, path)
+
+        def load(path):
+            with open(path, encoding="utf-8") as fh:
+                return json.load(fh)
+
+        def load_binary(path):
+            with open(path, "rb") as fh:
+                return fh.read()
+    """, path=OBS_PATH).findings
+    # a computed mode is the caller's contract, not this site's
+    assert not run_rule("R14", """
+        def opener(path, mode):
+            return open(path, mode)
+    """, path=OBS_PATH).findings
+
+
+def test_r14_scoped_to_obs():
+    src = """
+        def dump(path, b):
+            with open(path, "wb") as fh:
+                fh.write(b)
+    """
+    assert not run_rule("R14", src,
+                        path="ytk_mp4j_tpu/comm/snippet.py").findings
+    assert run_rule("R14", src, path=OBS_PATH).findings
+
+
+def test_r14_inline_and_baseline_suppression():
+    r = run_rule("R14", """
+        def append_segment(path, frame):
+            # mp4j-lint: disable=R14 (crc-framed append-only stream)
+            with open(path, "ab", buffering=0) as fh:
+                fh.write(frame)
+    """, path=OBS_PATH)
+    assert not r.findings and len(r.suppressed) == 1
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R14"
+        file = "ytk_mp4j_tpu/obs/snippet.py"
+        context = "Sink.append"
+        reason = "torn-tail tolerant"
+    """))
+    r = run_rule("R14", """
+        class Sink:
+            def append(self, path, frame):
+                with open(path, "ab") as fh:
+                    fh.write(frame)
+    """, path=OBS_PATH, baseline=bl)
     assert not r.findings and len(r.suppressed) == 1

@@ -1,0 +1,662 @@
+"""Durable streaming telemetry sink + critical-path attribution
+(ISSUE 9): segment framing and the torn-tail property (truncate at
+every byte of the final record), rotation/eviction under the disk
+budget, live-job drains, the injected-slow-rank acceptance grid, the
+kill chaos case (survivor segments joinable by ``mp4j-scope
+analyze``), Prometheus/live rendering of the sink series, the
+``analyze``/``tail`` CLI, and knob validation."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from helpers import run_slaves
+from ytk_mp4j_tpu.exceptions import Mp4jError, Mp4jFatalError
+from ytk_mp4j_tpu.obs import critpath, metrics, sink, spans, telemetry
+from ytk_mp4j_tpu.obs import postmortem
+from ytk_mp4j_tpu.obs.cli import main as scope_main
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.utils import tuning
+
+N = 4
+
+
+@pytest.fixture
+def fresh_spans():
+    """Clear the process-global span ring around a test (the thread
+    harness shares it across every in-process slave)."""
+    spans.clear()
+    yield
+    spans.clear()
+
+
+def _allreduce_body(rounds=6, size=50_000):
+    def fn(slave, r):
+        for _ in range(rounds):
+            a = np.ones(size, np.float64) * (r + 1)
+            slave.allreduce_array(a, Operands.DOUBLE, Operators.SUM)
+        return True
+    return fn
+
+
+# ----------------------------------------------------------------------
+# segment framing + torn-tail tolerance
+# ----------------------------------------------------------------------
+def _write_segment(path, records):
+    with open(path, "wb") as fh:
+        offs = []
+        for rec in records:
+            offs.append(fh.tell())
+            fh.write(sink.encode_record(rec))
+        offs.append(fh.tell())
+    return offs           # frame start offsets + final size
+
+
+def test_record_frame_roundtrip(tmp_path):
+    recs = [{"t": "meta", "rank": 0, "seg": 0},
+            {"t": "spans", "spans": [["allreduce_array", "collective",
+                                      1.5, 0.25, 0, 0, {"seq": 1}]]},
+            {"t": "recovery", "epoch": 1, "events": [[0.1, "go", ""]]}]
+    p = tmp_path / "seg_00000000.mp4j"
+    _write_segment(p, recs)
+    got, end, torn = sink.read_segment(str(p))
+    assert got == recs
+    assert not torn and end == os.path.getsize(p)
+
+
+def test_torn_tail_at_every_byte_of_final_record(tmp_path):
+    """The ISSUE 9 property: truncating a segment at ANY byte offset
+    inside the final record loses only that record — the reader
+    recovers every prior record, reports exactly one torn tail, and
+    never crashes."""
+    recs = [{"t": "meta", "rank": 1, "seg": 0},
+            {"t": "stats", "delta": {"allreduce_array": {"calls": 3}}},
+            {"t": "spans", "spans": [["wire", "phase", 2.0, 0.01, 1, 0,
+                                      {"seq": 2, "peer": 0}]]},
+            {"t": "recovery", "epoch": 0, "events": []}]
+    whole = tmp_path / "whole.mp4j"
+    offs = _write_segment(whole, recs)
+    start_last, size = offs[-2], offs[-1]
+    blob = whole.read_bytes()
+
+    # clean cut exactly at the last frame boundary: no torn tail
+    p = tmp_path / "cut.mp4j"
+    p.write_bytes(blob[:start_last])
+    got, _, torn = sink.read_segment(str(p))
+    assert got == recs[:-1] and not torn
+
+    for cut in range(start_last + 1, size):
+        p.write_bytes(blob[:cut])
+        got, end, torn = sink.read_segment(str(p))
+        assert got == recs[:-1], f"cut at {cut} lost intact records"
+        assert torn, f"cut at {cut} not reported as torn"
+        assert end == start_last   # follow mode resumes at the tear
+
+
+def test_corrupt_byte_stops_at_the_tear_without_crashing(tmp_path):
+    recs = [{"t": "meta", "rank": 0, "seg": 0},
+            {"t": "stats", "delta": {"barrier": {"calls": 1}}},
+            {"t": "recovery", "epoch": 0, "events": []}]
+    p = tmp_path / "seg.mp4j"
+    offs = _write_segment(p, recs)
+    blob = bytearray(p.read_bytes())
+    mid = (offs[1] + offs[2]) // 2       # inside the middle record
+    blob[mid] ^= 0xFF
+    p.write_bytes(bytes(blob))
+    got, _, torn = sink.read_segment(str(p))
+    assert got == recs[:1] and torn     # stops at the corrupt frame
+
+
+def test_oversized_length_field_is_torn_not_allocated(tmp_path):
+    p = tmp_path / "seg.mp4j"
+    p.write_bytes(sink.MAGIC + (2 ** 31 - 1).to_bytes(4, "little")
+                  + b"\0\0\0\0junk")
+    got, _, torn = sink.read_segment(str(p))
+    assert got == [] and torn
+
+
+# ----------------------------------------------------------------------
+# rotation + eviction under the disk budget
+# ----------------------------------------------------------------------
+def test_rotation_eviction_never_exceeds_budget(tmp_path, fresh_spans):
+    budget = 192 * 1024
+    w = sink.SinkWriter(str(tmp_path), 0, slave_num=1,
+                        budget_bytes=budget, flush_secs=60.0)
+    filler = "x" * 512
+    for round_ in range(40):
+        for i in range(64):
+            spans.record(f"ev{i}", "phase", time.perf_counter(),
+                         0.001, 0, {"seq": round_, "pad": filler})
+        w.flush()
+        total = sum(
+            os.path.getsize(os.path.join(w.dir, f))
+            for f in os.listdir(w.dir))
+        assert total <= budget, f"round {round_}: {total} > {budget}"
+    assert w.evicted_segments > 0, "budget never forced an eviction"
+    assert w.bytes_written > budget   # wrote far more than retained
+    doc = sink.read_rank(w.dir)
+    assert doc["segments"] >= 2 and doc["torn"] == 0
+    # the survivors are the NEWEST records; every segment re-states
+    # identity in its meta record, so eviction loses no metadata
+    metas = [r for r in doc["records"] if r["t"] == "meta"]
+    assert metas and all(m["rank"] == 0 for m in metas)
+    last_spans = [r for r in doc["records"] if r["t"] == "spans"]
+    assert last_spans[-1]["spans"][-1][6]["seq"] == 39
+    w.close()
+
+
+def test_single_huge_drain_stays_under_budget(tmp_path, fresh_spans):
+    """The budget bound must hold for ANY drain size: one flush over
+    a massive backlog streams frame-wise through many segments with
+    eviction running between frames — never one oversized write that
+    blows past MP4J_SINK_BYTES."""
+    budget = 192 * 1024
+    prior = spans._capacity
+    spans.configure(20_000)
+    try:
+        w = sink.SinkWriter(str(tmp_path), 0, slave_num=1,
+                            budget_bytes=budget, flush_secs=60.0)
+        for i in range(20_000):       # ~2 MB of JSON >> budget
+            spans.record(f"ev{i}", "phase", 0.0, 0.0, 0,
+                         {"seq": i, "pad": "z" * 64})
+        w.flush()
+        w.close()
+    finally:
+        spans.configure(prior)
+    total = sum(os.path.getsize(os.path.join(w.dir, f))
+                for f in os.listdir(w.dir))
+    assert total <= budget, f"{total} > {budget}"
+    assert w.evicted_segments > 0
+    doc = sink.read_rank(w.dir)
+    assert doc["torn"] == 0
+    # the newest spans survived; the evicted prefix is the oldest
+    batches = [r for r in doc["records"] if r["t"] == "spans"]
+    assert batches[-1]["spans"][-1][6]["seq"] == 19_999
+
+
+def test_unserializable_span_arg_degrades_to_repr(tmp_path,
+                                                  fresh_spans):
+    """An exotic object leaking into span args must degrade to its
+    repr, never kill the drain (the sink may not die of a span)."""
+    w = sink.SinkWriter(str(tmp_path), 0, slave_num=1,
+                        budget_bytes=1 << 20, flush_secs=60.0)
+    spans.record("odd", "phase", 0.0, 0.0, 0, {"obj": object()})
+    w.flush()
+    w.close()
+    assert w.last_error is None
+    doc = sink.read_rank(w.dir)
+    [batch] = [r for r in doc["records"] if r["t"] == "spans"]
+    assert "object object" in batch["spans"][0][6]["obj"]
+
+
+def test_huge_span_backlog_splits_into_readable_frames(tmp_path,
+                                                       fresh_spans):
+    """One drain over a full default-size span ring must never emit a
+    frame the reader would reject as a corrupt header (which discards
+    the rest of the segment): span batches split at _SPAN_BATCH."""
+    prior = spans._capacity
+    spans.configure(3 * sink._SPAN_BATCH)
+    try:
+        w = sink.SinkWriter(str(tmp_path), 0, slave_num=1,
+                            budget_bytes=256 * 1024 * 1024,
+                            flush_secs=60.0)
+        for i in range(3 * sink._SPAN_BATCH):
+            spans.record(f"ev{i}", "phase", 0.0, 0.0, 0,
+                         {"seq": i, "pad": "y" * 64})
+        w.flush()
+        w.close()
+    finally:
+        spans.configure(prior)
+    doc = sink.read_rank(w.dir)
+    assert doc["torn"] == 0
+    batches = [r for r in doc["records"] if r["t"] == "spans"]
+    assert len(batches) == 3
+    assert all(len(b["spans"]) <= sink._SPAN_BATCH for b in batches)
+    assert sum(len(b["spans"]) for b in batches) == 3 * sink._SPAN_BATCH
+
+
+def test_idle_sink_quiesces(tmp_path, fresh_spans):
+    """An idle job's sink must write NOTHING after its sources drain:
+    the sink's own accounting counters are excluded from the metrics
+    stream, else each drain's bookkeeping would make the next delta
+    non-empty forever and the budget would churn on self-noise."""
+    from ytk_mp4j_tpu.obs import metrics as metrics_mod
+    from ytk_mp4j_tpu.utils.stats import CommStats
+
+    stats = CommStats()
+    w = sink.SinkWriter(str(tmp_path), 0, slave_num=1, stats=stats,
+                        budget_bytes=1 << 20, flush_secs=60.0)
+    spans.record("ev", "phase", 0.0, 0.001, 0, {"seq": 1})
+    stats.add("reduce_seconds", 0.001, bucket="allreduce_array")
+    w.flush()
+    settled = w.bytes_written
+    assert settled > 0
+    for _ in range(5):
+        w.flush()
+    assert w.bytes_written == settled, "idle drains kept writing"
+    w.close()
+    assert w.bytes_written == settled
+
+
+def test_short_write_raises_instead_of_tearing_silently():
+    class ShortFh:
+        def __init__(self):
+            self.got = b""
+            self.calls = 0
+
+        def write(self, view):
+            self.calls += 1
+            if self.calls == 1:
+                self.got += bytes(view[:3])
+                return 3          # short write, no exception
+            self.got += bytes(view)
+            return len(view)
+
+    fh = ShortFh()
+    sink._write_all(fh, b"abcdefgh")
+    assert fh.got == b"abcdefgh" and fh.calls == 2
+
+    class StuckFh:
+        def write(self, view):
+            return 0
+
+    with pytest.raises(OSError):
+        sink._write_all(StuckFh(), b"abc")
+
+
+def test_ring_overflow_drops_are_reported(tmp_path, fresh_spans):
+    prior = spans._capacity
+    spans.configure(32)
+    try:
+        w = sink.SinkWriter(str(tmp_path), 0, slave_num=1,
+                            budget_bytes=1 << 20, flush_secs=60.0)
+        for i in range(200):
+            spans.record(f"ev{i}", "phase", 0.0, 0.0, 0, None)
+        w.flush()
+        assert w.dropped_records == 200 - 32
+        w.close()
+    finally:
+        spans.configure(prior)
+
+
+# ----------------------------------------------------------------------
+# live-job drains + analyze
+# ----------------------------------------------------------------------
+def test_sink_drains_live_job_and_analyze_attributes(tmp_path,
+                                                     fresh_spans):
+    d = str(tmp_path / "trail")
+    run_slaves(N, _allreduce_body(rounds=6), sink_dir=d)
+    job = sink.load_job(d)
+    assert sorted(job) == list(range(N))
+    for r, doc in job.items():
+        kinds = {rec["t"] for rec in doc["records"]}
+        assert {"meta", "spans", "stats"} <= kinds
+        assert doc["torn"] == 0
+        meta = next(rec for rec in doc["records"] if rec["t"] == "meta")
+        assert meta["slave_num"] == N and meta["rank"] == r
+        # span batches carry only THIS rank's spans (the thread
+        # harness shares one process-global ring)
+        for rec in doc["records"]:
+            if rec["t"] == "spans":
+                assert {s[4] for s in rec["spans"]} == {r}
+    analysis = critpath.analyze(job)
+    # 6 allreduces per rank -> 6 attributable ordinals, all 4 ranks
+    assert analysis["ordinals_attributed"] == 6
+    assert set(analysis["phase_totals"]) == set(range(N))
+    assert sum(e["ordinals"] for e in analysis["dominators"].values()) \
+        == 6
+    report = critpath.format_report(analysis, d)
+    assert "critical-path dominators" in report
+    assert "per-phase wait decomposition" in report
+
+
+def test_analyze_names_injected_slow_rank(tmp_path, fresh_spans):
+    """The acceptance grid: a ``slow``-injected rank must be named the
+    critical-path dominator for >= 90% of the affected ordinals, with
+    per-phase wait attribution and a straggler-onset event."""
+    d = str(tmp_path / "trail")
+    results = [None] * N
+    errors = []
+    import threading
+
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+
+    master = Master(N, timeout=60.0).serve_in_thread()
+
+    def worker():
+        slave = None
+        try:
+            # 20 ms per injected I/O sleep: an order of magnitude
+            # above the scheduling noise a fully loaded 1-core CI
+            # host adds to each ~1 ms collective, so the dominance
+            # signal survives any suite-neighbor load
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=60.0, sink_dir=d,
+                fault_plan="slow:rank=3:secs=0.02:nth=5")
+            fn = _allreduce_body(rounds=16, size=100_000)
+            results[slave.rank] = fn(slave, slave.rank)
+            slave.close(0)
+        except Exception as e:      # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(90.0)
+        assert not t.is_alive(), "slave hung"
+    assert not errors, errors
+
+    analysis = critpath.analyze(sink.load_job(d))
+    affected = [r for r in analysis["rows"] if r["seq"] >= 5]
+    assert affected
+    dominated = sum(1 for r in affected if r["dominator"] == 3)
+    assert dominated / len(affected) >= 0.9, \
+        f"rank 3 dominated only {dominated}/{len(affected)}"
+    # per-phase wait attribution present and wire-dominated
+    p3 = analysis["phase_totals"][3]
+    assert p3["wire"] > 0
+    # onset trend names the slow rank
+    assert any(ev["rank"] == 3 for ev in analysis["onsets"])
+    report = critpath.format_report(analysis, d)
+    assert "rank 3" in report
+
+
+def test_chaos_kill_survivor_segments_joinable(tmp_path, fresh_spans,
+                                               monkeypatch):
+    """A killed rank leaves survivors whose segments (plus a
+    simulated kill-9 torn tail) still join into one ``mp4j-scope
+    analyze`` report, and the postmortem report gains the full-job
+    durable-sink section."""
+    d = str(tmp_path / "trail")
+    pmdir = str(tmp_path / "pm")
+    monkeypatch.setenv("MP4J_SINK_DIR", d)
+    monkeypatch.setenv("MP4J_POSTMORTEM_DIR", pmdir)
+    # fast drain cadence so the victim has durable segments BEFORE the
+    # kill — like a real long job, where hours of history precede the
+    # crash and only the final interval is at risk
+    monkeypatch.setenv("MP4J_SINK_FLUSH_SECS", "0.05")
+    import threading
+
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+
+    master = Master(N, timeout=45.0).serve_in_thread()
+    errors: list = [None] * N
+
+    def worker(i):
+        slave = None
+        try:
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=45.0,
+                dead_rank_secs=20.0,
+                fault_plan="kill:rank=2:nth=4")
+            for k in range(5):
+                a = np.ones(50_000, np.float64)
+                slave.allreduce_array(a, Operands.DOUBLE,
+                                      Operators.SUM)
+                if k == 1:
+                    # lockstep + one flush interval: the pre-fault
+                    # ordinals reach every rank's segments
+                    slave.barrier()
+                    time.sleep(0.2)
+            slave.close(0)
+        except Exception as e:
+            errors[slave.rank if slave is not None else i] = e
+            if slave is not None:
+                try:
+                    slave.close(1)
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+        assert not t.is_alive(), "rank hung past the join deadline"
+    master.join(15.0)
+    survivors = [r for r in range(N) if r != 2]
+    assert all(isinstance(errors[r], (Mp4jError, Mp4jFatalError))
+               for r in survivors), errors
+
+    # simulate the kill -9 artifact: cut rank 2's newest segment
+    # mid-frame (the in-process "kill" can't tear a real write)
+    segs = sink.list_segments(sink.rank_dir(d, 2))
+    assert segs
+    with open(segs[-1], "r+b") as fh:
+        fh.truncate(os.path.getsize(segs[-1]) - 3)
+
+    job = sink.load_job(d)
+    assert sorted(job) == list(range(N))
+    assert job[2]["torn"] == 1
+    assert all(job[r]["torn"] == 0 for r in survivors)
+    analysis = critpath.analyze(job)
+    assert analysis["ordinals_attributed"] >= 2   # pre-fault ordinals
+    assert scope_main(["analyze", d]) == 0
+
+    # the postmortem report joins the durable history via the
+    # manifest's sink_dir pointer
+    report = postmortem.merge_report(pmdir)
+    assert "DEAD rank 2" in report
+    assert "durable sink (full-job history):" in report
+    assert "torn tails: rank 2: 1" in report
+
+
+# ----------------------------------------------------------------------
+# critpath units (synthetic timelines)
+# ----------------------------------------------------------------------
+def _cell(family="allreduce_array", t0=0.0, dur=1.0, wire=0.0,
+          reduce=0.0, serialize=0.0, links=None):
+    return {"family": family, "t0": t0, "dur": dur,
+            "phases": {"wire": wire, "reduce": reduce,
+                       "serialize": serialize},
+            "links": links or {}}
+
+
+def test_attribute_late_arrival():
+    ordinals = {7: {
+        0: _cell(t0=0.0, dur=1.2, wire=1.1),
+        1: _cell(t0=1.0, dur=0.2, wire=0.1),
+        2: _cell(t0=0.0, dur=1.2, wire=1.1),
+    }}
+    [row] = critpath.attribute(ordinals)
+    assert row["seq"] == 7
+    assert row["dominator"] == 1 and row["cause"] == "late-arrival"
+
+
+def test_attribute_late_arrival_two_ranks():
+    """n=2 must still detect a straggler: the lower-median start
+    keeps the early rank as the reference (the upper median would
+    zero the skew and misread the peer's blocked recv as wire
+    blame)."""
+    ordinals = {1: {
+        0: _cell(t0=0.0, dur=10.2, wire=10.1),
+        1: _cell(t0=10.0, dur=0.2, wire=0.1),
+    }}
+    [row] = critpath.attribute(ordinals)
+    assert row["dominator"] == 1 and row["cause"] == "late-arrival"
+
+
+def test_onset_trend_catches_trailing_window():
+    """A straggler whose onset falls in the final < window ordinals
+    (the pre-crash degradation) must still emit an onset event."""
+    rows = []
+    for i in range(1, 102):
+        rows.append({"seq": i, "family": "allreduce_array",
+                     "start": float(i), "end": i + 0.5, "dur": 0.5,
+                     "dominator": 3 if i >= 99 else 0,
+                     "cause": "wire", "transport": None, "score": 1.0,
+                     "margin": 0.0, "waits": {}})
+    # regular window starts (step 2) end at 96, where rank 3 holds
+    # only 2/4 of the window — only the appended tail window (start
+    # 97: three of four rows) crosses the 75% share
+    events = critpath.onset_trend(rows, window=4, share=0.75)
+    assert any(e["rank"] == 3 for e in events)
+
+
+def test_attribute_blamed_peer_link_with_transport():
+    link_to_2 = {2: {"secs": 0.9, "transport": "tcp", "bytes": 1000}}
+    ordinals = {3: {
+        0: _cell(t0=0.0, dur=1.0, wire=0.9, links=dict(link_to_2)),
+        1: _cell(t0=0.0, dur=1.0, wire=0.9, links=dict(link_to_2)),
+        2: _cell(t0=0.0, dur=1.0, wire=0.95,
+                 links={0: {"secs": 0.5, "transport": "tcp",
+                            "bytes": 500},
+                        1: {"secs": 0.45, "transport": "tcp",
+                            "bytes": 500}}),
+    }}
+    [row] = critpath.attribute(ordinals)
+    assert row["dominator"] == 2
+    assert row["cause"] == "link->2 over tcp"
+    assert row["transport"] == "tcp"
+
+
+def test_attribute_local_reduce_dominance():
+    ordinals = {1: {
+        0: _cell(dur=1.0, wire=0.1, reduce=0.8),
+        1: _cell(dur=0.4, wire=0.1),
+    }}
+    [row] = critpath.attribute(ordinals)
+    assert row["dominator"] == 0 and row["cause"] == "reduce"
+
+
+def test_attribute_needs_two_ranks():
+    assert critpath.attribute({1: {0: _cell()}}) == []
+
+
+def test_onset_trend_localizes_the_flip():
+    rows = []
+    for i in range(1, 81):
+        rows.append({"seq": i, "family": "allreduce_array",
+                     "start": float(i), "end": i + 0.5, "dur": 0.5,
+                     "dominator": 0 if i <= 40 else 3,
+                     "cause": "wire", "transport": None, "score": 1.0,
+                     "margin": 0.0, "waits": {}})
+    events = critpath.onset_trend(rows, window=16, share=0.6)
+    r3 = [e for e in events if e["rank"] == 3]
+    assert r3, "no onset for the late straggler"
+    assert 33 <= r3[0]["onset_seq"] <= 49
+    assert r3[0]["onset_wall"] == float(r3[0]["onset_seq"])
+
+
+# ----------------------------------------------------------------------
+# rendering: Prometheus series, live view, CLI
+# ----------------------------------------------------------------------
+def _doc_with_sink():
+    rank = {
+        "progress": {"seq": 4, "current": None, "last": "barrier",
+                     "phase": None, "current_secs": 0.0},
+        "age": 0.2, "stats": {}, "rates": {}, "histograms": {},
+        "counters": {"sink/bytes": 2_400_000.0, "sink/records": 12.0,
+                     "sink/dropped_records": 2.0},
+        "gauges": {"sink/lag_secs": 1.25},
+    }
+    other = {**rank, "counters": {}, "gauges": {}}
+    return {"slave_num": 2, "window_secs": 60.0,
+            "ranks": {"0": rank, "1": other},
+            "cluster": {"stats": {}, "rates": {}, "histograms": {},
+                        "audit": None}}
+
+
+def test_prometheus_renders_sink_series():
+    text = metrics.to_prometheus(_doc_with_sink())
+    assert "# TYPE mp4j_sink_bytes_total counter" in text
+    assert 'mp4j_sink_bytes_total{rank="0"} 2400000' in text
+    assert 'mp4j_sink_bytes_total{rank="cluster"} 2400000' in text
+    assert 'mp4j_sink_dropped_records_total{rank="0"} 2' in text
+    assert "# TYPE mp4j_sink_lag_seconds gauge" in text
+    assert 'mp4j_sink_lag_seconds{rank="0"} 1.25' in text
+    # sinkless jobs get NO sink series (absent, not zero-noise)
+    doc = _doc_with_sink()
+    for r in doc["ranks"].values():
+        r["counters"], r["gauges"] = {}, {}
+    assert "mp4j_sink" not in metrics.to_prometheus(doc)
+
+
+def test_live_view_sink_column():
+    frame = telemetry.format_live(_doc_with_sink())
+    header = frame.splitlines()[1]
+    assert "sink" in header
+    row0 = next(ln for ln in frame.splitlines() if ln.lstrip()
+                .startswith("0 "))
+    assert "2.4M!" in row0          # dropping -> flagged
+    row1 = next(ln for ln in frame.splitlines() if ln.lstrip()
+                .startswith("1 "))
+    assert "2.4M" not in row1       # sinkless rank renders "-"
+
+
+def test_live_view_failing_sink_not_rendered_as_disarmed():
+    """A full disk writes zero bytes but drops records — the column
+    must flag it, not render the '-' of a disarmed sink."""
+    doc = _doc_with_sink()
+    doc["ranks"]["0"]["counters"] = {"sink/bytes": 0.0,
+                                     "sink/dropped_records": 7.0}
+    frame = telemetry.format_live(doc)
+    row0 = next(ln for ln in frame.splitlines() if ln.lstrip()
+                .startswith("0 "))
+    assert "0.0M!" in row0
+
+
+def test_cli_analyze_json_and_tail_once(tmp_path, fresh_spans, capsys):
+    d = str(tmp_path / "trail")
+    run_slaves(2, _allreduce_body(rounds=3), sink_dir=d)
+    assert scope_main(["analyze", d]) == 0
+    out = capsys.readouterr().out
+    assert "critical-path report" in out
+    assert scope_main(["analyze", d, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ordinals_attributed"] == 3
+    assert scope_main(["tail", d, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("gated by rank") == 3
+
+
+def test_analyze_empty_dir_reports_cleanly(tmp_path, capsys):
+    assert scope_main(["analyze", str(tmp_path)]) == 0
+    assert "0 attributed" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# knobs
+# ----------------------------------------------------------------------
+def test_sink_knob_validation(tmp_path, monkeypatch):
+    monkeypatch.delenv("MP4J_SINK", raising=False)
+    assert tuning.sink_enabled() is True
+    monkeypatch.setenv("MP4J_SINK", "off")
+    assert tuning.sink_enabled() is False
+    monkeypatch.setenv("MP4J_SINK", "banana")
+    with pytest.raises(Mp4jError):
+        tuning.sink_enabled()
+
+    monkeypatch.delenv("MP4J_SINK_DIR", raising=False)
+    assert tuning.sink_dir() == ""
+    f = tmp_path / "afile"
+    f.write_text("x")
+    monkeypatch.setenv("MP4J_SINK_DIR", str(f))
+    with pytest.raises(Mp4jError):
+        tuning.sink_dir()
+
+    monkeypatch.setenv("MP4J_SINK_BYTES", "12")
+    with pytest.raises(Mp4jError):
+        tuning.sink_bytes()
+    monkeypatch.setenv("MP4J_SINK_FLUSH_SECS", "0")
+    with pytest.raises(Mp4jError):
+        tuning.sink_flush_secs()
+    monkeypatch.delenv("MP4J_SINK_BYTES", raising=False)
+    assert tuning.sink_bytes() == tuning.DEFAULT_SINK_BYTES
+
+
+def test_sink_off_knob_disarms_despite_dir(tmp_path, monkeypatch,
+                                           fresh_spans):
+    monkeypatch.setenv("MP4J_SINK_DIR", str(tmp_path / "trail"))
+    monkeypatch.setenv("MP4J_SINK", "off")
+    run_slaves(2, _allreduce_body(rounds=2))
+    assert not os.path.exists(str(tmp_path / "trail"))

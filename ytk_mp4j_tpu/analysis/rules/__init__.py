@@ -15,6 +15,7 @@
 | R11 | error   | wall clock feeding duration/deadline arithmetic |
 | R12 | error   | transport construction outside transport/ (SPI) |
 | R13 | error   | raw-byte read of a possibly non-contiguous array |
+| R14 | error   | telemetry artifact write skipping tmp+os.replace |
 """
 
 from __future__ import annotations
@@ -44,6 +45,7 @@ from ytk_mp4j_tpu.analysis.rules.r12_transport_spi import (
     R12TransportSpiBypass)
 from ytk_mp4j_tpu.analysis.rules.r13_digest_contiguity import (
     R13DigestContiguity)
+from ytk_mp4j_tpu.analysis.rules.r14_torn_write import R14TornWrite
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -59,6 +61,7 @@ ALL_RULES = [
     R11WallClockDuration,
     R12TransportSpiBypass,
     R13DigestContiguity,
+    R14TornWrite,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

@@ -76,7 +76,8 @@ class Master:
                  stall_timeout: float | None = 60.0,
                  dead_rank_secs: float | None = None,
                  metrics_port: int | None = None,
-                 postmortem_dir: str | None = None):
+                 postmortem_dir: str | None = None,
+                 sink_dir: str | None = None):
         """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
         bounds each accepted connection's registration message, so one
         stray dial-in stalls rendezvous briefly instead of consuming the
@@ -104,7 +105,12 @@ class Master:
         as JSON. ``0`` binds an ephemeral port; the bound port is
         ``self.metrics_port``. ``postmortem_dir`` (None reads
         ``MP4J_POSTMORTEM_DIR``; empty disables) makes a terminal
-        abort also write the flight recorder's cluster manifest."""
+        abort also write the flight recorder's cluster manifest.
+        ``sink_dir`` (ISSUE 9; None reads ``MP4J_SINK_DIR`` gated by
+        ``MP4J_SINK``; empty disables) names the job's durable-sink
+        root in that manifest so ``mp4j-scope postmortem`` joins the
+        full-job segment history — the same constructor seam as
+        ``postmortem_dir``."""
         self.slave_num = slave_num
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
@@ -159,6 +165,15 @@ class Master:
         self._postmortem_dir = (tuning.postmortem_dir()
                                 if postmortem_dir is None
                                 else str(postmortem_dir))
+        # durable-sink root (ISSUE 9): the master never writes
+        # segments itself, but the manifest records where the ranks'
+        # sinks are so `mp4j-scope postmortem` can join full-job
+        # history into the report
+        if sink_dir is None:
+            self._sink_dir = (tuning.sink_dir()
+                              if tuning.sink_enabled() else "")
+        else:
+            self._sink_dir = str(sink_dir)
         self._metrics_window = tuning.metrics_window_secs()
         # per-rank + cluster rate rings, fed on every heartbeat fold;
         # cluster totals are maintained incrementally (O(1 rank) per
@@ -709,6 +724,14 @@ class Master:
                     "rates": win.rates() if win is not None else {},
                     "histograms": (t.get("metrics") or {}).get(
                         "histograms", {}),
+                    # registry counters/gauges ride the doc since
+                    # ISSUE 9 — the sink series (sink/bytes,
+                    # sink/dropped_records, sink/lag_secs) render per
+                    # rank in Prometheus and in `mp4j-scope live`
+                    "counters": (t.get("metrics") or {}).get(
+                        "counters", {}),
+                    "gauges": (t.get("metrics") or {}).get(
+                        "gauges", {}),
                 }
             cluster_rates = self._cluster_window.rates()
             cluster_metrics = self._cluster_metrics
@@ -757,7 +780,8 @@ class Master:
                 reason=reason, table=table, departed=departed,
                 diagnosis=telemetry_mod.render_diagnosis(
                     table, self.slave_num),
-                audit=audit_status)
+                audit=audit_status,
+                sink_dir=self._sink_dir or None)
         except OSError:
             pass  # best-effort: the job is already terminal
 

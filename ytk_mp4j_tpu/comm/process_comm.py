@@ -48,6 +48,7 @@ from ytk_mp4j_tpu.comm.context import CommSlave
 from ytk_mp4j_tpu.obs import audit as audit_mod
 from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import postmortem
+from ytk_mp4j_tpu.obs import sink as sink_mod
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.exceptions import (
     Mp4jError, Mp4jFatalError, Mp4jTransportError)
@@ -136,7 +137,8 @@ class ProcessCommSlave(CommSlave):
                  dead_rank_secs: float | None = None,
                  fault_plan=None,
                  postmortem_dir: str | None = None,
-                 audit: str | None = None):
+                 audit: str | None = None,
+                 sink_dir: str | None = None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -197,7 +199,17 @@ class ProcessCommSlave(CommSlave):
         ``off|digest|verify|capture`` (:mod:`ytk_mp4j_tpu.obs.audit`).
         JOB-wide like ``native_transport``: cross-rank digest
         comparison assumes every rank digests the same schedule the
-        same way."""
+        same way.
+
+        ``sink_dir`` (ISSUE 9; None reads ``MP4J_SINK_DIR``, gated by
+        ``MP4J_SINK``; empty disables) arms the durable streaming
+        telemetry sink: a background thread drains this rank's span/
+        stats/metrics/audit/recovery rings into crc-framed rotating
+        segment files under ``<sink_dir>/rank_NNNN/`` (per-rank disk
+        budget ``MP4J_SINK_BYTES``, oldest-segment eviction), so
+        ``mp4j-scope analyze``/``tail`` can reconstruct full-job
+        cross-rank timelines and critical-path attribution — ring
+        tails no longer bound history."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
         self._handshake_timeout = handshake_timeout
@@ -222,6 +234,15 @@ class ProcessCommSlave(CommSlave):
                                 if postmortem_dir is None
                                 else str(postmortem_dir))
         self._pm_done = False
+        # durable sink (ISSUE 9): dir + enable validated up front like
+        # every other knob; the writer itself starts after rendezvous
+        # (it needs the rank)
+        if sink_dir is None:
+            self._sink_dir = (tuning.sink_dir()
+                              if tuning.sink_enabled() else "")
+        else:
+            self._sink_dir = str(sink_dir)
+        self._sink: sink_mod.SinkWriter | None = None
         # job-wide transport tuning (env-validated here, before any
         # connection exists, so a typo'd knob fails the job cleanly)
         # and pipeline state — all of it must exist BEFORE the accept
@@ -380,6 +401,14 @@ class ProcessCommSlave(CommSlave):
                 target=self._heartbeat_loop, daemon=True,
                 name=f"mp4j-hb-r{self._rank}")
             self._hb_thread.start()
+        # durable sink drain thread (ISSUE 9) — control plane only,
+        # off the collective hot path entirely (the hot path pays the
+        # ring appends it already paid)
+        if self._sink_dir:
+            self._sink = sink_mod.SinkWriter(
+                self._sink_dir, self._rank, slave_num=self._n,
+                stats=self._comm_stats, audit=self._audit,
+                recovery=self._recovery).start()
 
     # ------------------------------------------------------------------
     # identity / control plane
@@ -542,6 +571,8 @@ class ProcessCommSlave(CommSlave):
         The master sees the control connection die and fans out the
         terminal abort to the survivors."""
         self._hb_stop.set()
+        if self._sink is not None:
+            self._sink.abort()   # a corpse flushes nothing
         with self._master_lock:
             self._closed = True
         self._teardown_peers()
@@ -611,6 +642,11 @@ class ProcessCommSlave(CommSlave):
                 (master_mod.TELEMETRY, self._telemetry_payload()))
         except (Mp4jError, OSError):
             pass  # master may be the thing that died
+        if self._sink is not None:
+            # the fatal path may never reach close(): drain the rings
+            # NOW so the job's last interval is durable before anyone
+            # raises (ISSUE 9)
+            self._sink.flush()
         self._dump_postmortem(msg)
 
     def _dump_postmortem(self, reason: str) -> None:
@@ -627,7 +663,9 @@ class ProcessCommSlave(CommSlave):
                 epoch=self._recovery.epoch,
                 events=self._recovery.events(),
                 audit=(self._audit.dump() if self._audit is not None
-                       else None))
+                       else None),
+                sink=(self._sink.status() if self._sink is not None
+                      else None))
         except OSError:
             pass  # the recorder must never worsen a dying job
 
@@ -635,6 +673,11 @@ class ProcessCommSlave(CommSlave):
         if self._closed:
             return
         self._hb_stop.set()
+        # flush-on-close (ISSUE 9): the final collective's spans and
+        # deltas reach the segment before the close handshake — a
+        # clean job's sink is complete, not one interval short
+        if self._sink is not None:
+            self._sink.close()
         sent = False
         # final telemetry delta computed OUTSIDE _master_lock (the
         # heartbeat thread takes _tel_lock then _master_lock; nesting
@@ -699,6 +742,12 @@ class ProcessCommSlave(CommSlave):
             return None
         return audit_mod.write_rank_audit(root, self._rank,
                                           self._audit.dump())
+
+    def sink_status(self) -> dict | None:
+        """The durable sink's health record (ISSUE 9; None when the
+        sink is disarmed): segment dir, bytes/records written,
+        dropped-record count, eviction count, budget."""
+        return None if self._sink is None else self._sink.status()
 
     # ------------------------------------------------------------------
     # peer transport

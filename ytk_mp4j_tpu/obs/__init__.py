@@ -23,8 +23,17 @@ Layers on top of the PR-2 measurement substrate:
 - :mod:`ytk_mp4j_tpu.obs.benchdiff` — the perf regression gate behind
   ``mp4j-scope bench-diff`` (ISSUE 6): per-metric budgets over
   ``bench.py`` JSON outputs.
+- :mod:`ytk_mp4j_tpu.obs.sink` — mp4j-trail (ISSUE 9): the durable
+  streaming telemetry sink draining the span/metrics/audit/recovery
+  rings into crc-framed rotating segment files (``MP4J_SINK_DIR``,
+  per-rank budget, torn-tail-tolerant reader).
+- :mod:`ytk_mp4j_tpu.obs.critpath` — cross-rank per-collective
+  timeline reconstruction over sink segments with critical-path
+  dominator attribution, per-phase wait decomposition and
+  straggler-onset trend detection (``mp4j-scope analyze``/``tail``).
 - :mod:`ytk_mp4j_tpu.obs.cli` — the ``mp4j-scope`` CLI: merge per-rank
   Chrome-trace files into one timeline; render the cross-rank skew
   table from per-rank ``comm.stats()`` JSON dumps; ``live`` /
-  ``postmortem`` / ``bench-diff``.
+  ``postmortem`` / ``replay`` / ``analyze`` / ``tail`` /
+  ``bench-diff``.
 """

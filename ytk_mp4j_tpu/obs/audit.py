@@ -80,6 +80,7 @@ import zlib
 import numpy as np
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.obs import spans as spans_mod
 from ytk_mp4j_tpu.utils import tuning
 
 _MASK = (1 << 64) - 1
@@ -283,6 +284,7 @@ class AuditRing:
         self._ring: collections.deque = collections.deque(maxlen=cap)
         self._shipped = 0       # records already taken as a delta
         self._dropped = 0       # records that fell off unshipped
+        self._appended = 0      # records ever appended (sink cursor)
         # current-collective wire folds: (peer, dir) -> [crc, bytes,
         # transport]
         self._wire: dict = {}
@@ -396,11 +398,28 @@ class AuditRing:
                 elif self.ships:
                     self._dropped += 1
             self._ring.append(rec)
+            self._appended += 1
 
     # -- reading / shipping ---------------------------------------------
     def records(self) -> list[dict]:
         with self._lock:
             return [dict(r) for r in self._ring]
+
+    def read_since(self, cursor: int) -> tuple[int, list[dict], int]:
+        """``(new_cursor, records, dropped)`` — records appended since
+        ``cursor`` that are still in the ring, plus the count that
+        already fell off. Non-destructive and independent of the
+        heartbeat's ``take_delta`` cursor: the durable sink (ISSUE 9)
+        reads here without stealing records from the master's
+        verification stream. Capture payloads stay out — the sink is a
+        telemetry stream, the bundle carries bytes."""
+        with self._lock:
+            total, recs, dropped = spans_mod.ring_delta(
+                self._ring, self._appended, cursor)
+            return (total,
+                    [{k: v for k, v in r.items() if k != "cap"}
+                     for r in recs],
+                    dropped)
 
     def take_delta(self) -> dict | None:
         """Records appended since the last take — the heartbeat
@@ -599,8 +618,12 @@ def write_rank_audit(root: str, rank: int, dump: dict) -> str:
     d = os.path.join(root, f"rank_{rank:04d}")
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, "audit.json")
-    with open(path, "w", encoding="utf-8") as fh:
+    # tmp + replace (mp4j-lint R14): replay must never decode a dump
+    # torn by a dying process as a short-but-valid schedule
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(dump, fh)
+    os.replace(tmp, path)
     return path
 
 

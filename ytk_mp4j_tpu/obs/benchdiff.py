@@ -41,6 +41,9 @@ THRESHOLDS: dict[str, float] = {
     # leg — gated so the always-on digest tax cannot silently creep;
     # same loopback noise floor as the other socket figures
     "socket_collective_gbs_audit_digest": 0.25,
+    # ISSUE 9: the durable sink armed on the headline leg — gated so
+    # the background-drain tax cannot silently creep; same noise floor
+    "socket_collective_gbs_sink_on": 0.25,
     "socket_framed_collective_gbs": 0.20,
     "socket_collective_in_workload_gbs": 0.25,
     "ffm_sparse_steps_per_sec": 0.10,

@@ -7,6 +7,8 @@ Usage::
     mp4j-scope live http://master-host:PORT [--interval 1.0] [--once]
     mp4j-scope postmortem /path/to/MP4J_POSTMORTEM_DIR
     mp4j-scope replay /path/to/BUNDLE_DIR
+    mp4j-scope analyze /path/to/MP4J_SINK_DIR [--json]
+    mp4j-scope tail /path/to/MP4J_SINK_DIR [--interval 1.0] [--once]
     mp4j-scope bench-diff BENCH_rA.json BENCH_rB.json [--threshold PCT]
     python -m ytk_mp4j_tpu.obs report ...
 
@@ -37,6 +39,14 @@ watermark (the last cross-rank-verified collective before the fatal).
 backend and diffs digests record-by-record — offline reproduction of
 a divergence with no cluster. Exit 1 when any record diverges.
 
+``analyze`` (ISSUE 9) reads a durable sink directory
+(``MP4J_SINK_DIR``: crc-framed per-rank segments) and prints the
+job-lifetime critical-path report — per-collective dominators,
+per-phase wait decomposition, straggler-onset timestamps, torn-tail
+counts. ``tail`` follows the same directory live, printing each
+collective's timeline line as all ranks' records land (``--once``
+prints the current backlog and exits).
+
 ``bench-diff`` compares two ``bench.py`` JSON outputs against
 per-metric regression budgets (``obs.benchdiff``); exit 1 on a
 regression — the perf gate.
@@ -54,7 +64,8 @@ import time
 import urllib.error
 import urllib.request
 
-from ytk_mp4j_tpu.obs import audit, benchdiff, postmortem, spans, telemetry
+from ytk_mp4j_tpu.obs import (audit, benchdiff, critpath, postmortem,
+                              sink as sink_mod, spans, telemetry)
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -97,6 +108,23 @@ def _build_parser() -> argparse.ArgumentParser:
                               "record-by-record (MP4J_AUDIT=capture)")
     rp2.add_argument("dir", help="bundle dir (rank_*/audit.json)")
 
+    an = sub.add_parser("analyze",
+                        help="job-lifetime critical-path report from "
+                             "a durable sink directory "
+                             "(MP4J_SINK_DIR)")
+    an.add_argument("dir", help="sink dir (rank_*/seg_*.mp4j)")
+    an.add_argument("--json", action="store_true",
+                    help="emit the structured analysis as JSON")
+
+    tl = sub.add_parser("tail",
+                        help="follow a durable sink directory live, "
+                             "one line per completed collective")
+    tl.add_argument("dir", help="sink dir (rank_*/seg_*.mp4j)")
+    tl.add_argument("--interval", type=float, default=1.0,
+                    help="poll period in seconds (default 1.0)")
+    tl.add_argument("--once", action="store_true",
+                    help="print the current backlog and exit")
+
     bd = sub.add_parser("bench-diff",
                         help="compare two bench.py JSON outputs "
                              "against per-metric regression budgets")
@@ -131,6 +159,69 @@ def _fetch_doc(base: str) -> dict:
         return json.load(resp)
 
 
+def _analyze(args) -> int:
+    analysis = critpath.analyze(sink_mod.load_job(args.dir))
+    if args.json:
+        print(json.dumps(analysis, sort_keys=True, default=str))
+    else:
+        print(critpath.format_report(analysis, args.dir))
+    return 0
+
+
+def _tail(args) -> int:
+    """Follow mode: each poll re-reads the sink and prints every
+    collective whose cross-rank attribution is COMPLETE and new
+    since the last poll, plus recovery events as they land. An
+    ordinal is held back until every rank's spans have landed —
+    ranks flush on independent cadences, and attributing from the
+    ranks that happened to flush first would systematically
+    misattribute exactly the ordinals a slow-flushing straggler
+    gates. An ordinal older than the newest fully-covered one can
+    never complete (a rank died mid-job) and prints with what
+    survived. Full re-reads keep the loop simple and robust against
+    rotation/eviction under the tailer; a sink directory is at most
+    slave_num * MP4J_SINK_BYTES."""
+    seen: set[int] = set()
+    pending: dict[int, int] = {}    # seq -> polls waited incomplete
+    seen_recovery: dict[int, int] = {}
+    while True:
+        analysis = critpath.analyze(sink_mod.load_job(args.dir))
+        n = max((int(m.get("slave_num") or 0)
+                 for m in analysis["meta"].values()), default=0) \
+            or len(analysis["ranks"])
+        horizon = max((r["seq"] for r in analysis["rows"]
+                       if len(r["waits"]) >= n), default=0)
+        for row in analysis["rows"]:
+            seq = row["seq"]
+            if seq in seen:
+                continue
+            # emit once coverage is complete, once a NEWER ordinal is
+            # fully covered (every rank already flushed past this
+            # one), after 3 incomplete polls (a dead rank's spans are
+            # never coming — the ordinals around a crash must not be
+            # withheld forever), or on --once (final state)
+            stale = pending.get(seq, 0) >= 3
+            if len(row["waits"]) >= n or seq < horizon or stale \
+                    or args.once:
+                seen.add(seq)
+                pending.pop(seq, None)
+                print(critpath.format_row(row), flush=True)
+            else:
+                pending[seq] = pending.get(seq, 0) + 1
+        for rank, events in sorted(analysis["recovery"].items()):
+            start = seen_recovery.get(rank, 0)
+            for _, kind, detail in events[start:]:
+                print(f"rank {rank} recovery: {kind}"
+                      + (f" ({detail})" if detail else ""), flush=True)
+            seen_recovery[rank] = len(events)
+        if args.once:
+            return 0
+        try:
+            time.sleep(max(args.interval, 0.1))
+        except KeyboardInterrupt:
+            return 0
+
+
 def _live(args) -> int:
     while True:
         frame = telemetry.format_live(_fetch_doc(args.url))
@@ -162,6 +253,10 @@ def main(argv=None) -> int:
             text, diverged = audit.replay_bundle(args.dir)
             print(text)
             return 1 if diverged else 0
+        if args.cmd == "analyze":
+            return _analyze(args)
+        if args.cmd == "tail":
+            return _tail(args)
         if args.cmd == "bench-diff":
             thr = (None if args.threshold is None
                    else args.threshold / 100.0)

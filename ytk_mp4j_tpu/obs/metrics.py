@@ -385,6 +385,39 @@ def to_prometheus(doc: dict) -> str:
         out.append("mp4j_audit_verified_seq_watermark "
                    f"{int(audit.get('verified_seq', 0))}")
 
+    # durable-sink series (ISSUE 9): per-rank registry counters named
+    # sink/<what> plus the drain-lag gauge; a cluster total per
+    # counter so dashboards can alert on drop growth fleet-wide. The
+    # series exist whenever a rank arms MP4J_SINK_DIR and stay absent
+    # otherwise (no zero-noise for sinkless jobs).
+    for key, metric in (("sink/bytes", "mp4j_sink_bytes_total"),
+                        ("sink/records", "mp4j_sink_records_total"),
+                        ("sink/dropped_records",
+                         "mp4j_sink_dropped_records_total")):
+        block = []
+        total = 0.0
+        for r in whos:
+            v = doc["ranks"][r].get("counters", {}).get(key)
+            if v:
+                total += v
+                block.append(f'{metric}{{rank="{_esc(r)}"}} '
+                             f"{_fmt(float(v))}")
+        if block:
+            block.append(f'{metric}{{rank="cluster"}} '
+                         f"{_fmt(float(total))}")
+            out.append(f"# TYPE {metric} counter")
+            out.extend(block)
+    lag_block = []
+    for r in whos:
+        g = doc["ranks"][r].get("gauges", {}).get("sink/lag_secs")
+        if g is not None:
+            lag_block.append(
+                f'mp4j_sink_lag_seconds{{rank="{_esc(r)}"}} '
+                f"{_fmt(float(g))}")
+    if lag_block:
+        out.append("# TYPE mp4j_sink_lag_seconds gauge")
+        out.extend(lag_block)
+
     out.append("# TYPE mp4j_collective_latency_seconds histogram")
     hists = doc.get("cluster", {}).get("histograms", {})
     for name in sorted(hists):

@@ -37,7 +37,7 @@ import time
 from ytk_mp4j_tpu.obs import spans, telemetry
 
 _BUNDLE_FILES = ("trace.json", "stats.json", "metrics.json",
-                 "recovery.json", "audit.json")
+                 "recovery.json", "audit.json", "sink.json")
 
 
 def bundle_dir(root: str, rank: int) -> str:
@@ -47,12 +47,18 @@ def bundle_dir(root: str, rank: int) -> str:
 def write_bundle(root: str, rank: int, *, reason: str, progress: dict,
                  stats: dict, metrics: dict, epoch: int,
                  events: list | None = None,
-                 audit: dict | None = None) -> str:
+                 audit: dict | None = None,
+                 sink: dict | None = None) -> str:
     """Write one rank's postmortem bundle; returns the bundle dir.
     The ``complete.json`` marker goes last so a reader can distinguish
-    a finished bundle from one torn by the dying process. ``audit``
-    (ISSUE 8) is the rank's audit-ring dump — the record ring that
-    makes the bundle replayable offline (``mp4j-scope replay``)."""
+    a finished bundle from one torn by the dying process, and every
+    file lands via tmp + ``os.replace`` (mp4j-lint R14) so a crash
+    mid-dump can never leave a syntactically truncated JSON
+    masquerading as a complete one — ``complete.json``-last used to be
+    the ONLY guard. ``audit`` (ISSUE 8) is the rank's audit-ring dump
+    — the record ring that makes the bundle replayable offline
+    (``mp4j-scope replay``); ``sink`` (ISSUE 9) is the durable sink's
+    status record pointing the report at full-job segment history."""
     d = bundle_dir(root, rank)
     os.makedirs(d, exist_ok=True)
     spans.export_chrome_trace(os.path.join(d, "trace.json"))
@@ -64,6 +70,8 @@ def write_bundle(root: str, rank: int, *, reason: str, progress: dict,
                                "events": list(events or [])})
     if audit is not None:
         _dump(d, "audit.json", audit)
+    if sink is not None:
+        _dump(d, "sink.json", sink)
     _dump(d, "complete.json", {
         "rank": rank, "files": list(_BUNDLE_FILES),
         # wall clock: a postmortem artifact's timestamp must be
@@ -74,33 +82,42 @@ def write_bundle(root: str, rank: int, *, reason: str, progress: dict,
 
 
 def _dump(d: str, name: str, obj) -> None:
-    with open(os.path.join(d, name), "w", encoding="utf-8") as fh:
+    """Atomic bundle-file write (tmp + ``os.replace``): the visible
+    path only ever holds a complete JSON document — a crash between
+    write and replace leaves the tmp file, never a torn artifact."""
+    path = os.path.join(d, name)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(obj, fh)
+    os.replace(tmp, path)
 
 
 def write_master_manifest(root: str, *, slave_num: int, reason: str,
                           table: dict, departed: dict,
                           diagnosis: list[str],
-                          audit: dict | None = None) -> str:
+                          audit: dict | None = None,
+                          sink_dir: str | None = None) -> str:
     """The master's cluster-level half of the recorder: who the job
     thought was alive, why it died, and the final heartbeat table
     (fresh — the slaves' fatal-path telemetry flush lands before the
     closing manifest refresh). ``audit`` (ISSUE 8) carries the
     cluster audit status — the last cross-rank-verified collective
-    ordinal is the report's known-good watermark."""
+    ordinal is the report's known-good watermark; ``sink_dir``
+    (ISSUE 9) names the job's durable-sink root so the merged report
+    can join full-job segment history."""
     os.makedirs(root, exist_ok=True)
     path = os.path.join(root, "manifest.json")
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump({
-            "slave_num": slave_num,
-            "reason": reason,
-            "departed": {str(r): why for r, why in departed.items()},
-            "diagnosis": list(diagnosis),
-            "audit": audit,
-            "table": {str(r): t for r, t in table.items()},
-            # mp4j-lint: disable=R11 (artifact timestamp, not a duration)
-            "wall_time": time.time(),
-        }, fh)
+    _dump(root, "manifest.json", {
+        "slave_num": slave_num,
+        "reason": reason,
+        "departed": {str(r): why for r, why in departed.items()},
+        "diagnosis": list(diagnosis),
+        "audit": audit,
+        "sink_dir": sink_dir or None,
+        "table": {str(r): t for r, t in table.items()},
+        # mp4j-lint: disable=R11 (artifact timestamp, not a duration)
+        "wall_time": time.time(),
+    })
     return path
 
 
@@ -222,4 +239,28 @@ def merge_report(root: str) -> str:
         lines.append("")
         lines.append("master diagnosis at abort time:")
         lines.extend(f"  {ln}" for ln in manifest["diagnosis"])
+
+    # durable-sink join (ISSUE 9): when the job ran with the streaming
+    # sink, the report gains FULL-JOB history — critical-path
+    # dominators and straggler onset over every ordinal the segments
+    # kept, not just the ring tails the bundles froze
+    sink_root = (manifest or {}).get("sink_dir")
+    if not sink_root:
+        for b in bundles.values():
+            root_hint = (b.get("sink") or {}).get("root")
+            if root_hint:
+                sink_root = root_hint
+                break
+    if sink_root and os.path.isdir(sink_root):
+        try:
+            from ytk_mp4j_tpu.obs import critpath, sink as sink_mod
+            analysis = critpath.analyze(sink_mod.load_job(sink_root))
+            lines.append("")
+            lines.append("durable sink (full-job history):")
+            lines.extend("  " + ln for ln in critpath.format_report(
+                analysis, sink_root).splitlines())
+        except Exception as e:      # torn segments must not kill the
+            # postmortem path they exist to enrich
+            lines.append(f"durable sink at {sink_root}: unreadable "
+                         f"({e!r})")
     return "\n".join(lines)

@@ -20,7 +20,9 @@ combines them into a single timeline (ranks keep distinct pids).
 from __future__ import annotations
 
 import collections
+import itertools
 import json
+import os
 import threading
 import time
 from typing import Any
@@ -39,6 +41,12 @@ _capacity = tuning.span_ring_capacity()
 _ring: collections.deque = collections.deque(maxlen=max(_capacity, 1))
 _enabled = _capacity > 0
 _tids: dict[int, int] = {}        # thread ident -> small stable tid
+# total spans ever appended — the durable sink's delta cursor (ISSUE
+# 9): take_since(cursor) derives (new records, ring-overflow drops)
+# from (count, ring length, cursor) alone, so readers never consume
+# the ring. Appends bump it under _lock so (ring, count) stay
+# consistent for the cursor math.
+_count = 0
 
 
 def enabled() -> bool:
@@ -61,6 +69,61 @@ def clear() -> None:
         _ring.clear()
 
 
+def _append(item: tuple) -> None:
+    global _count
+    with _lock:
+        _ring.append(item)
+        _count += 1
+
+
+def ring_delta(ring, count: int, cursor: int
+               ) -> tuple[int, list, int]:
+    """``(count, new_items, dropped)`` — THE cursor-delta read every
+    bounded-ring source shares (span ring here, the audit record ring,
+    the recovery event log): items appended since ``cursor`` that are
+    still in the ring, plus how many already fell off (reported, never
+    silently lost). A cursor ahead of ``count`` (ring reconfigured/
+    cleared) resets cleanly. The caller holds its own lock.
+
+    Cost is O(new items), not O(ring): reversed(deque) iterates from
+    the right, so a near-current reader over a full 65536-entry ring
+    copies only its delta — appenders sharing the caller's lock must
+    never stall behind a full-ring copy."""
+    new = count - min(cursor, count)
+    avail = min(new, len(ring))
+    if not avail:
+        return count, [], new
+    items = list(itertools.islice(reversed(ring), avail))
+    items.reverse()
+    return count, items, new - avail
+
+
+def take_since(cursor: int) -> tuple[int, list[tuple], int]:
+    """``(new_cursor, spans, dropped)`` — every span appended since
+    ``cursor`` that is still in the ring (:func:`ring_delta` under the
+    span lock). Non-destructive: any number of readers keep
+    independent cursors."""
+    with _lock:
+        return ring_delta(_ring, _count, cursor)
+
+
+def oldest_cursor() -> int:
+    """The earliest cursor :func:`take_since` can still serve in full
+    — a reader attaching mid-process (the durable sink of a slave
+    constructed after other slaves already ran in this process)
+    starts here so pre-attachment history is neither replayed nor
+    misreported as dropped."""
+    with _lock:
+        return _count - len(_ring)
+
+
+def to_wall(t0: float) -> float:
+    """A span's ``perf_counter`` timestamp anchored to the wall clock
+    — the same anchoring :func:`export_chrome_trace` applies, shared
+    so the durable sink writes cross-rank-comparable timestamps."""
+    return t0 - _epoch + _epoch_wall
+
+
 def _tid() -> int:
     ident = threading.get_ident()
     tid = _tids.get(ident)
@@ -76,7 +139,7 @@ def record(name: str, cat: str, t0: float, dur: float,
     seconds). Bounded ring: the oldest span falls off when full."""
     if not _enabled:
         return
-    _ring.append((name, cat, t0, dur, pid or 0, _tid(), args))
+    _append((name, cat, t0, dur, pid or 0, _tid(), args))
 
 
 def phase(name: str, seconds: float, pid: int | None, collective: str,
@@ -91,8 +154,8 @@ def phase(name: str, seconds: float, pid: int | None, collective: str,
     for k, v in extra.items():
         if v is not None:
             args[k] = v
-    _ring.append((name, "phase", end - seconds, seconds, pid or 0,
-                  _tid(), args))
+    _append((name, "phase", end - seconds, seconds, pid or 0,
+             _tid(), args))
 
 
 def mark(name: str, pid: int | None, **args: Any) -> None:
@@ -102,9 +165,9 @@ def mark(name: str, pid: int | None, **args: Any) -> None:
     recovered (ISSUE 5)."""
     if not _enabled:
         return
-    _ring.append((name, "recovery", time.perf_counter(), 0.0, pid or 0,
-                  _tid(), {k: v for k, v in args.items()
-                           if v is not None} or None))
+    _append((name, "recovery", time.perf_counter(), 0.0, pid or 0,
+             _tid(), {k: v for k, v in args.items()
+                      if v is not None} or None))
 
 
 def collective(name: str, t0: float, dur: float, pid: int | None,
@@ -112,8 +175,8 @@ def collective(name: str, t0: float, dur: float, pid: int | None,
     """The outermost collective-call span (emitted by trace.traced)."""
     if not _enabled:
         return
-    _ring.append((name, "collective", t0, dur, pid or 0, _tid(),
-                  {"seq": seq}))
+    _append((name, "collective", t0, dur, pid or 0, _tid(),
+             {"seq": seq}))
 
 
 def snapshot() -> list[tuple]:
@@ -144,9 +207,18 @@ def export_chrome_trace(path: str) -> int:
             ev["args"] = args
         events.append(ev)
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh)
+    _atomic_dump(path, doc)
     return len(events)
+
+
+def _atomic_dump(path: str, doc) -> None:
+    """Tmp-file + ``os.replace`` write: a crash mid-dump leaves either
+    the previous file or the complete new one, never a syntactically
+    truncated JSON masquerading as a trace (mp4j-lint R14)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
 
 
 def merge_chrome_traces(out_path: str, in_paths: list[str]) -> int:
@@ -161,6 +233,6 @@ def merge_chrome_traces(out_path: str, in_paths: list[str]) -> int:
         events = doc["traceEvents"] if isinstance(doc, dict) else doc
         merged.extend(events)
     merged.sort(key=lambda e: (e.get("ts", 0)))
-    with open(out_path, "w", encoding="utf-8") as fh:
-        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, fh)
+    _atomic_dump(out_path, {"traceEvents": merged,
+                            "displayTimeUnit": "ms"})
     return len(merged)

@@ -134,7 +134,7 @@ def format_live(doc: dict) -> str:
     lines = [head,
              f"{'rank':>4}  {'seq':>5}  {'lag':>4}  "
              f"{'state':<34}  {'MB/s':>8}  {'shm%':>5}  "
-             f"{'aud':>5}  {'retries':>7}  hb age"]
+             f"{'aud':>5}  {'sink':>7}  {'retries':>7}  hb age"]
     for r in sorted(ranks, key=int):
         info = ranks[r]
         prog = info.get("progress", {})
@@ -161,6 +161,17 @@ def format_live(doc: dict) -> str:
         # audit column (ISSUE 8): the rank's last audited collective
         # ordinal; "-" until the rank ships audit records
         aud = info.get("audit_seq", 0)
+        # sink column (ISSUE 9): MB the rank's durable sink has made
+        # safe, with a ! marker when it is dropping records; "-" only
+        # when the sink is truly disarmed (no bytes AND no drops — a
+        # full disk writes nothing but drops plenty, and rendering
+        # that as disarmed would hide exactly the failure the marker
+        # exists for)
+        sink_b = info.get("counters", {}).get("sink/bytes", 0)
+        sink_drop = info.get("counters", {}).get(
+            "sink/dropped_records", 0)
+        sink_col = (f"{sink_b / 1e6:.1f}M" + ("!" if sink_drop else "")
+                    if sink_b or sink_drop else "-")
         mark = "*" if int(r) in stragglers else " "
         lines.append(
             f"{mark}{r:>3}  {seq:>5}  {lag if lag else '-':>4}  "
@@ -168,6 +179,7 @@ def format_live(doc: dict) -> str:
             f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:>8.2f}  "
             f"{shm_pct:>5}  "
             f"{aud if aud else '-':>5}  "
+            f"{sink_col:>7}  "
             f"{retries:>7}  {info.get('age', 0.0):.1f}s")
     return "\n".join(lines)
 

@@ -98,6 +98,10 @@ class RecoveryManager:
         # bounded epoch/retry event log — the postmortem bundle's
         # recovery.json (monotonic timestamps: deltas are what matter)
         self._events: collections.deque = collections.deque(maxlen=256)
+        # own lock (NOT _cond: _note runs inside _cond-held sections);
+        # keeps (deque, count) consistent for the sink's cursor math
+        self._events_lock = threading.Lock()
+        self._event_count = 0    # events ever noted (sink cursor)
         # (collective ordinal, in-flight flag) for the abort ack: the
         # master refuses to release a round whose ranks sit at
         # DIFFERENT collectives — recovery is per-collective, and a
@@ -115,11 +119,22 @@ class RecoveryManager:
     # control-thread side
     # ------------------------------------------------------------------
     def _note(self, kind: str, detail: str = "") -> None:
-        self._events.append((time.monotonic(), kind, detail))
+        with self._events_lock:
+            self._events.append((time.monotonic(), kind, detail))
+            self._event_count += 1
 
     def events(self) -> list[tuple]:
         """The bounded epoch/retry event log (postmortem bundle)."""
-        return list(self._events)
+        with self._events_lock:
+            return list(self._events)
+
+    def events_since(self, cursor: int) -> tuple[int, list[tuple], int]:
+        """``(new_cursor, events, dropped)`` — the durable sink's
+        non-destructive delta read over the bounded event log
+        (ISSUE 9), mirroring ``obs.spans.take_since``."""
+        with self._events_lock:
+            return spans.ring_delta(self._events, self._event_count,
+                                    cursor)
 
     def on_abort(self, target: int) -> None:
         """Master announced an abort round targeting ``target``: tear

@@ -249,9 +249,14 @@ class CommStats:
                 e[f"wire_bytes_{tagged}"] += bytes_sent + bytes_recv
             self._last_phase = "wire"
         if spans._enabled:
+            # transport rides the span args too (ISSUE 9): the
+            # critical-path analyzer attributes a dominated ordinal to
+            # a (rank, peer link, transport), so the wire span must
+            # name the plane the bytes rode, not just the peer
             spans.phase("wire", seconds, self.rank, name, seq,
                         bytes_sent=bytes_sent or None,
-                        bytes_recv=bytes_recv or None, peer=peer)
+                        bytes_recv=bytes_recv or None, peer=peer,
+                        transport=tagged)
         # frame-size histogram, one observation per direction moved,
         # split per transport (the ISSUE 7 attribution satellite)
         if self.metrics.enabled:

@@ -86,6 +86,22 @@ Knobs (all validated where they are consumed; garbage raises
 - ``MP4J_AUDIT_RING`` — capacity (records) of the per-rank audit
   record ring; bounds postmortem/replay coverage and, under
   ``capture``, the payload memory held per rank.
+- ``MP4J_SINK`` / ``MP4J_SINK_DIR`` — the durable streaming telemetry
+  sink (``obs/sink.py``): with ``MP4J_SINK_DIR`` set (and ``MP4J_SINK``
+  not ``off``) every rank drains its span/metrics/audit/recovery rings
+  into crc-framed append-only segment files under
+  ``<dir>/rank_NNNN/`` on a background thread, so a multi-day job
+  keeps full history on disk instead of ring tails
+  (``mp4j-scope analyze`` / ``tail``). Unset dir disables the sink.
+- ``MP4J_SINK_BYTES`` — PER-RANK disk budget for sink segments; the
+  writer rotates segments and evicts the oldest whole segment when
+  the rank's directory would exceed it (a job's total footprint is
+  bounded by ``slave_num * MP4J_SINK_BYTES``).
+- ``MP4J_SINK_FLUSH_SECS`` — period of the sink's background drain
+  thread; each drain appends everything new in the source rings as
+  frame-wise unbuffered writes, so a ``kill -9`` loses at most one
+  flush interval of undrained telemetry plus the single frame being
+  written (the torn tail the segment reader detects and reports).
 """
 
 from __future__ import annotations
@@ -130,6 +146,13 @@ DEFAULT_SPAN_RING = 65536
 DEFAULT_AUDIT_MODE = "digest"
 DEFAULT_AUDIT_RING = 1024
 AUDIT_MODES = ("off", "digest", "verify", "capture")
+# Durable-sink defaults (ISSUE 9): armed only when MP4J_SINK_DIR is
+# set. 64 MiB per rank holds hours of span-level history at typical
+# collective rates (one ~120 B span record per chunk/phase); the 1 s
+# flush period bounds kill -9 telemetry loss to one interval while
+# keeping the drain thread's duty cycle negligible.
+DEFAULT_SINK_BYTES = 64 * 1024 * 1024
+DEFAULT_SINK_FLUSH_SECS = 1.0
 # Metrics-plane default (ISSUE 6): the window the master's rate ring
 # covers. Heartbeats arrive every DEFAULT_HEARTBEAT_SECS, so 60 s keeps
 # ~120 interval points per rank — enough for a stable GB/s readout,
@@ -382,6 +405,52 @@ def audit_ring() -> int:
     (``MP4J_AUDIT_RING``); must be >= 1 — disabling the plane is
     ``MP4J_AUDIT=off``, not a zero ring."""
     return env_int("MP4J_AUDIT_RING", DEFAULT_AUDIT_RING, minimum=1)
+
+
+def sink_enabled() -> bool:
+    """Whether the durable telemetry sink may arm (``MP4J_SINK``).
+    ``on``/``1`` (default) lets a set ``MP4J_SINK_DIR`` arm it;
+    ``off``/``0`` pins it off regardless of the dir — the bench A/B
+    knob, mirroring the shm/audit frozen-leg precedent."""
+    raw = os.environ.get("MP4J_SINK")
+    if raw is None or raw.strip() == "":
+        return True
+    val = raw.strip().lower()
+    if val not in ("on", "off", "0", "1"):
+        raise Mp4jError(
+            f"MP4J_SINK={raw!r} must be one of on/off/0/1")
+    return val in ("on", "1")
+
+
+def sink_dir() -> str:
+    """The durable sink's root directory (``MP4J_SINK_DIR``); empty
+    disables the sink. Validated like ``MP4J_POSTMORTEM_DIR`` (must
+    not name an existing regular file — every rank mkdirs under it);
+    creation happens lazily at the first drain."""
+    raw = os.environ.get("MP4J_SINK_DIR", "").strip()
+    if raw and os.path.isfile(raw):
+        raise Mp4jError(
+            f"MP4J_SINK_DIR={raw!r} names an existing regular file, "
+            "not a directory")
+    return raw
+
+
+def sink_bytes() -> int:
+    """PER-RANK disk budget for sink segments (``MP4J_SINK_BYTES``).
+    The floor keeps at least two rotatable segments alive — eviction
+    removes whole segments and must never have to evict the one being
+    written."""
+    return env_bytes("MP4J_SINK_BYTES", DEFAULT_SINK_BYTES,
+                     minimum=128 * 1024)
+
+
+def sink_flush_secs() -> float:
+    """Background drain period of the durable sink
+    (``MP4J_SINK_FLUSH_SECS``); must be positive — the sink is
+    disabled by unsetting ``MP4J_SINK_DIR`` (or ``MP4J_SINK=off``),
+    not by a zero period."""
+    return env_float("MP4J_SINK_FLUSH_SECS", DEFAULT_SINK_FLUSH_SECS,
+                     minimum=0.01)
 
 
 def fault_plan_spec() -> str:
