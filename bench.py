@@ -157,8 +157,11 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
     from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
 
     ctx = mp.get_context("fork")
-    master = Master(procs, timeout=60.0).serve_in_thread()
+    # frozen legs pin MP4J_ELASTIC=off (the shm/audit/sink precedent):
+    # historical figures stay comparable whatever the caller's env says
+    master = Master(procs, timeout=60.0, elastic="off").serve_in_thread()
     q = ctx.Queue()
+    slave_kwargs.setdefault("elastic", "off")
 
     def worker():
         try:
@@ -475,6 +478,184 @@ def bench_socket_recovery_latency(procs=4, reps=9, size=262_144):
         },
     }
     return summary, stats
+
+
+def _run_elastic_job(procs, body, fault_plan, elastic, spare_body=None,
+                     join_timeout=120.0, **slave_kwargs):
+    """Master + ``procs`` worker PROCESSES under an elastic mode, plus
+    one warm-spare process when ``spare_body`` is given (ISSUE 10).
+    Workers that die to an injected kill report ``("killed", rank)``;
+    the spare reports under its adopted rank. Returns ``(results,
+    killed_ranks)`` with results keyed by FINAL rank."""
+    import multiprocessing as mp
+
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+    from ytk_mp4j_tpu.resilience.faults import FaultKill
+
+    ctx = mp.get_context("fork")
+    master = Master(procs, timeout=60.0, elastic=elastic,
+                    spares=1 if spare_body is not None else 0,
+                    adopt_secs=15.0).serve_in_thread()
+    q = ctx.Queue()
+
+    def worker():
+        try:
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=60.0,
+                fault_plan=fault_plan, elastic=elastic,
+                dead_rank_secs=60.0, **slave_kwargs)
+            start_rank = slave.rank
+            try:
+                res = body(slave, slave.rank)
+            except FaultKill:
+                q.put(("killed", start_rank, None))
+                return
+            q.put(("ok", slave.rank, res))
+            slave.close(0)
+        except Exception as e:  # pragma: no cover
+            q.put(("err", -1, repr(e)))
+
+    def spare_worker():
+        try:
+            sp = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=60.0, spare=True,
+                elastic=elastic, dead_rank_secs=60.0, **slave_kwargs)
+            res = spare_body(sp)
+            q.put(("ok", sp.rank, res))
+            sp.close(0)
+        except Exception as e:  # pragma: no cover
+            q.put(("err", -1, repr(e)))
+
+    ps = [ctx.Process(target=worker, daemon=True)
+          for _ in range(procs)]
+    if spare_body is not None:
+        ps.append(ctx.Process(target=spare_worker, daemon=True))
+    for p in ps:
+        p.start()
+    expected = len(ps)
+    results: dict[int, object] = {}
+    killed: list[int] = []
+    deadline = time.monotonic() + join_timeout
+    got = 0
+    while got < expected:
+        try:
+            kind, rank, payload = q.get(timeout=1.0)
+        except pyqueue.Empty:
+            dead = [p.exitcode for p in ps
+                    if not p.is_alive() and p.exitcode not in (0, None)]
+            if dead or time.monotonic() > deadline:
+                for p in ps:
+                    p.terminate()
+                raise RuntimeError(
+                    f"elastic benchmark stalled (exit codes {dead}, "
+                    f"{got}/{expected} reported)")
+            continue
+        if kind == "err":
+            for p in ps:
+                p.terminate()
+            raise RuntimeError(f"elastic benchmark worker: {payload}")
+        if kind == "killed":
+            killed.append(rank)
+        else:
+            results[rank] = payload
+        got += 1
+    for p in ps:
+        p.join(10.0)
+    master.join(10.0)
+    return results, killed
+
+
+def _timed_elastic_loop(reps):
+    """The shared per-iteration-timed allreduce loop of both elastic
+    latency legs, plus the spare's resume half (skips the barrier of
+    the iteration it resumes INTO — that generation completed before
+    the kill could fire, see README 'Elastic membership'). The kill
+    point lives ONLY in the caller's fault-plan string."""
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    size = 262_144
+
+    def body(slave, r):
+        buf = np.ones(size, np.float32)
+        times = []
+        for _ in range(reps):
+            slave.barrier()
+            t0 = time.perf_counter()
+            slave.allreduce_array(buf, Operands.FLOAT, Operators.SUM)
+            times.append(time.perf_counter() - t0)
+        return times
+
+    def spare_body(sp):
+        buf = np.ones(size, np.float32)
+        times = []
+        for k in range(sp.resume_seq + 1, reps + 1):
+            if not (k == sp.resume_seq + 1
+                    and sp.resume_barrier_gen > sp.resume_seq):
+                sp.barrier()
+            t0 = time.perf_counter()
+            sp.allreduce_array(buf, Operands.FLOAT, Operators.SUM)
+            times.append(time.perf_counter() - t0)
+        return times
+
+    return body, spare_body
+
+
+def bench_socket_replacement_latency(procs=4, reps=9):
+    """ISSUE 10 acceptance workload (replace): ``kill -9`` one rank
+    mid-loop with a warm spare registered and measure kill -> adopted
+    spare -> first completed collective, as the faulted iteration's
+    wall time over the healthy median on the SURVIVORS (the spare's
+    first collective completes inside that same window — survivors
+    cannot finish the retry without its contribution). Asserts the
+    replacement actually happened (a silently-fatal run would report
+    garbage)."""
+    fault_at = reps // 2 + 1
+    body, spare_body = _timed_elastic_loop(reps)
+    results, killed = _run_elastic_job(
+        procs, body, f"kill:rank=1:nth={fault_at}", "replace",
+        spare_body=spare_body, shm=False, audit="off", sink_dir="")
+    if killed != [1] or len(results) != procs:
+        raise RuntimeError(
+            f"replacement bench: expected rank 1 killed + {procs} "
+            f"finishers, got killed={killed} results={sorted(results)}")
+    survivors = [r for r in range(procs) if r != 1]
+    per_iter = [max(results[r][k] for r in survivors)
+                for k in range(reps)]
+    healthy = sorted(per_iter[:fault_at - 1] + per_iter[fault_at:])
+    median = healthy[len(healthy) // 2]
+    return {
+        "replacement_latency_ms": round(
+            (per_iter[fault_at - 1] - median) * 1e3, 3),
+        "healthy_iter_ms": round(median * 1e3, 3),
+        "spare_iters": len(results[1]),
+    }
+
+
+def bench_socket_shrink_latency(procs=4, reps=9):
+    """ISSUE 10 acceptance workload (shrink): same kill, no spare —
+    survivors renumber to n-1 and continue; the figure is the faulted
+    iteration's wall time over the healthy median."""
+    fault_at = reps // 2 + 1
+    body, _ = _timed_elastic_loop(reps)
+    results, killed = _run_elastic_job(
+        procs, body, f"kill:rank=1:nth={fault_at}", "shrink",
+        shm=False, audit="off", sink_dir="")
+    if killed != [1] or len(results) != procs - 1:
+        raise RuntimeError(
+            f"shrink bench: expected rank 1 killed + {procs - 1} "
+            f"finishers, got killed={killed} results={sorted(results)}")
+    per_iter = [max(results[r][k] for r in results)
+                for k in range(reps)]
+    healthy = sorted(per_iter[:fault_at - 1] + per_iter[fault_at:])
+    median = healthy[len(healthy) // 2]
+    return {
+        "shrink_latency_ms": round(
+            (per_iter[fault_at - 1] - median) * 1e3, 3),
+        "healthy_iter_ms": round(median * 1e3, 3),
+        "final_ranks": len(results),
+    }
 
 
 def bench_audit_overhead(rounds=2):
@@ -871,6 +1052,8 @@ def main():
                                               columnar=False)
     map_sweep, map_sweep_stats = bench_socket_map_sweep()
     recovery, recovery_stats = bench_socket_recovery_latency()
+    replacement = bench_socket_replacement_latency()
+    shrinkage = bench_socket_shrink_latency()
     (tpu_gbs, trees_per_sec, n_chips, gbdt_fps,
      gbdt_hist_fps) = bench_tpu(n=n_tpu)
     ffm_steps, ffm_fps = bench_ffm_tpu()
@@ -938,6 +1121,20 @@ def main():
             # 1-core loopback host amplifies because its "wire" is
             # itself memcpy (see bench_socket_recovery_latency doc)
             "socket_recovery": recovery,
+            # scalar alias for bench-diff gating (lower is better)
+            "socket_recovery_latency_ms": recovery[
+                "recovery_latency_ms"],
+            # mp4j-elastic (ISSUE 10): kill -> adopted spare (or n-1
+            # shrink) -> first completed collective, measured as the
+            # faulted iteration's wall time over the healthy median;
+            # frozen legs elsewhere pin MP4J_ELASTIC=off so these are
+            # the ONLY figures that pay the membership machinery
+            "socket_replacement_latency_ms": replacement[
+                "replacement_latency_ms"],
+            "socket_shrink_latency_ms": shrinkage[
+                "shrink_latency_ms"],
+            "socket_elastic": {"replace": replacement,
+                               "shrink": shrinkage},
             # merged cross-rank comm.stats() snapshot per socket
             # workload: where the wire/reduce/serialize budget actually
             # went (schema: ytk_mp4j_tpu/utils/stats.py)

@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 15)]
+    assert ids == [f"R{i}" for i in range(1, 16)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1071,4 +1071,90 @@ def test_r14_inline_and_baseline_suppression():
                 with open(path, "ab") as fh:
                     fh.write(frame)
     """, path=OBS_PATH, baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R15 — roster-derived topology cached in a long-lived attribute
+# ----------------------------------------------------------------------
+def test_r15_fires_on_topology_caches():
+    r = run_rule("R15", """
+        class Slave:
+            def __init__(self, roster):
+                self._n = len(roster)
+                self._fanout = self._n - 1          # cached count
+                self._right = (self._rank + 1) % self._n
+
+            def _prepare(self):
+                self._peer_ports = [e[1] for e in self._roster]
+
+            def _regroup(self):
+                self._groups = self._derive_host_groups(self._roster)
+    """)
+    assert [f.line for f in r.findings] == [5, 6, 9, 12]
+    assert all("topology" in f.message for f in r.findings)
+    assert all("_set_roster" in f.message for f in r.findings)
+
+
+def test_r15_quiet_on_use_time_reads_and_locals():
+    assert not run_rule("R15", """
+        class Slave:
+            def _channel(self, peer):
+                if not (0 <= peer < self._n):       # read at use time
+                    raise ValueError(peer)
+                n = self._n                          # local, not cached
+                return [(r + 1) % n for r in range(n)]
+
+            def _dial(self, peer):
+                host, port = self._roster[peer][0], self._roster[peer][1]
+                return (host, port)
+
+            def fanout(self):
+                return self._n - 1                   # derived, returned
+
+            def __init__(self, rank, n):
+                self._rank = rank                    # param, not derived
+                self._n = n
+                self._timeout = 5.0
+                # cosmetic identity: a thread NAME is not a schedule
+                self._name = f"mp4j-ctl-r{self._rank}"
+    """).findings
+
+
+def test_r15_scoped_to_comm_classes():
+    src = """
+        class Grid:
+            def __init__(self):
+                self._fanout = self._n - 1
+    """
+    assert run_rule("R15", src).findings
+    assert not run_rule("R15", src,
+                        path="ytk_mp4j_tpu/obs/snippet.py").findings
+    # module-level / free functions take topology as arguments
+    assert not run_rule("R15", """
+        def fanout(n):
+            return n - 1
+    """).findings
+
+
+def test_r15_inline_and_baseline_suppression():
+    r = run_rule("R15", """
+        class Slave:
+            def _set_roster(self, roster):
+                # mp4j-lint: disable=R15 (the sanctioned accessor)
+                self._groups = self._derive_host_groups(self._roster)
+    """)
+    assert not r.findings and len(r.suppressed) == 1
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R15"
+        file = "ytk_mp4j_tpu/comm/snippet.py"
+        context = "Slave._sync_identity"
+        reason = "the one sanctioned mirror site"
+    """))
+    r = run_rule("R15", """
+        class Slave:
+            def _sync_identity(self):
+                self._stats.rank = self._rank
+    """, baseline=bl)
     assert not r.findings and len(r.suppressed) == 1

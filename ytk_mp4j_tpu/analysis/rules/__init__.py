@@ -16,6 +16,7 @@
 | R12 | error   | transport construction outside transport/ (SPI) |
 | R13 | error   | raw-byte read of a possibly non-contiguous array |
 | R14 | error   | telemetry artifact write skipping tmp+os.replace |
+| R15 | error   | roster-derived topology cached in an attribute |
 """
 
 from __future__ import annotations
@@ -46,6 +47,8 @@ from ytk_mp4j_tpu.analysis.rules.r12_transport_spi import (
 from ytk_mp4j_tpu.analysis.rules.r13_digest_contiguity import (
     R13DigestContiguity)
 from ytk_mp4j_tpu.analysis.rules.r14_torn_write import R14TornWrite
+from ytk_mp4j_tpu.analysis.rules.r15_topology_cache import (
+    R15TopologyCache)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -62,6 +65,7 @@ ALL_RULES = [
     R12TransportSpiBypass,
     R13DigestContiguity,
     R14TornWrite,
+    R15TopologyCache,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

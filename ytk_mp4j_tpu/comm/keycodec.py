@@ -240,6 +240,32 @@ class IntKeyCodec:
         self._sorted_codes = self._sorted_codes[keep]
         self._partitions.truncate(size)
 
+    def export(self, size: int | None = None) -> list:
+        """The first ``size`` keys in CODE order — the rank-replacement
+        manifest's vocabulary payload (ISSUE 10). Code order is the
+        load-bearing part: the joining spare rebuilds its tables with
+        :meth:`import_keys`, and only an identical key->code assignment
+        keeps the job-wide columnar invariant."""
+        n = self._by_code.size if size is None else min(
+            size, self._by_code.size)
+        return self._by_code[:n].tolist()
+
+    def import_keys(self, keys) -> None:
+        """Rebuild an EMPTY codec from an exported key list, assigning
+        code i to ``keys[i]`` — NOT ``encode`` (which orders a novel
+        batch by sorted key, the per-call canonical rule, and would
+        scramble a vocabulary grown over many calls)."""
+        if self._by_code.size:
+            raise Mp4jError("import_keys requires an empty codec")
+        ks = np.asarray(list(keys), np.int64)
+        if ks.size >= int(SENTINEL):
+            raise Mp4jError("key vocabulary overflows int32 codes")
+        self._by_code = ks
+        codes = np.arange(ks.size, dtype=np.int32)
+        order = np.argsort(ks, kind="stable")
+        self._sorted = ks[order]
+        self._sorted_codes = codes[order]
+
 
 class ObjKeyCodec:
     """Grow-only hashable-key <-> int32 code vocabulary."""
@@ -307,3 +333,21 @@ class ObjKeyCodec:
         del self._by_code[size:]
         self._arr = None
         self._partitions.truncate(size)
+
+    def export(self, size: int | None = None) -> list:
+        """See :meth:`IntKeyCodec.export`."""
+        n = len(self._by_code) if size is None else min(
+            size, len(self._by_code))
+        return list(self._by_code[:n])
+
+    def import_keys(self, keys) -> None:
+        """See :meth:`IntKeyCodec.import_keys` (insertion order IS code
+        order for this codec)."""
+        if self._by_code:
+            raise Mp4jError("import_keys requires an empty codec")
+        keys = list(keys)
+        if len(keys) >= int(SENTINEL):
+            raise Mp4jError("key vocabulary overflows int32 codes")
+        self._by_code = keys
+        self._code = {k: i for i, k in enumerate(keys)}
+        self._arr = None

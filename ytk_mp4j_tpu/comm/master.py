@@ -50,6 +50,7 @@ from ytk_mp4j_tpu.obs import audit as audit_mod
 from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import postmortem as postmortem_mod
 from ytk_mp4j_tpu.obs import telemetry as telemetry_mod
+from ytk_mp4j_tpu.resilience import membership as membership_mod
 from ytk_mp4j_tpu.transport.channel import Channel
 from ytk_mp4j_tpu.transport.tcp import TcpChannel
 from ytk_mp4j_tpu.utils import stats as stats_mod
@@ -64,6 +65,29 @@ TELEMETRY = "telemetry"   # periodic heartbeat: {progress, stats}
 DIAGNOSE = "diagnose"     # a slave's bounded wait expired; report it
 ABORT_REQ = "abort_req"   # a collective failed; start an abort round
 ABORT_ACK = "abort_ack"   # slave finished tearing down the old epoch
+SPARE_PING = "spare_ping"  # an idle warm spare proving liveness
+ADOPT_ACK = "adopt_ack"   # a spare finished seeding its adopted rank
+MANIFEST = "manifest"     # a survivor's adoption manifest contribution
+
+
+class _Slot:
+    """One connected slave: its channel, a per-channel send lock
+    (master->slave pushes may originate on any serve thread), and a
+    MUTABLE rank — a shrink round renumbers survivors, and the serve
+    thread must attribute every later message to the rank the slave
+    currently holds, not the one it registered with (ISSUE 10)."""
+
+    __slots__ = ("rank", "ch", "lock", "dead")
+
+    def __init__(self, rank: int, ch: Channel):
+        self.rank = rank
+        self.ch = ch
+        self.lock = threading.Lock()
+        # set when the rank is DECLARED dead while its channel still
+        # answers (watchdog escalation): the serve thread must stop
+        # attributing this zombie's messages to a rank id that a
+        # replacement spare may now legitimately hold
+        self.dead = False
 
 
 class Master:
@@ -77,7 +101,10 @@ class Master:
                  dead_rank_secs: float | None = None,
                  metrics_port: int | None = None,
                  postmortem_dir: str | None = None,
-                 sink_dir: str | None = None):
+                 sink_dir: str | None = None,
+                 elastic: str | None = None,
+                 spares: int | None = None,
+                 adopt_secs: float | None = None):
         """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
         bounds each accepted connection's registration message, so one
         stray dial-in stalls rendezvous briefly instead of consuming the
@@ -110,12 +137,30 @@ class Master:
         ``MP4J_SINK``; empty disables) names the job's durable-sink
         root in that manifest so ``mp4j-scope postmortem`` joins the
         full-job segment history — the same constructor seam as
-        ``postmortem_dir``."""
+        ``postmortem_dir``.
+
+        ``elastic`` (ISSUE 10; None reads ``MP4J_ELASTIC``, default
+        ``off``) selects the elastic-membership mode: ``off`` keeps
+        the pre-elastic contract (a dead rank is a job-wide
+        ``Mp4jFatalError``), ``replace`` adopts a warm spare into the
+        dead rank's id at the next epoch (bit-exact continuation),
+        ``shrink`` renumbers the survivors and continues at n-1.
+        ``spares`` (None reads ``MP4J_SPARES``) is how many warm-spare
+        registrations rendezvous waits for before the job starts;
+        spares may also register later, mid-job. ``adopt_secs`` (None
+        reads ``MP4J_ADOPT_SECS``) bounds each adoption handshake
+        before the next spare is tried."""
         self.slave_num = slave_num
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
         self.stall_timeout = stall_timeout
         self.dead_rank_secs = tuning.dead_rank_secs(dead_rank_secs)
+        # elastic knobs validated BEFORE any socket binds (a knob
+        # conflict must not leak a bound listener out of a failed
+        # constructor — the metrics-server precedent)
+        self.elastic = tuning.elastic_mode(elastic)
+        self._spares_expected = tuning.spares(spares)
+        self._adopt_secs = tuning.adopt_secs(adopt_secs)
         self.log_stream = log_stream if log_stream is not None else sys.stderr
         # log sink config: validated once at construction (a typo'd
         # MP4J_LOG_LEVEL fails the job here, not silently mid-run)
@@ -133,14 +178,16 @@ class Master:
         self._server.bind((host or "0.0.0.0", port))
         self._server.listen(slave_num * 2)
         self.port = self._server.getsockname()[1]
-        self._channels: list[Channel] = []      # by rank after rendezvous
-        # master->slave pushes (barrier releases, abort fan-outs) may
-        # originate on any serve thread; one lock per slave channel
-        # keeps concurrent pushes from interleaving frame bytes
-        self._send_locks: list[threading.Lock] = []
+        self._slots: list[_Slot] = []           # by CURRENT rank
         self._exit_codes: dict[int, int] = {}
         self._barrier_waiting: dict[int, list[int]] = {}  # gen -> ranks
         self._barrier_since: dict[int, float] = {}        # gen -> mono ts
+        # highest generation ever released: an adopted joiner seeded
+        # from a manifest sampled a beat early may re-send an already-
+        # released generation — release it back to that rank alone
+        # instead of opening a ghost generation nobody else will join
+        # (ISSUE 10)
+        self._barrier_max_released = -1
         self._diagnosed_gens: set[int] = set()
         self._diag_incident_seq: int | None = None  # debounce key
         # recovery protocol state (ISSUE 5)
@@ -150,6 +197,23 @@ class Master:
         self._abort_since: float | None = None  # mono ts of open round
         self._departed: dict[int, str] = {}     # rank -> why it left
         self._fatal_msg: str | None = None      # terminal abort, once
+        # elastic membership (ISSUE 10): warm-spare pool + the open
+        # round's membership extension (kind/dead/manifest/adoptions).
+        # All guarded by self._lock like the abort state.
+        self._membership = membership_mod.MembershipLog(self.elastic)
+        self._spare_pool: list[membership_mod.SpareRecord] = []
+        self._spare_seq = 0                     # spares ever registered
+        self._spare_threads: list[threading.Thread] = []
+        self._serve_threads: list[threading.Thread] = []
+        self._roster: list[tuple] = []          # current (host, port, fp)
+        self._round_kind: str | None = None     # None/'abort'/mode
+        self._round_dead: dict[int, str] = {}   # this round's casualties
+        self._round_why = ""                    # first casualty's message
+        self._round_manifest: dict | None = None
+        self._round_manifest_from: int | None = None
+        self._round_seq: int | None = None      # joiner resume ordinal
+        self._round_adoptions: dict[int, membership_mod.SpareRecord] = {}
+        self._round_adopted: dict[int, membership_mod.SpareRecord] = {}
         # rank -> last heartbeat: progress fields + stats + arrival time
         self._telemetry: dict[int, dict] = {}
         # audit plane (ISSUE 8): folds heartbeat digest-record deltas
@@ -220,12 +284,20 @@ class Master:
 
     def _serve(self) -> int:
         self._rendezvous()
-        threads = []
-        for rank, ch in enumerate(self._channels):
-            t = threading.Thread(target=self._serve_slave, args=(rank, ch),
-                                 daemon=True, name=f"master-slave{rank}")
-            t.start()
-            threads.append(t)
+        with self._lock:
+            for slot in self._slots:
+                t = threading.Thread(target=self._serve_slave,
+                                     args=(slot,), daemon=True,
+                                     name=f"master-slave{slot.rank}")
+                t.start()
+                self._serve_threads.append(t)
+        # late spare registrations (ISSUE 10): a replacement spare may
+        # dial in any time after the job started; the rendezvous
+        # listener stays open for exactly that
+        spare_accept = threading.Thread(target=self._spare_accept_loop,
+                                        daemon=True,
+                                        name="mp4j-spare-accept")
+        spare_accept.start()
         # the watchdog now also drives the dead-rank ESCALATION
         # (ISSUE 5): it must run even with stall_timeout=None —
         # disabling the diagnosis must not silently disable the
@@ -241,10 +313,24 @@ class Master:
                                         name="mp4j-watchdog")
             watchdog.start()
         try:
-            for t in threads:
+            # the list GROWS when a spare is adopted (its serve thread
+            # becomes the rank's), so re-read it until drained
+            i = 0
+            while True:
+                with self._lock:
+                    if i >= len(self._serve_threads):
+                        break
+                    t = self._serve_threads[i]
+                i += 1
                 t.join()
         finally:
             self._stop.set()
+            # unadopted spares idle in a blocking recv: release them
+            # so their constructors raise Mp4jSpareReleased instead of
+            # waiting out a timeout against a finished job
+            self._release_spares(
+                self._fatal_msg or "job completed without adopting "
+                "this spare")
         if watchdog is not None:
             watchdog.join(2.0)
         # serve()'s finally closes the listener, refreshes the
@@ -269,18 +355,25 @@ class Master:
     def _rendezvous(self):
         """Accept slave registrations; assign ranks in registration order
         (pinned free choice — the reference's exact rule is unverified);
-        broadcast the roster to all."""
+        broadcast the roster to all. Warm spares (``spare: True`` in the
+        REGISTER payload, ISSUE 10) are parked in the spare pool instead
+        of claiming a rank; rendezvous additionally waits for
+        ``spares`` of them so a job configured with spares starts with
+        its pool warm."""
         deadline = (None if self.timeout is None
                     else time.monotonic() + self.timeout)
-        pending = []  # (channel, (host, listen_port))
+        pending = []  # (channel, (host, listen_port, fp))
         self._server.settimeout(1.0)
-        while len(pending) < self.slave_num:
+        while (len(pending) < self.slave_num
+               or len(self._spare_pool) < self._spares_expected):
             if deadline is not None and time.monotonic() > deadline:
                 got = [hp for _, hp in pending]
                 raise Mp4jError(
                     f"rendezvous timeout: {len(pending)}/{self.slave_num} "
-                    f"slaves registered (heard from: {got or 'none'} — "
-                    "the missing slaves never dialed in)")
+                    f"slaves and {len(self._spare_pool)}/"
+                    f"{self._spares_expected} spares registered (heard "
+                    f"from: {got or 'none'} — the missing slaves never "
+                    "dialed in)")
             try:
                 sock, addr = self._server.accept()
             except socket.timeout:
@@ -309,28 +402,48 @@ class Master:
                 # share iff they can attach each other's shm segments;
                 # "" means the slave opted out (MP4J_SHM=0)
                 fp = str(payload.get("fp") or "") if ok else ""
+                is_spare = bool(payload.get("spare")) if ok else False
             except Exception:
                 ok = False
             if not ok:
                 ch.close()
                 continue
             ch.set_timeout(None)  # control plane is fail-stop from here
+            if is_spare:
+                self._register_spare(ch, (host, listen_port, fp))
+                continue
+            if len(pending) >= self.slave_num:
+                # every rank is claimed; rendezvous only stays open
+                # for the spares it is still waiting on — a surplus
+                # non-spare dial-in must not mint an out-of-range rank
+                ch.close()
+                continue
             pending.append((ch, (host, listen_port, fp)))
         roster = [hp for _, hp in pending]
+        self._roster = roster
         for rank, (ch, _) in enumerate(pending):
             ch.send_obj({"rank": rank, "roster": roster,
                          "job": self.job_id})
-            self._channels.append(ch)
-            self._send_locks.append(threading.Lock())
+            self._slots.append(_Slot(rank, ch))
 
-    def _serve_slave(self, rank: int, ch: Channel):
+    def _serve_slave(self, slot: _Slot):
+        ch = slot.ch
         try:
             while True:
                 kind, payload = ch.recv()
+                if slot.dead:
+                    # a zombie: this rank was declared dead and its id
+                    # may already belong to a replacement — drop the
+                    # connection instead of laundering its messages
+                    ch.close()
+                    return
+                # the CURRENT rank, re-read per message: a shrink round
+                # renumbers survivors mid-job (ISSUE 10)
+                rank = slot.rank
                 if kind == LOG:
                     self._log(rank, payload["level"], payload["msg"])
                 elif kind == BARRIER:
-                    self._barrier(rank, payload["gen"], ch)
+                    self._barrier(slot, payload["gen"])
                 elif kind == TELEMETRY:
                     self._record_telemetry(rank, payload)
                 elif kind == DIAGNOSE:
@@ -339,22 +452,35 @@ class Master:
                     self._handle_abort_req(rank, payload)
                 elif kind == ABORT_ACK:
                     self._handle_abort_ack(rank, payload)
+                elif kind == MANIFEST:
+                    self._handle_manifest(rank, payload)
                 elif kind == CLOSE:
                     code = payload["code"]
                     with self._lock:
-                        self._exit_codes[rank] = code
+                        already_dead = rank in self._departed
+                        if not already_dead:
+                            self._exit_codes[rank] = code
                         live_left = (set(range(self.slave_num))
                                      - set(self._departed)
                                      - set(self._exit_codes))
-                    with self._send_locks[rank]:
+                    with slot.lock:
                         ch.send_obj("closed")
                     ch.close()
+                    if already_dead:
+                        # this rank's death is already being handled
+                        # (declared dead, possibly replaced): its late
+                        # close must not re-kill the job
+                        return
                     self._mark_departed(
                         rank, f"closed with code {code}")
                     if code != 0 and live_left:
                         # a nonzero close is a defect report; peers
                         # blocked on this rank's data would otherwise
-                        # only find out at their own (long) timeouts
+                        # only find out at their own (long) timeouts.
+                        # Deliberately NOT an elastic trigger: the
+                        # process defected with its own error — its
+                        # state is suspect, replacement would launder a
+                        # defect into "recovery"
                         self._fatal_abort(
                             f"rank {rank} exited with code {code} "
                             "before the job completed; aborting the "
@@ -366,14 +492,16 @@ class Master:
             # a dead slave (reset, EOF, corrupt frame) marks a nonzero
             # exit code and the master keeps serving the others — but
             # no longer silently (ISSUE 5): a lost connection means the
-            # process died without closing, so the job cannot complete;
-            # fan out the terminal abort so every survivor raises the
-            # same clean error instead of timing out one by one
+            # process died without closing, so the job cannot complete
+            # under MP4J_ELASTIC=off. The elastic modes (ISSUE 10)
+            # dispatch through _on_rank_dead instead: replacement from
+            # a warm spare, or a contiguous shrink of the survivors.
+            rank = slot.rank
             self._log(rank, "ERROR", f"slave connection lost: {e!r}")
             with self._lock:
                 self._exit_codes.setdefault(rank, 1)
-            self._mark_departed(rank, f"connection lost ({e!r})")
-            self._fatal_abort(
+            self._on_rank_dead(
+                rank, f"connection lost ({e!r})",
                 f"rank {rank} is dead (connection lost: {e!r}); "
                 "aborting the job")
 
@@ -382,8 +510,8 @@ class Master:
         """Push one control message to a slave; a rank that dies while
         we push is marked departed, never crashes a serve thread."""
         try:
-            with self._send_locks[rank]:
-                self._channels[rank].send_obj(obj)
+            with self._slots[rank].lock:
+                self._slots[rank].ch.send_obj(obj)
         except (Mp4jError, OSError):
             self._mark_departed(rank, "unreachable on push")
 
@@ -397,7 +525,10 @@ class Master:
             pending = self._abort_since is not None
         if pending:
             # an open abort round can never complete without this rank
-            self._fatal_abort(
+            # — terminal under MP4J_ELASTIC=off; the elastic modes
+            # extend the round into a membership round instead
+            self._on_rank_dead(
+                rank, why,
                 f"rank {rank} left during recovery ({why}); "
                 "aborting the job")
 
@@ -413,10 +544,7 @@ class Master:
                 dup = True      # round already fanned out; debounce
             else:
                 dup = False
-                self._abort_epoch = target
-                self._abort_acks = set()
-                self._abort_progress = {}
-                self._abort_since = time.monotonic()
+                self._open_round_locked(target)
                 dead = dict(self._departed)
         self._log(rank, "ERROR",
                   f"collective '{payload.get('collective')}' failed "
@@ -425,9 +553,20 @@ class Master:
         if dup:
             return
         if dead:
-            self._fatal_abort(
-                f"cannot recover: rank(s) {sorted(dead)} already gone "
-                f"({'; '.join(f'{r}: {w}' for r, w in sorted(dead.items()))})")
+            msg = (f"cannot recover: rank(s) {sorted(dead)} already gone "
+                   f"({'; '.join(f'{r}: {w}' for r, w in sorted(dead.items()))})")
+            if self.elastic == "off":
+                self._fatal_abort(msg)
+                return
+            # elastic (ISSUE 10): the departed ranks become this
+            # round's casualties — the round just opened fans out
+            # below, then the membership machinery takes over
+            self._log("M", "WARN",
+                      f"abort round -> epoch {target}: tearing down "
+                      f"the data plane on all surviving ranks")
+            for r in sorted(self._live_ranks()):
+                self._send_to(r, ("abort", target))
+            self._begin_membership(dead, msg)
             return
         self._log("M", "WARN",
                   f"abort round -> epoch {target}: tearing down the "
@@ -435,32 +574,514 @@ class Master:
         for r in sorted(self._live_ranks()):
             self._send_to(r, ("abort", target))
 
+    def _open_round_locked(self, target: int) -> None:
+        """Reset the round state for a new abort round (caller holds
+        the lock and has verified ``target`` advances the epoch)."""
+        self._abort_epoch = target
+        self._abort_acks = set()
+        self._abort_progress = {}
+        self._abort_since = time.monotonic()
+        self._round_kind = "abort"
+        self._round_dead = {}
+        self._round_why = ""
+        self._round_manifest = None
+        self._round_manifest_from = None
+        self._round_seq = None
+        self._round_adoptions = {}
+        self._round_adopted = {}
+
     def _handle_abort_ack(self, rank: int, payload: dict) -> None:
-        release = False
         with self._lock:
             if int(payload.get("epoch", 0)) != self._abort_epoch:
                 return          # ack for a stale round
             self._abort_acks.add(rank)
             self._abort_progress[rank] = (int(payload.get("seq", 0)),
                                           bool(payload.get("inflight")))
+        self._try_advance_round()
+
+    def _handle_manifest(self, rank: int, payload: dict) -> None:
+        """A survivor's adoption-manifest contribution (ISSUE 10):
+        pinned keycodec vocabularies + its progress/barrier position."""
+        with self._lock:
+            if (int(payload.get("epoch", 0)) != self._abort_epoch
+                    or self._round_kind != "replace"):
+                return          # stale round, or mode changed
+            self._round_manifest = payload
+            self._round_manifest_from = rank
+        self._try_advance_round()
+
+    # -- elastic membership (ISSUE 10) ----------------------------------
+    def _on_rank_dead(self, rank: int, why: str, fatal_msg: str) -> None:
+        """Central dead-rank dispatch. ``fatal_msg`` is EXACTLY the
+        message the pre-elastic master fanned out — used verbatim when
+        elastic membership is off (the MP4J_ELASTIC=off contract is
+        bit-for-bit the old behavior) or cannot help."""
+        with self._lock:
+            already = self._fatal_msg is not None
+            pending = self._abort_since is not None
+        if self.elastic == "off" or already:
+            with self._lock:
+                self._departed.setdefault(rank, why)
+            if pending:
+                # pre-elastic precedence: an open abort round can
+                # never complete without this rank, and THAT message
+                # is the one the old _mark_departed fanned out first
+                self._fatal_abort(
+                    f"rank {rank} left during recovery ({why}); "
+                    "aborting the job")
+            self._fatal_abort(fatal_msg)   # debounced if above fired
+            return
+        self._begin_membership({rank: why}, fatal_msg)
+
+    def _begin_membership(self, dead: dict[int, str],
+                          fatal_msg: str) -> None:
+        """Open (or extend) a membership round for the newly dead
+        ranks: fan out the abort if no round is open, upgrade the
+        round's kind to the elastic mode, request the adoption
+        manifest (replace), and push a terminal notice to any declared-
+        dead rank whose control channel still answers (a watchdog-
+        declared straggler must learn it was replaced, not hang)."""
+        notify: list[tuple[_Slot, Channel]] = []
+        fan_abort = False
+        manifest_req: int | None = None
+        fatal: str | None = None
+        with self._lock:
+            if self._fatal_msg is not None:
+                return
+            mode = self.elastic
+            fresh = {r: w for r, w in dead.items()
+                     if r not in self._round_dead}
+            for r, w in dead.items():
+                self._departed.setdefault(r, w)
+            if self._abort_since is None:
+                self._open_round_locked(self._abort_epoch + 1)
+                fan_abort = True
+            self._round_kind = mode
+            for r, w in fresh.items():
+                self._round_dead[r] = w
+                if not self._round_why:
+                    self._round_why = fatal_msg
+                slot = (self._slots[r]
+                        if 0 <= r < len(self._slots) else None)
+                if slot is not None:
+                    slot.dead = True
+                    notify.append((slot, slot.ch))
             live = set(range(self.slave_num)) - set(self._departed)
-            if self._abort_since is not None and live <= self._abort_acks:
-                release = True
+            if not live:
+                fatal = fatal_msg + "; no surviving rank left"
+            elif mode == "replace":
+                avail = sum(1 for s in self._spare_pool
+                            if s.alive and s.adopting_rank is None)
+                if avail < (len(self._round_dead)
+                            - len(self._round_adopted)
+                            - len(self._round_adoptions)):
+                    # today's clean Mp4jFatalError: elasticity was
+                    # requested but the pool cannot cover the loss
+                    fatal = (fatal_msg
+                             + "; no warm spare available to replace "
+                             f"rank(s) {sorted(self._round_dead)}")
+                elif (self._round_manifest is None
+                        and (self._round_manifest_from is None
+                             or self._round_manifest_from not in live)):
+                    manifest_req = min(live)
+                    self._round_manifest_from = manifest_req
+            target = self._abort_epoch
+        if fatal is not None:
+            self._fatal_abort(fatal)
+            return
+        for slot, ch in notify:
+            # best-effort: the rank was DECLARED dead, but a merely
+            # wedged process should still raise the same clean error
+            try:
+                with slot.lock:
+                    ch.send_obj(("abort_fatal", fatal_msg))
+            except (Mp4jError, OSError):
+                pass
+        if dead:
+            self._log(
+                "M", "WARN",
+                f"membership round ({mode}) -> epoch {target}: "
+                f"rank(s) {sorted(dead)} declared dead "
+                f"({'; '.join(f'{r}: {w}' for r, w in sorted(dead.items()))})")
+        if fan_abort:
+            for r in sorted(self._live_ranks()):
+                self._send_to(r, ("abort", target))
+        if manifest_req is not None:
+            self._send_to(manifest_req, ("manifest_req", target))
+        self._try_advance_round()
+
+    def _next_spare_locked(self):
+        for rec in self._spare_pool:
+            if rec.alive and rec.adopting_rank is None:
+                return rec
+        return None
+
+    def _try_advance_round(self) -> None:
+        """Evaluate the open round against its completion condition and
+        take the next step: release a plain abort round, start spare
+        adoptions, or finalize a membership round. Re-entered whenever
+        an input lands — an ack, a departure, the manifest, an adopt
+        ack, a spare death."""
+        adopts: list[tuple[int, object, dict]] = []
+        fatal: str | None = None
+        release = None
+        with self._lock:
+            if self._abort_since is None or self._fatal_msg is not None:
+                return
+            live = set(range(self.slave_num)) - set(self._departed)
+            if not live or not live <= self._abort_acks:
+                return
+            kind = self._round_kind or "abort"
+            epoch = self._abort_epoch
+            progress = {r: self._abort_progress.get(r, (0, False))
+                        for r in sorted(live)}
+            mixed = self._mixed_progress(progress)
+            if mixed is not None:
+                fatal = mixed
+            elif kind == "abort":
                 self._abort_since = None
-                epoch = self._abort_epoch
-                progress = {r: self._abort_progress.get(r, (0, False))
-                            for r in sorted(live)}
-        if not release:
+                self._round_kind = None
+                release = ("abort", epoch, None, sorted(live), [], ())
+            elif kind == "replace":
+                if self._round_manifest is not None:
+                    if self._round_seq is None:
+                        self._round_seq = membership_mod.joiner_seq(
+                            progress)
+                    need = [r for r in sorted(self._round_dead)
+                            if r not in self._round_adoptions]
+                    for r in need:
+                        rec = self._next_spare_locked()
+                        if rec is None:
+                            fatal = (self._round_why
+                                     + "; no warm spare available to "
+                                     f"replace rank {r}")
+                            break
+                        rec.adopting_rank = r
+                        rec.adopt_since = time.monotonic()
+                        self._round_adoptions[r] = rec
+                    if fatal is None:
+                        man = self._round_manifest
+                        repl = {r2: rec2.entry for r2, rec2
+                                in self._round_adoptions.items()}
+                        roster = membership_mod.swap_roster(
+                            self._roster, repl)
+                        for r in need:
+                            rec = self._round_adoptions[r]
+                            adopts.append((r, rec, {
+                                "rank": r, "epoch": epoch,
+                                "roster": roster, "job": self.job_id,
+                                "seq": self._round_seq,
+                                # the donor's CommStats position (it
+                                # counts nested collectives the
+                                # recovery ordinal does not) keeps the
+                                # joiner's heartbeat seq out of the
+                                # skew table's laggard column
+                                "stats_seq": int(man.get(
+                                    "stats_seq", self._round_seq)),
+                                "barrier_gen": int(
+                                    man.get("barrier_gen", 0)),
+                                "vocab": man.get("vocab") or {},
+                                "watermark":
+                                    self._auditor.verified_seq,
+                                "why": self._round_dead.get(r, ""),
+                            }))
+                        if (not adopts and set(self._round_dead)
+                                <= set(self._round_adopted)):
+                            release = self._finalize_replace_locked(
+                                epoch, live)
+            elif kind == "shrink":
+                release = self._finalize_shrink_locked(epoch)
+        if fatal is not None:
+            self._fatal_abort(fatal)
             return
-        mixed = self._mixed_progress(progress)
-        if mixed is not None:
-            self._fatal_abort(mixed)
+        for r, rec, info in adopts:
+            self._log("M", "WARN",
+                      f"adopting spare #{rec.idx} into rank {r} "
+                      f"(epoch {epoch}, resume seq {info['seq']})")
+            self._send_spare(rec, ("adopt", info))
+        if release is None:
             return
+        kind, epoch, info, targets, extra_lines, release_gens = release
+        for line in extra_lines:
+            self._log("M", "ERROR", line)
+        if kind == "abort":
+            self._log("M", "WARN",
+                      f"abort round complete: releasing epoch {epoch} "
+                      f"to all ranks")
+            for r in targets:
+                self._send_to(r, ("abort_go", epoch))
+        elif kind == "replace":
+            self._log("M", "WARN",
+                      f"membership round complete: rank(s) "
+                      f"{sorted(info['replaced'])} replaced from warm "
+                      f"spares; releasing epoch {epoch}")
+            for r in targets:
+                self._send_to(r, ("abort_go", epoch, info))
+        elif kind == "shrink":
+            self._log("M", "WARN",
+                      f"membership round complete: shrunk to "
+                      f"{self.slave_num} rank(s) "
+                      f"(dropped {info['shrink']['departed']}); "
+                      f"releasing epoch {epoch}")
+            for r in targets:
+                self._send_to(r, ("abort_go", epoch, info))
+            for gen in release_gens:
+                for r in range(self.slave_num):
+                    self._send_to(r, ("barrier_release", gen))
+
+    def _finalize_replace_locked(self, epoch: int, live: set[int]):
+        """All survivors acked, every casualty's spare acked its
+        adoption: swap the roster, resurrect the replaced ranks and
+        compose the go message (caller holds the lock and fans out)."""
+        repl = {r: rec.entry for r, rec in self._round_adopted.items()}
+        self._roster = membership_mod.swap_roster(self._roster, repl)
+        joiners = sorted(self._round_adopted)
+        extra_lines: list[str] = []
+        for r in joiners:
+            rec = self._round_adopted[r]
+            self._departed.pop(r, None)
+            self._exit_codes.pop(r, None)
+            self._membership.note_replace(
+                r, epoch, rec.idx, self._round_dead.get(r, ""))
+            extra_lines.extend(
+                self._auditor.note_replacement(
+                    r, self._round_seq or 0))
+        info = {"replaced": joiners, "roster": self._roster,
+                "epoch": epoch}
+        targets = sorted(live)
+        self._abort_since = None
+        self._round_kind = None
+        self._round_dead = {}
+        self._round_adoptions = {}
+        self._round_adopted = {}
+        self._round_manifest = None
+        self._round_manifest_from = None
+        self._round_seq = None
+        return ("replace", epoch, info, targets, extra_lines, ())
+
+    def _finalize_shrink_locked(self, epoch: int):
+        """All survivors acked a shrink round: renumber them
+        contiguously, rebuild every rank-keyed table under the new
+        numbering, and compose the go message (caller holds the lock
+        and fans out)."""
+        dead = set(self._departed)
+        mapping = membership_mod.shrink_mapping(self.slave_num, dead)
+        new_roster = membership_mod.shrink_roster(self._roster, mapping)
+        dead_list = sorted(dead)
+        new_slots: list = [None] * len(mapping)
+        for old, new in mapping.items():
+            slot = self._slots[old]
+            slot.rank = new
+            new_slots[new] = slot
+        self._slots = new_slots
+        self._roster = new_roster
+        self.slave_num = len(mapping)
+        self._rank_width = max(1, len(str(max(self.slave_num - 1, 0))))
+        self._exit_codes = {mapping[r]: c for r, c
+                            in self._exit_codes.items() if r in mapping}
+        self._telemetry = {mapping[r]: t for r, t
+                           in self._telemetry.items() if r in mapping}
+        self._rank_windows = {mapping[r]: w for r, w
+                              in self._rank_windows.items()
+                              if r in mapping}
+        self._rank_totals = {mapping[r]: t for r, t
+                             in self._rank_totals.items() if r in mapping}
+        self._departed = {}
+        self._abort_progress = {}
+        self._auditor.note_shrink(self.slave_num, mapping)
+        self._membership.note_shrink(dead_list, mapping, epoch,
+                                     self._round_why)
+        # pending barriers renumber too; one now-complete generation
+        # (every survivor already arrived, only the dead were missing)
+        # releases on the way out
+        release_gens = []
+        for gen, ranks in list(self._barrier_waiting.items()):
+            self._barrier_waiting[gen] = [
+                mapping[r] for r in ranks if r in mapping]
+            if len(self._barrier_waiting[gen]) == self.slave_num:
+                release_gens.append(gen)
+                self._barrier_max_released = max(
+                    self._barrier_max_released, gen)
+                del self._barrier_waiting[gen]
+                self._barrier_since.pop(gen, None)
+        info = {"shrink": {"roster": new_roster, "ranks": mapping,
+                           "departed": dead_list, "epoch": epoch}}
+        targets = sorted(mapping.values())
+        self._abort_since = None
+        self._round_kind = None
+        self._round_dead = {}
+        self._round_manifest = None
+        self._round_manifest_from = None
+        self._round_seq = None
+        return ("shrink", epoch, info, targets, [], release_gens)
+
+    # -- warm spares (ISSUE 10) -----------------------------------------
+    def _register_spare(self, ch: Channel, entry: tuple) -> None:
+        """Park a warm-spare registration: ack it, pool it, and start
+        its serve thread (pings until adopted)."""
+        with self._lock:
+            idx = self._spare_seq
+            self._spare_seq += 1
+            rec = membership_mod.SpareRecord(idx, ch, entry)
+            self._spare_pool.append(rec)
+        try:
+            ch.send_obj({"spare": idx, "job": self.job_id})
+        except (Mp4jError, OSError):
+            self._spare_gone(rec, "died during registration")
+            return
+        t = threading.Thread(target=self._serve_spare, args=(rec,),
+                             daemon=True, name=f"master-spare{idx}")
+        with self._lock:
+            self._spare_threads.append(t)
+        t.start()
+        self._log("M", "INFO",
+                  f"warm spare #{idx} registered "
+                  f"({entry[0]}:{entry[1]})")
+
+    def _spare_accept_loop(self) -> None:
+        """Post-rendezvous listener: only spare registrations are
+        accepted mid-job (a late non-spare dial-in has no rank to
+        claim)."""
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return          # listener closed with serve()
+            ch = TcpChannel(sock)
+            ch.set_timeout(self.handshake_timeout)
+            try:
+                kind, payload = ch.recv()
+                ok = (kind == REGISTER and isinstance(payload, dict)
+                      and bool(payload.get("spare")))
+                entry = ((str(payload.get("host") or addr[0]),
+                          int(payload["listen_port"]),
+                          str(payload.get("fp") or ""))
+                         if ok else None)
+            except Exception:
+                ok = False
+            if not ok:
+                ch.close()
+                continue
+            ch.set_timeout(None)
+            self._register_spare(ch, entry)
+
+    def _serve_spare(self, rec) -> None:
+        """Read one spare's control channel: liveness pings until an
+        adoption completes — then this THREAD becomes the adopted
+        rank's serve thread (the channel is the same object; only its
+        role changes)."""
+        slot = None
+        try:
+            while True:
+                kind, payload = rec.ch.recv()
+                if kind == SPARE_PING:
+                    rec.last_ping = time.monotonic()
+                elif kind == ADOPT_ACK:
+                    slot = self._finish_adoption(rec)
+                    if slot is not None:
+                        break
+                elif kind == LOG:
+                    self._log(f"s{rec.idx}", payload["level"],
+                              payload["msg"])
+                elif kind == CLOSE:
+                    # a spare shutting down cleanly before adoption
+                    try:
+                        rec.ch.send_obj("closed")
+                    except (Mp4jError, OSError):
+                        pass
+                    rec.ch.close()
+                    self._spare_gone(rec, "closed")
+                    return
+                # anything else from an unadopted spare is noise
+        except Exception as e:
+            self._spare_gone(rec, f"connection lost ({e!r})")
+            return
+        self._serve_slave(slot)
+
+    def _finish_adoption(self, rec):
+        """An adopted spare acked: install its channel as the rank's
+        slot and hand the round machinery the news. Returns the slot
+        (the caller's thread continues as the rank's serve thread), or
+        None when the ack is stale."""
+        with self._lock:
+            r = rec.adopting_rank
+            if r is None or self._fatal_msg is not None:
+                return None
+            rec.adopt_since = None
+            slot = _Slot(r, rec.ch)
+            self._slots[r] = slot
+            self._round_adopted[r] = rec
+            if rec in self._spare_pool:
+                self._spare_pool.remove(rec)
+            # the dead occupant's telemetry must not pollute the
+            # joiner's: fresh windows, fresh deltas (cluster TOTALS
+            # keep the dead rank's history — it really happened)
+            self._telemetry.pop(r, None)
+            self._rank_windows.pop(r, None)
+            self._rank_totals.pop(r, None)
+            self._serve_threads.append(threading.current_thread())
         self._log("M", "WARN",
-                  f"abort round complete: releasing epoch {epoch} "
-                  f"to all ranks")
-        for r in sorted(self._live_ranks()):
-            self._send_to(r, ("abort_go", epoch))
+                  f"spare #{rec.idx} adopted as rank {r}")
+        self._try_advance_round()
+        return slot
+
+    def _send_spare(self, rec, obj) -> None:
+        try:
+            rec.ch.send_obj(obj)
+        except (Mp4jError, OSError):
+            self._spare_gone(rec, "unreachable on adopt push")
+
+    def _spare_gone(self, rec, why: str) -> None:
+        """A spare died (pre- or mid-adoption): drop it from the pool,
+        un-assign any in-flight adoption and re-drive the round — the
+        next spare is tried, or the round goes terminal through the
+        no-spare path."""
+        retry = False
+        with self._lock:
+            rec.alive = False
+            if rec in self._spare_pool:
+                self._spare_pool.remove(rec)
+            r = rec.adopting_rank
+            rec.adopting_rank = None
+            rec.adopt_since = None
+            if r is not None and self._round_adoptions.get(r) is rec:
+                del self._round_adoptions[r]
+                retry = True
+        self._log("M", "WARN", f"warm spare #{rec.idx} lost: {why}")
+        try:
+            rec.ch.close()
+        except OSError:
+            pass
+        if retry:
+            # re-enter through _begin_membership so the no-spare path
+            # produces the same clean fatal as never having had one
+            self._begin_membership({}, self._round_why or
+                                   f"spare #{rec.idx} died mid-adoption")
+            self._try_advance_round()
+
+    def _release_spares(self, reason: str) -> None:
+        with self._lock:
+            pool = list(self._spare_pool)
+            self._spare_pool = []
+            threads = list(self._spare_threads)
+        for rec in pool:
+            try:
+                rec.ch.send_obj(("release", reason))
+            except (Mp4jError, OSError):
+                pass
+            try:
+                rec.ch.close()
+            except OSError:
+                pass
+        me = threading.current_thread()
+        for t in threads:
+            # the fatal path can be DRIVEN from a spare's own serve
+            # thread (last spare dies mid-adoption -> no-spare fatal);
+            # joining it would raise "cannot join current thread"
+            if t is not me:
+                t.join(2.0)
 
     @staticmethod
     def _mixed_progress(progress: dict) -> str | None:
@@ -508,6 +1129,9 @@ class Master:
         self._write_postmortem_manifest()
         for r in sorted(self._live_ranks()):
             self._send_to(r, ("abort_fatal", msg))
+        # idle spares raise Mp4jSpareReleased instead of outliving
+        # the job they were provisioned for (ISSUE 10)
+        self._release_spares(msg)
 
     def _log(self, rank, level: str, msg: str):
         """Centralized log sink: ISO-8601 timestamps and a fixed-width
@@ -566,6 +1190,9 @@ class Master:
                 "last": progress.get("last"),
                 "phase": progress.get("phase"),
                 "current_secs": float(progress.get("current_secs", 0.0)),
+                # per-rank recovery epoch (ISSUE 10): `mp4j-scope
+                # live` renders it next to the roster badges
+                "epoch": int(progress.get("epoch", 0)),
                 "stats": stats,
                 "metrics": metrics,
                 "mono": now,
@@ -619,9 +1246,9 @@ class Master:
         and the postmortem manifest. Caller must NOT hold the lock."""
         now = time.monotonic()
         with self._lock:
-            return {r: {**{k: t[k] for k in
+            return {r: {**{k: t.get(k) for k in
                            ("seq", "current", "last", "phase",
-                            "current_secs")},
+                            "current_secs", "epoch")},
                         "age": now - t["mono"]}
                     for r, t in self._telemetry.items()}
 
@@ -716,9 +1343,9 @@ class Master:
                 # see a consistent frozen view — no per-scrape deep
                 # copy of the whole fleet's stats under the lock
                 ranks[str(r)] = {
-                    "progress": {k: t[k] for k in
+                    "progress": {k: t.get(k) for k in
                                  ("seq", "current", "last", "phase",
-                                  "current_secs")},
+                                  "current_secs", "epoch")},
                     "age": now - t["mono"],
                     "stats": t["stats"],
                     "rates": win.rates() if win is not None else {},
@@ -736,6 +1363,7 @@ class Master:
             cluster_rates = self._cluster_window.rates()
             cluster_metrics = self._cluster_metrics
             audit_status = self._auditor.status()
+            membership_status = self._membership_status_locked()
         cluster_stats = stats_mod.merge_snapshots(
             *(info["stats"] for info in ranks.values()))
         for r, info in ranks.items():
@@ -750,8 +1378,27 @@ class Master:
                 "rates": cluster_rates,
                 "histograms": cluster_metrics["histograms"],
                 "audit": audit_status,
+                "membership": membership_status,
             },
         }
+
+    def _membership_status_locked(self) -> dict:
+        """ONE definition of the membership snapshot (availability
+        predicate included) for every surface that renders it — the
+        metrics doc, :meth:`membership_status` and the postmortem
+        manifest must never disagree. Caller holds the lock."""
+        return self._membership.status(
+            spares_available=sum(
+                1 for s in self._spare_pool
+                if s.alive and s.adopting_rank is None),
+            spares_total=self._spare_seq)
+
+    def membership_status(self) -> dict:
+        """The elastic-membership document (ISSUE 10): mode, counters,
+        spare availability, per-rank badges and the bounded event
+        history (schema: resilience.membership.MembershipLog.status)."""
+        with self._lock:
+            return self._membership_status_locked()
 
     def audit_status(self) -> dict:
         """The cluster audit document (ISSUE 8): last cross-rank-
@@ -769,6 +1416,7 @@ class Master:
             reason = self._fatal_msg
             departed = dict(self._departed)
             audit_status = self._auditor.status()
+            membership_status = self._membership_status_locked()
         if not self._postmortem_dir or reason is None:
             return
         # ONE table snapshot feeds both fields, so the manifest's
@@ -781,7 +1429,8 @@ class Master:
                 diagnosis=telemetry_mod.render_diagnosis(
                     table, self.slave_num),
                 audit=audit_status,
-                sink_dir=self._sink_dir or None)
+                sink_dir=self._sink_dir or None,
+                membership=membership_status)
         except OSError:
             pass  # best-effort: the job is already terminal
 
@@ -803,33 +1452,68 @@ class Master:
         while not self._stop.wait(tick):
             now = time.monotonic()
             stalled, fatal = [], None
+            escalate: dict[int, str] = {}   # rank -> why (elastic)
+            lost_spares = []
             with self._lock:
+                round_open = self._abort_since is not None
                 for gen, since in self._barrier_since.items():
                     if gen not in self._barrier_waiting:
                         continue
                     age = now - since
                     if (age > self.dead_rank_secs
-                            and self._fatal_msg is None):
+                            and self._fatal_msg is None
+                            # a barrier waiting out a membership round
+                            # (the joiner has not re-arrived yet) is
+                            # the round's business, not a new death
+                            and not (self.elastic != "off"
+                                     and round_open)):
                         missing = sorted(
                             set(range(self.slave_num))
                             - set(self._barrier_waiting[gen]))
                         fatal = (f"barrier gen {gen} stalled for "
                                  f"{age:.1f}s waiting on ranks "
                                  f"{missing}; aborting the job")
+                        if self.elastic != "off":
+                            for r in missing:
+                                escalate.setdefault(
+                                    r, f"barrier gen {gen} stalled "
+                                    f"{age:.1f}s without it")
                     elif (self.stall_timeout is not None
                             and age > self.stall_timeout
                             and gen not in self._diagnosed_gens):
                         self._diagnosed_gens.add(gen)
                         stalled.append(
                             (gen, list(self._barrier_waiting[gen]), age))
-                if (fatal is None and self._abort_since is not None
+                if (fatal is None and round_open
                         and now - self._abort_since > self.dead_rank_secs):
                     missing = sorted(set(range(self.slave_num))
                                      - set(self._departed)
                                      - self._abort_acks)
-                    fatal = (f"abort round -> epoch {self._abort_epoch} "
-                             f"stalled: no teardown ack from ranks "
-                             f"{missing}; aborting the job")
+                    if missing:
+                        fatal = (f"abort round -> epoch "
+                                 f"{self._abort_epoch} stalled: no "
+                                 f"teardown ack from ranks "
+                                 f"{missing}; aborting the job")
+                        if self.elastic != "off":
+                            for r in missing:
+                                escalate.setdefault(
+                                    r, "no teardown ack within "
+                                    f"{self.dead_rank_secs:.1f}s")
+                    elif self._round_kind in ("replace", "shrink"):
+                        # acks complete but the membership half never
+                        # finished (manifest or adoption wedged past
+                        # every narrower deadline): terminal
+                        fatal = (f"membership round -> epoch "
+                                 f"{self._abort_epoch} stalled for "
+                                 f"{now - self._abort_since:.1f}s; "
+                                 "aborting the job")
+                # spare-adoption deadline (ISSUE 10): a spare that
+                # never acks its adoption burns one deadline, not the
+                # whole recovery budget — the next spare is tried
+                for r, rec in list(self._round_adoptions.items()):
+                    if (rec.adopt_since is not None
+                            and now - rec.adopt_since > self._adopt_secs):
+                        lost_spares.append(rec)
             for gen, ranks, age in stalled:
                 missing = sorted(set(range(self.slave_num)) - set(ranks))
                 self._log("M", "WARN",
@@ -838,19 +1522,38 @@ class Master:
                           f"{missing}")
                 for line in self.diagnose():
                     self._log("M", "WARN", line)
+            for rec in lost_spares:
+                self._spare_gone(
+                    rec, f"adoption not acked within "
+                    f"{self._adopt_secs:.1f}s")
             if fatal is not None:
-                self._fatal_abort(fatal)
+                if self.elastic != "off" and escalate:
+                    for r, why in escalate.items():
+                        self._on_rank_dead(r, why, fatal)
+                else:
+                    self._fatal_abort(fatal)
 
-    def _barrier(self, rank: int, gen: int, ch: Channel):
+    def _barrier(self, slot: _Slot, gen: int):
         release = False
+        stale = False
         with self._lock:
+            rank = slot.rank
             fatal = self._fatal_msg
             if fatal is None:
-                waiting = self._barrier_waiting.setdefault(gen, [])
-                self._barrier_since.setdefault(gen, time.monotonic())
-                waiting.append(rank)
-                if len(waiting) == self.slave_num:
-                    release = True
+                if gen <= self._barrier_max_released:
+                    stale = True    # see _barrier_max_released
+                else:
+                    waiting = self._barrier_waiting.setdefault(gen, [])
+                    self._barrier_since.setdefault(gen,
+                                                   time.monotonic())
+                    waiting.append(rank)
+                    if len(waiting) == self.slave_num:
+                        release = True
+                        self._barrier_max_released = max(
+                            self._barrier_max_released, gen)
+        if stale:
+            self._send_to(rank, ("barrier_release", gen))
+            return
         if fatal is not None:
             # the job is terminally aborted: never release a barrier
             # into it — a straggler arriving after the fan-out must
@@ -860,7 +1563,7 @@ class Master:
             return
         if release:
             # release everyone waiting on this generation
-            for r in range(len(self._channels)):
+            for r in range(self.slave_num):
                 self._send_to(r, ("barrier_release", gen))
             with self._lock:
                 del self._barrier_waiting[gen]

@@ -51,10 +51,11 @@ from ytk_mp4j_tpu.obs import postmortem
 from ytk_mp4j_tpu.obs import sink as sink_mod
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.exceptions import (
-    Mp4jError, Mp4jFatalError, Mp4jTransportError)
+    Mp4jError, Mp4jFatalError, Mp4jSpareReleased, Mp4jTransportError)
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
 from ytk_mp4j_tpu.resilience import faults as faults_mod
+from ytk_mp4j_tpu.resilience import membership as membership_mod
 from ytk_mp4j_tpu.resilience.recovery import RecoveryManager
 from ytk_mp4j_tpu.transport import shm as shm_mod
 from ytk_mp4j_tpu.transport import tcp as tcp_mod
@@ -138,7 +139,9 @@ class ProcessCommSlave(CommSlave):
                  fault_plan=None,
                  postmortem_dir: str | None = None,
                  audit: str | None = None,
-                 sink_dir: str | None = None):
+                 sink_dir: str | None = None,
+                 elastic: str | None = None,
+                 spare: bool = False):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -209,7 +212,25 @@ class ProcessCommSlave(CommSlave):
         budget ``MP4J_SINK_BYTES``, oldest-segment eviction), so
         ``mp4j-scope analyze``/``tail`` can reconstruct full-job
         cross-rank timelines and critical-path attribution — ring
-        tails no longer bound history."""
+        tails no longer bound history.
+
+        ``elastic`` (ISSUE 10; None reads ``MP4J_ELASTIC``) is the
+        job's elastic-membership mode, validated here like every other
+        job-wide knob — including the fail-stop conflict rule: an
+        elastic mode next to ``max_retries=0`` raises at construction
+        (the fenced retry is the mechanism that re-runs the
+        interrupted collective after a membership change).
+
+        ``spare=True`` registers this slave as a WARM SPARE (ISSUE 10)
+        instead of claiming a rank: construction blocks — pinging the
+        master from a background thread — until the master adopts it
+        into a dead rank's id (the constructor then returns a fully
+        seeded member of the running job: the dead rank's id at the
+        current epoch, the columnar keycodec vocabularies, the resume
+        ordinal in :attr:`resume_seq` and barrier position in
+        :attr:`resume_barrier_gen`, and the cross-rank-verified audit
+        watermark) or releases it (``Mp4jSpareReleased`` — the job
+        ended without needing this spare)."""
         self._timeout = timeout
         self._peer_timeout = peer_timeout
         self._handshake_timeout = handshake_timeout
@@ -224,6 +245,13 @@ class ProcessCommSlave(CommSlave):
                                    if reconnect_backoff is None
                                    else float(reconnect_backoff))
         self._dead_rank_secs = tuning.dead_rank_secs(dead_rank_secs)
+        # elastic membership (ISSUE 10): the master drives the
+        # protocol, but the mode is validated on EVERY rank — the
+        # fail-stop conflict (elastic + max_retries=0) must fail the
+        # job at setup, never silently pick a winner
+        self._elastic = tuning.elastic_mode(elastic,
+                                            max_retries=self._max_retries)
+        self._spare = bool(spare)
         if fault_plan is None:
             spec = tuning.fault_plan_spec()
             fault_plan = faults_mod.FaultPlan.parse(spec) if spec else None
@@ -267,6 +295,12 @@ class ProcessCommSlave(CommSlave):
         # plane, kept IDENTICAL across ranks (grown only inside the
         # synchronized novelty exchange — see _map_sync)
         self._map_codecs: dict[str, object] = {}
+        # pre-attempt codec sizes of the collective in flight (set by
+        # the recovery wrapper's preserve): the adoption manifest's
+        # vocabulary export pins to these — a failed map attempt's
+        # tentative growth must not reach a joining spare when every
+        # survivor's retry is about to truncate it away (ISSUE 10)
+        self._codec_pin: dict | None = None
         self._scratch = _ScratchPool()
         self._comm_stats = CommStats()
         # audit plane (ISSUE 8): mode validated up front like every
@@ -297,22 +331,18 @@ class ProcessCommSlave(CommSlave):
         self._master.set_timeout(timeout)
         self._master.send_obj((master_mod.REGISTER, {
             "listen_port": self._listen_port, "host": listen_host,
-            "fp": self._fp}))
+            "fp": self._fp, "spare": self._spare}))
         reply = self._master.recv()
+        adopt_info = None
+        if self._spare:
+            # blocks (pinging) until the master adopts this spare into
+            # a dead rank's id — or releases it (ISSUE 10)
+            reply, adopt_info = self._spare_wait(reply)
         self._rank = reply["rank"]
-        self._roster = reply["roster"]
-        self._n = len(self._roster)
+        self._roster_version = 0
+        self._set_roster(reply["roster"])
         # job id namespaces this job's shm segment names
         self._job_id = str(reply.get("job") or "0")
-        # topology (ISSUE 7): group ranks by roster host fingerprint —
-        # a pure function of the shared roster, so every rank derives
-        # the identical grouping (R1/R8 discipline). Fingerprint-less
-        # ranks are singleton hosts (they can never ride shm).
-        self._host_groups = self._derive_host_groups(self._roster)
-        self._members = next(g for g in self._host_groups
-                             if self._rank in g)
-        self._leader = self._members[0]
-        self._leaders = [g[0] for g in self._host_groups]
         # after rendezvous the master channel is fail-stop (barrier
         # waits are unbounded by design, see barrier())
         self._master.set_timeout(None)
@@ -320,10 +350,7 @@ class ProcessCommSlave(CommSlave):
         # heartbeat thread interleaving frame bytes with a barrier or
         # log send would corrupt the control plane
         self._master_lock = threading.Lock()
-        self._comm_stats.rank = self._rank  # tags spans + heartbeats
-        if self._audit is not None:
-            self._audit.rank = self._rank   # tags the audit bundle
-            self._audit.slave_num = self._n  # replay's dead-rank guard
+        self._sync_identity()
 
         # peer channels: canonical rule — the HIGHER rank connects to the
         # lower rank's listen socket; one duplex channel per pair.
@@ -387,6 +414,22 @@ class ProcessCommSlave(CommSlave):
         # touches this (submit + the drain barrier), no lock needed
         self._send_futs: list = []
         self._barrier_gen = 0
+        # barrier generations COMPLETED (vs. _barrier_gen = entered):
+        # the adoption manifest ships this count so a joiner's next
+        # barrier call pairs with the survivors' (ISSUE 10)
+        self._barrier_done = 0
+        # adoption resume position (0 on ordinary members): the
+        # application reads these to know where the job already is
+        self.resume_seq = 0
+        self.resume_barrier_gen = 0
+        if adopt_info is not None:
+            self._adopt_seed(adopt_info)
+            # ack BEFORE the heartbeat thread exists: the master's
+            # spare serve thread switches into the rank's serve loop
+            # on this message, and a TELEMETRY frame arriving first
+            # would hit the spare-side dispatch
+            self._master_send((master_mod.ADOPT_ACK,
+                               {"rank": self._rank}))
         # telemetry heartbeat (control plane only — never touches the
         # peer data channels, so it cannot block a collective): ships
         # {progress, stats} to the master every MP4J_HEARTBEAT_SECS
@@ -449,6 +492,11 @@ class ProcessCommSlave(CommSlave):
                 or self._recovery.fatal is not None)
             if gen in self._barrier_released:
                 self._barrier_released.discard(gen)
+                # completed-generation count: the adoption manifest's
+                # barrier seed (ISSUE 10) — every rank that PASSED
+                # this barrier agrees on it, waiting ranks still show
+                # the previous value
+                self._barrier_done = gen + 1
                 return
         raise Mp4jFatalError(self._recovery.fatal)
 
@@ -542,7 +590,29 @@ class ProcessCommSlave(CommSlave):
                 elif kind == "abort":
                     self._recovery.on_abort(int(msg[1]))
                 elif kind == "abort_go":
+                    # a membership go (ISSUE 10) carries the roster
+                    # change; it must land BEFORE the epoch release
+                    # wakes any retry — the re-dials read the roster
+                    if len(msg) > 2 and msg[2]:
+                        self._apply_membership(msg[2])
                     self._recovery.on_go(int(msg[1]))
+                elif kind == "manifest_req":
+                    # the master needs this survivor's adoption
+                    # manifest (ISSUE 10): vocabulary export + progress
+                    # + barrier position, all quiescent while the
+                    # collective thread waits out the round
+                    try:
+                        self._master_send((master_mod.MANIFEST, {
+                            "epoch": int(msg[1]),
+                            "vocab": self._vocab_export(),
+                            "seq": self._progress_state[0],
+                            "inflight": self._progress_state[1],
+                            "stats_seq": self._comm_stats.progress()[
+                                "seq"],
+                            "barrier_gen": self._barrier_done,
+                        }))
+                    except (Mp4jError, OSError):
+                        pass  # master gone; its watchdog owns this
                 elif kind == "abort_fatal":
                     self._recovery.on_fatal(str(msg[1]))
                 else:
@@ -582,6 +652,131 @@ class ProcessCommSlave(CommSlave):
             pass
         self._server.close()
 
+    # -- elastic membership: spare mode + roster updates (ISSUE 10) ----
+    def _spare_wait(self, reg_reply):
+        """Block as a registered warm spare until the master adopts or
+        releases this process. A ping thread keeps the spare's
+        liveness visible (a silently dead spare must not be the thing
+        a replacement round discovers mid-adoption). Returns
+        ``(reply, adopt_info)`` where ``reply`` has the shape of a
+        normal rendezvous reply."""
+        if not (isinstance(reg_reply, dict) and "spare" in reg_reply):
+            raise Mp4jError(
+                f"master did not accept the spare registration "
+                f"(got {reg_reply!r}); is this master elastic-aware?")
+        # spares idle indefinitely by design: the rendezvous timeout
+        # bounds registration, not the wait for a fault that may
+        # never come
+        self._master.set_timeout(None)
+        lock = threading.Lock()   # ping thread vs. nobody else yet
+        stop = threading.Event()
+
+        def ping():
+            while not stop.wait(1.0):
+                try:
+                    with lock:
+                        self._master.send_obj(
+                            (master_mod.SPARE_PING, {}))
+                except (Mp4jError, OSError):
+                    return
+
+        t = threading.Thread(target=ping, daemon=True,
+                             name="mp4j-spare-ping")
+        t.start()
+        try:
+            while True:
+                try:
+                    msg = self._master.recv()
+                except (Mp4jError, OSError, EOFError) as e:
+                    raise Mp4jSpareReleased(
+                        f"master connection lost while idling as a "
+                        f"spare: {e!r}") from e
+                kind = (msg[0] if isinstance(msg, tuple) and msg
+                        else None)
+                if kind == "adopt":
+                    info = msg[1]
+                    break
+                if kind in ("release", "abort_fatal"):
+                    raise Mp4jSpareReleased(str(msg[1]))
+                # anything else is master-side noise; keep waiting
+        except BaseException:
+            stop.set()
+            try:
+                self._master.close()
+            except OSError:
+                pass
+            self._server.close()
+            raise
+        stop.set()
+        t.join(2.0)
+        reply = {"rank": int(info["rank"]), "roster": info["roster"],
+                 "job": info.get("job")}
+        return reply, info
+
+    def _adopt_seed(self, info: dict) -> None:
+        """Seed a just-adopted joiner from the master-held manifest
+        (ISSUE 10): the released epoch, the resume ordinal (the
+        joiner's next collective pairs with the survivors' retry), the
+        barrier generation, the columnar keycodec vocabularies (code
+        tables identical to every survivor's post-restore state), and
+        the cross-rank-verified audit watermark."""
+        epoch = int(info.get("epoch", 0))
+        self._recovery.seed(epoch)
+        seq = int(info.get("seq", 0))
+        self._progress_state = (seq, False)
+        self._comm_stats.seed_seq(int(info.get("stats_seq", seq)))
+        gen = int(info.get("barrier_gen", 0))
+        self._barrier_gen = gen
+        self._barrier_done = gen
+        self.resume_seq = seq
+        self.resume_barrier_gen = gen
+        membership_mod.import_vocab(self._map_codecs,
+                                    info.get("vocab") or {})
+        if self._audit is not None:
+            self._audit.watermark = int(info.get("watermark", 0))
+        self._comm_stats.add("replacements_seen", 1)
+        self._recovery.note(
+            "adopted",
+            f"rank {self._rank} @ epoch {epoch} seq {seq} "
+            f"({info.get('why', '')})"[:160])
+
+    def _vocab_export(self) -> dict[str, list]:
+        """This rank's keycodec vocabularies for the adoption manifest,
+        pinned at the in-flight collective's pre-attempt sizes (see
+        ``_codec_pin``). Runs on the CONTROL thread while the
+        collective thread is parked in the abort round — the codecs
+        are quiescent."""
+        return membership_mod.export_vocab(self._map_codecs,
+                                           self._codec_pin)
+
+    def _apply_membership(self, info: dict) -> None:
+        """Apply a membership go's roster change (control thread, runs
+        BEFORE the epoch release wakes any retry — the re-dials must
+        see the new roster). Replacement swaps entries under the same
+        ids; shrink renumbers this rank and every roster-derived
+        quantity through the one sanctioned accessor."""
+        shrink = info.get("shrink")
+        if shrink is not None:
+            mapping = {int(k): int(v)
+                       for k, v in shrink["ranks"].items()}
+            old_rank = self._rank
+            # mp4j-lint: disable=R15 (the renumbering site itself)
+            self._rank = mapping[self._rank]
+            self._set_roster(shrink["roster"])
+            self._sync_identity()
+            self._comm_stats.add("shrinks_seen", 1)
+            self._recovery.note(
+                "shrink",
+                f"rank {old_rank}->{self._rank} of {self._n} "
+                f"(dropped {shrink.get('departed')}) @ epoch "
+                f"{shrink.get('epoch')}")
+        elif "roster" in info:
+            self._set_roster(info["roster"])
+            self._recovery.note(
+                "replace",
+                f"rank(s) {info.get('replaced')} replaced @ epoch "
+                f"{info.get('epoch')}")
+
     # -- telemetry (control plane only) --------------------------------
     def _telemetry_payload(self) -> dict:
         """The heartbeat message: progress plus stats/metric DELTAS
@@ -598,7 +793,11 @@ class ProcessCommSlave(CommSlave):
             md = metrics_mod.diff_snapshot(mets, self._tel_last_metrics)
             self._tel_last_stats = stats
             self._tel_last_metrics = mets
-        payload = {"progress": self._comm_stats.progress(),
+        prog = self._comm_stats.progress()
+        # the recovery epoch rides every beat (ISSUE 10): `mp4j-scope
+        # live` renders it next to the membership badges
+        prog["epoch"] = self._recovery.epoch
+        payload = {"progress": prog,
                    "stats_delta": sd, "metrics_delta": md}
         if self._audit is not None:
             # verify/capture ship digest records as deltas (the audit
@@ -770,6 +969,39 @@ class ProcessCommSlave(CommSlave):
         out = list(groups.values()) + singles
         out.sort(key=lambda g: g[0])
         return out
+
+    def _set_roster(self, roster) -> None:
+        """THE roster-versioned topology update (mp4j-lint R15's
+        sanctioned site): every roster-derived quantity — rank count,
+        host groups, this rank's host members, the leader sets — is
+        (re)derived here and ONLY here, so a membership change
+        (ISSUE 10: replacement swaps a roster entry, shrink renumbers
+        the survivors) updates ALL of them atomically with one call.
+        Code elsewhere must read these attributes, never re-derive and
+        cache its own copy — a long-lived private cache survives the
+        renumbering silently wrong (that is rule R15)."""
+        # mp4j-lint: disable=R15 (the sanctioned derivation site itself)
+        self._roster = list(roster)
+        self._n = len(self._roster)
+        self._host_groups = self._derive_host_groups(self._roster)
+        self._members = next(g for g in self._host_groups
+                             if self._rank in g)
+        self._leader = self._members[0]
+        self._leaders = [g[0] for g in self._host_groups]
+        self._roster_version += 1
+
+    def _sync_identity(self) -> None:
+        """Mirror the current (rank, slave_num) into the attached
+        observability/recovery planes — the ONE place those mirrors
+        are written, so a shrink renumbering cannot strand one of
+        them on the old id (mp4j-lint R15 baseline)."""
+        self._comm_stats.rank = self._rank  # tags spans + heartbeats
+        if self._audit is not None:
+            self._audit.rank = self._rank   # tags the audit bundle
+            self._audit.slave_num = self._n  # replay's dead-rank guard
+        rec = getattr(self, "_recovery", None)
+        if rec is not None:
+            rec.rank = self._rank           # names this rank in aborts
 
     def _accept_loop(self):
         while True:
@@ -2836,6 +3068,11 @@ def _recovered(fn, snapshot: bool):
                 # pre-attempt sizes restores the invariant
                 sizes = ({k: c.size for k, c in self._map_codecs.items()}
                          if is_map else None)
+                # published for the adoption manifest (ISSUE 10): a
+                # replacement round's vocabulary export must ship the
+                # pre-attempt state every survivor rolls back to, not
+                # this attempt's tentative growth
+                self._codec_pin = sizes
                 saved_box.append(saved)
                 return (saved, sizes)
 
@@ -2884,6 +3121,7 @@ def _recovered(fn, snapshot: bool):
                 return out
             finally:
                 self._progress_state = (ordinal, False)
+                self._codec_pin = None
                 # pooled snapshot buffers go back for the next call
                 if saved_box and isinstance(saved_box[0], np.ndarray) \
                         and saved_box[0].base is not None:

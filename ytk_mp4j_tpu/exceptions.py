@@ -36,3 +36,12 @@ class Mp4jFatalError(Mp4jError):
     unrecoverable (dead rank, exhausted retry budget, stalled recovery
     round) and fanned the SAME message out to every surviving rank.
     Deliberately not a transport error — nothing retries it."""
+
+
+class Mp4jSpareReleased(Mp4jError):
+    """A warm spare (ISSUE 10, ``ProcessCommSlave(spare=True)``) was
+    released without ever being adopted: the job completed (or died)
+    while the spare idled. Not a defect — the spare existing unused is
+    the success case of elastic provisioning — but the blocked
+    constructor has nothing to return, so it raises this distinct type
+    for the hosting process to treat as a clean exit."""

@@ -276,6 +276,12 @@ class AuditRing:
         # so replay knows the TRUE job size even when the highest
         # rank(s) died without leaving a bundle
         self.slave_num: int | None = None
+        # rank replacement (ISSUE 10): a joining spare inherits the
+        # last cross-rank-verified ordinal from the adoption manifest,
+        # so its ring starts ALIGNED — every record it ever writes has
+        # seq > watermark, and postmortem/replay readers know ordinals
+        # at or below it were verified before this rank even existed
+        self.watermark = 0
         self.wire_on = self.mode in ("verify", "capture")
         self.ships = self.mode in ("verify", "capture")
         self.captures = self.mode == "capture"
@@ -444,9 +450,11 @@ class AuditRing:
 
     def dump(self) -> dict:
         """The postmortem-bundle / replay-bundle document
-        (``audit.json``)."""
+        (``audit.json``). ``watermark`` is nonzero only for an adopted
+        joiner (ISSUE 10): the verified ordinal it inherited."""
         return {"rank": self.rank, "mode": self.mode,
                 "slave_num": self.slave_num,
+                "watermark": self.watermark,
                 "records": self.records()}
 
 
@@ -592,6 +600,34 @@ class ClusterAuditor:
         self.divergence_total += 1
         self.divergences.append({"seq": seq, "kind": kind, "msg": msg})
         return f"audit: DIVERGENCE ({kind}) {msg}"
+
+    # -- elastic membership (ISSUE 10) ----------------------------------
+    def note_replacement(self, rank: int, resume_seq: int) -> list[str]:
+        """Rank ``rank`` was re-populated from a spare resuming at
+        ``resume_seq``: ordinals at or below it can never receive a
+        record from the NEW occupant, so settle every pending seq in
+        that range against whoever did report it (the dead occupant's
+        pre-death records included — they are honest and comparable)
+        instead of letting those seqs jam the pending table until the
+        cap prunes them as silently unverified."""
+        lines: list[str] = []
+        for seq in sorted(s for s in self._pending if s <= resume_seq):
+            # live=∅ forces completeness: verify among the reporters
+            lines.extend(self._maybe_verify(seq, set()))
+        return lines
+
+    def note_shrink(self, slave_num: int,
+                    mapping: dict[int, int]) -> None:
+        """The roster renumbered (shrink): remap the per-rank audit
+        positions and drop pending seqs — their records are keyed by
+        OLD ranks, and the retried ordinal's fresh records arrive
+        under the new numbering (comparing across the rename would
+        false-diverge every survivor against itself)."""
+        self.slave_num = slave_num
+        self.rank_seq = {mapping[r]: s for r, s in self.rank_seq.items()
+                        if r in mapping}
+        self.unverified_dropped += len(self._pending)
+        self._pending.clear()
 
     def status(self) -> dict:
         """The cluster audit document (metrics endpoint, live view,

@@ -58,7 +58,24 @@ THRESHOLDS: dict[str, float] = {
     "device_map_int_allreduce_keys_per_sec": 0.20,
     "device_map_chained_keys_per_sec": 0.20,
     "gbdt_hist_mxu_tflops_per_sec_per_chip": 0.10,
+    # ISSUE 10: recovery/membership latencies (LOWER is better — see
+    # LOWER_IS_BETTER below). Wide budgets: these are single-event
+    # wall-clock deltas on a shared 1-core host whose scheduler tails
+    # swing them run to run; the gate exists to catch a protocol
+    # regression (an extra round trip, a lost deadline), which shows
+    # as a multiple, not a percent
+    "socket_recovery_latency_ms": 1.0,
+    "socket_replacement_latency_ms": 1.0,
+    "socket_shrink_latency_ms": 1.0,
 }
+
+# metrics where SMALLER is the good direction (latencies): the budget
+# bounds GROWTH — new <= old * (1 + thr) — instead of shrinkage
+LOWER_IS_BETTER = frozenset({
+    "socket_recovery_latency_ms",
+    "socket_replacement_latency_ms",
+    "socket_shrink_latency_ms",
+})
 
 
 def load_bench(path: str) -> dict[str, float]:
@@ -94,7 +111,16 @@ def compare(old: dict[str, float], new: dict[str, float],
             thr = threshold
         a, b = old[metric], new[metric]
         ratio = b / a if a else float("inf")
-        if b < a * (1.0 - thr):
+        lower = metric in LOWER_IS_BETTER
+        if lower:
+            # latency: growth past budget regresses, shrinkage improves
+            if b > a * (1.0 + thr):
+                verdict = "REGRESSED"
+            elif b < a * (1.0 - thr):
+                verdict = "improved"
+            else:
+                verdict = "ok"
+        elif b < a * (1.0 - thr):
             verdict = "REGRESSED"
         elif b > a * (1.0 + thr):
             verdict = "improved"
@@ -102,6 +128,7 @@ def compare(old: dict[str, float], new: dict[str, float],
             verdict = "ok"
         rows.append({"metric": metric, "old": a, "new": b,
                      "ratio": ratio, "threshold": thr,
+                     "lower_is_better": lower,
                      "verdict": verdict})
     return rows
 
@@ -113,9 +140,10 @@ def format_table(rows: list[dict]) -> str:
     lines = [f"{'metric':<{w}}  {'old':>12}  {'new':>12}  "
              f"{'ratio':>6}  {'budget':>6}  verdict"]
     for r in rows:
+        sign = "+" if r.get("lower_is_better") else "-"
         lines.append(
             f"{r['metric']:<{w}}  {r['old']:>12.4f}  {r['new']:>12.4f}  "
-            f"{r['ratio']:>6.2f}  -{r['threshold'] * 100:>4.0f}%  "
+            f"{r['ratio']:>6.2f}  {sign}{r['threshold'] * 100:>4.0f}%  "
             f"{r['verdict']}")
     regressed = [r["metric"] for r in rows
                  if r["verdict"] == "REGRESSED"]

@@ -385,6 +385,21 @@ def to_prometheus(doc: dict) -> str:
         out.append("mp4j_audit_verified_seq_watermark "
                    f"{int(audit.get('verified_seq', 0))}")
 
+    # elastic membership (ISSUE 10): replacement/shrink counters and
+    # the warm-spare gauge — present whenever the master carries a
+    # membership log (they stay 0 for non-elastic jobs, so dashboards
+    # can alert on growth unconditionally)
+    ms = doc.get("cluster", {}).get("membership")
+    if ms is not None:
+        out.append("# TYPE mp4j_replacements_total counter")
+        out.append(
+            f"mp4j_replacements_total {int(ms.get('replacements', 0))}")
+        out.append("# TYPE mp4j_shrinks_total counter")
+        out.append(f"mp4j_shrinks_total {int(ms.get('shrinks', 0))}")
+        out.append("# TYPE mp4j_spares_available gauge")
+        out.append(
+            f"mp4j_spares_available {int(ms.get('spares_available', 0))}")
+
     # durable-sink series (ISSUE 9): per-rank registry counters named
     # sink/<what> plus the drain-lag gauge; a cluster total per
     # counter so dashboards can alert on drop growth fleet-wide. The

@@ -96,7 +96,8 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
                           table: dict, departed: dict,
                           diagnosis: list[str],
                           audit: dict | None = None,
-                          sink_dir: str | None = None) -> str:
+                          sink_dir: str | None = None,
+                          membership: dict | None = None) -> str:
     """The master's cluster-level half of the recorder: who the job
     thought was alive, why it died, and the final heartbeat table
     (fresh — the slaves' fatal-path telemetry flush lands before the
@@ -104,7 +105,10 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
     cluster audit status — the last cross-rank-verified collective
     ordinal is the report's known-good watermark; ``sink_dir``
     (ISSUE 9) names the job's durable-sink root so the merged report
-    can join full-job segment history."""
+    can join full-job segment history; ``membership`` (ISSUE 10)
+    records the elastic mode, spare availability and full
+    replacement/shrink history so the report covers every roster the
+    job ever ran under."""
     os.makedirs(root, exist_ok=True)
     path = os.path.join(root, "manifest.json")
     _dump(root, "manifest.json", {
@@ -114,6 +118,7 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
         "diagnosis": list(diagnosis),
         "audit": audit,
         "sink_dir": sink_dir or None,
+        "membership": membership,
         "table": {str(r): t for r, t in table.items()},
         # mp4j-lint: disable=R11 (artifact timestamp, not a duration)
         "wall_time": time.time(),
@@ -188,6 +193,29 @@ def merge_report(root: str) -> str:
     for r in dead:
         why = departed.get(r, "no postmortem bundle written")
         lines.append(f"DEAD rank {r}: {why}")
+
+    # membership history (ISSUE 10): every replacement/shrink the job
+    # survived before it finally died — a postmortem that omits them
+    # would blame rank ids that belonged to different processes over
+    # the job's lifetime
+    ms = (manifest or {}).get("membership") or {}
+    if ms.get("replacements") or ms.get("shrinks"):
+        lines.append(
+            f"membership: mode={ms.get('mode')}, "
+            f"{ms.get('replacements', 0)} replacement(s), "
+            f"{ms.get('shrinks', 0)} shrink(s), "
+            f"{ms.get('spares_available', 0)} spare(s) left")
+        for ev in ms.get("events") or []:
+            if ev.get("kind") == "replace":
+                lines.append(
+                    f"membership event: rank {ev.get('rank')} REPLACED "
+                    f"from spare #{ev.get('spare')} @ epoch "
+                    f"{ev.get('epoch')} ({ev.get('why')})")
+            else:
+                lines.append(
+                    f"membership event: SHRUNK, dropped "
+                    f"{ev.get('dead')} @ epoch {ev.get('epoch')} "
+                    f"({ev.get('why')})")
 
     # known-good watermark (ISSUE 8): the last collective ordinal the
     # master cross-rank-verified before the fatal — everything up to

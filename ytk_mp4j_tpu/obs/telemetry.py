@@ -123,6 +123,27 @@ def format_live(doc: dict) -> str:
         if audit.get("divergences"):
             last = (audit.get("last_divergences") or [{}])[-1]
             head += f"\n  last: {last.get('msg', '?')}"
+    # elastic membership (ISSUE 10): the spares line + event headline;
+    # absent entirely for non-elastic jobs with no spares registered
+    ms = cl.get("membership") or {}
+    badges = {str(r): b for r, b in (ms.get("badges") or {}).items()}
+    if (ms.get("mode", "off") != "off" or ms.get("spares_total")
+            or ms.get("replacements") or ms.get("shrinks")):
+        head += (f"\nmembership: mode={ms.get('mode', 'off')} | "
+                 f"spares {ms.get('spares_available', 0)}/"
+                 f"{ms.get('spares_total', 0)} available | "
+                 f"{ms.get('replacements', 0)} replacement(s), "
+                 f"{ms.get('shrinks', 0)} shrink(s)")
+        events = ms.get("events") or []
+        if events:
+            ev = events[-1]
+            if ev.get("kind") == "replace":
+                head += (f"\n  last: rank {ev.get('rank')} REPLACED "
+                         f"from spare #{ev.get('spare')} @ epoch "
+                         f"{ev.get('epoch')}")
+            else:
+                head += (f"\n  last: SHRUNK, dropped {ev.get('dead')} "
+                         f"@ epoch {ev.get('epoch')}")
     if not ranks:
         return head + "\n(no rank telemetry yet)"
     skew = cluster_skew({int(r): info.get("stats", {})
@@ -132,9 +153,10 @@ def format_live(doc: dict) -> str:
     max_seq = max(info.get("progress", {}).get("seq", 0)
                   for info in ranks.values())
     lines = [head,
-             f"{'rank':>4}  {'seq':>5}  {'lag':>4}  "
+             f"{'rank':>4}  {'seq':>5}  {'lag':>4}  {'ep':>3}  "
              f"{'state':<34}  {'MB/s':>8}  {'shm%':>5}  "
-             f"{'aud':>5}  {'sink':>7}  {'retries':>7}  hb age"]
+             f"{'aud':>5}  {'sink':>7}  {'retries':>7}  "
+             f"{'roster':<14}  hb age"]
     for r in sorted(ranks, key=int):
         info = ranks[r]
         prog = info.get("progress", {})
@@ -173,14 +195,21 @@ def format_live(doc: dict) -> str:
         sink_col = (f"{sink_b / 1e6:.1f}M" + ("!" if sink_drop else "")
                     if sink_b or sink_drop else "-")
         mark = "*" if int(r) in stragglers else " "
+        # epoch + roster badge (ISSUE 10): which recovery epoch the
+        # rank runs at, and whether its id was REPLACED from a spare
+        # or SHRUNK into a new number this job
+        epoch = prog.get("epoch") or 0
+        badge = badges.get(str(r), "-")
         lines.append(
             f"{mark}{r:>3}  {seq:>5}  {lag if lag else '-':>4}  "
+            f"{epoch if epoch else '-':>3}  "
             f"{state:<34.34}  "
             f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:>8.2f}  "
             f"{shm_pct:>5}  "
             f"{aud if aud else '-':>5}  "
             f"{sink_col:>7}  "
-            f"{retries:>7}  {info.get('age', 0.0):.1f}s")
+            f"{retries:>7}  "
+            f"{badge:<14.14}  {info.get('age', 0.0):.1f}s")
     return "\n".join(lines)
 
 

@@ -123,10 +123,29 @@ class RecoveryManager:
             self._events.append((time.monotonic(), kind, detail))
             self._event_count += 1
 
+    def note(self, kind: str, detail: str = "") -> None:
+        """Public event-log append for the membership layer (ISSUE 10):
+        replacement/adoption/shrink events join the same durable log
+        the abort/retry protocol writes, so the sink (PR 9) and
+        ``mp4j-scope postmortem`` report full membership history."""
+        self._note(kind, detail)
+
     def events(self) -> list[tuple]:
         """The bounded epoch/retry event log (postmortem bundle)."""
         with self._events_lock:
             return list(self._events)
+
+    def seed(self, epoch: int) -> None:
+        """Pin a freshly adopted joiner's recovery state to the epoch
+        the membership round released (ISSUE 10): the joiner was never
+        part of epochs < ``epoch``, so both the released epoch and the
+        announce target start there — the fence sees a quiescent,
+        current state, and the joiner's peer dials pin the epoch every
+        survivor expects."""
+        with self._cond:
+            self.epoch = int(epoch)
+            self._target = int(epoch)
+            self._requested = int(epoch)
 
     def events_since(self, cursor: int) -> tuple[int, list[tuple], int]:
         """``(new_cursor, events, dropped)`` — the durable sink's

@@ -68,9 +68,13 @@ _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
 # retries/reconnects/aborts_seen (ISSUE 5): how many recovery rounds a
 # collective burned (booked into its bucket), how many peer channels
 # were re-dialed into a fresh epoch, and how many abort fan-outs this
-# rank observed (control-plane events, booked wherever the rank stood)
+# rank observed (control-plane events, booked wherever the rank stood).
+# replacements_seen/shrinks_seen (ISSUE 10): membership changes this
+# rank lived through — an adoption on the joiner, a renumbering on
+# every shrink survivor.
 _COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys",
              "retries", "reconnects", "aborts_seen",
+             "replacements_seen", "shrinks_seen",
              "wire_bytes_tcp", "wire_bytes_shm")
 
 # transports the wire split books (ISSUE 7); anything else (bare test
@@ -195,6 +199,15 @@ class CommStats:
         if shared is not None:
             return shared, self._shared_seq
         return "<untracked>", self._seq
+
+    def seed_seq(self, seq: int) -> None:
+        """Seed the collective sequence number of a freshly adopted
+        joiner (ISSUE 10): its heartbeats must report the JOB's
+        position, not 0 — a zero seq would read as the maximal laggard
+        in every skew table and hang diagnosis the moment it joins."""
+        with self._lock:
+            self._seq = max(self._seq, int(seq))
+            self._shared_seq = self._seq
 
     def progress(self) -> dict:
         """The heartbeat progress record (schema: obs.telemetry):

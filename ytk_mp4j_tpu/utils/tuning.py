@@ -102,6 +102,24 @@ Knobs (all validated where they are consumed; garbage raises
   frame-wise unbuffered writes, so a ``kill -9`` loses at most one
   flush interval of undrained telemetry plus the single frame being
   written (the torn tail the segment reader detects and reports).
+- ``MP4J_ELASTIC`` — elastic-membership mode (ISSUE 10;
+  ``resilience/membership.py``): ``off`` (default — a permanently dead
+  rank is a job-wide ``Mp4jFatalError``, exactly the pre-elastic
+  contract), ``replace`` (the master adopts a warm spare into the dead
+  rank's id at the next epoch and the fenced retry continues
+  bit-exactly), or ``shrink`` (survivors renumber contiguously and
+  continue at n-1 — reduction-only workloads). JOB-wide like
+  ``native_transport``. CONFLICTS with ``MP4J_MAX_RETRIES=0``: the
+  fenced retry IS the mechanism that re-runs the interrupted
+  collective after a membership change, so fail-stop mode hard-rejects
+  both elastic modes at setup (a validated-knob error, never a silent
+  precedence).
+- ``MP4J_SPARES`` — how many warm-spare registrations the master's
+  rendezvous waits for before starting the job (spares registered
+  later, mid-job, are accepted too); 0 (default) starts without any.
+- ``MP4J_ADOPT_SECS`` — how long the master waits for an adopted
+  spare's ack before declaring the spare dead and trying the next one
+  (or going terminal when the pool is empty).
 """
 
 from __future__ import annotations
@@ -153,6 +171,16 @@ AUDIT_MODES = ("off", "digest", "verify", "capture")
 # keeping the drain thread's duty cycle negligible.
 DEFAULT_SINK_BYTES = 64 * 1024 * 1024
 DEFAULT_SINK_FLUSH_SECS = 1.0
+# Elastic-membership defaults (ISSUE 10): OFF by default — replacing
+# or renumbering ranks is a semantic contract change the operator must
+# opt into; the adoption deadline is generous (a spare only has to ack
+# a control message, but a loaded host may schedule it late) while
+# still far below MP4J_DEAD_RANK_SECS so a dead spare costs one
+# deadline, not the whole recovery budget.
+DEFAULT_ELASTIC_MODE = "off"
+ELASTIC_MODES = ("off", "replace", "shrink")
+DEFAULT_SPARES = 0
+DEFAULT_ADOPT_SECS = 10.0
 # Metrics-plane default (ISSUE 6): the window the master's rate ring
 # covers. Heartbeats arrive every DEFAULT_HEARTBEAT_SECS, so 60 s keeps
 # ~120 interval points per rank — enough for a stable GB/s readout,
@@ -451,6 +479,73 @@ def sink_flush_secs() -> float:
     not by a zero period."""
     return env_float("MP4J_SINK_FLUSH_SECS", DEFAULT_SINK_FLUSH_SECS,
                      minimum=0.01)
+
+
+def elastic_mode(override=None, max_retries=None) -> str:
+    """The elastic-membership mode (``MP4J_ELASTIC``): one of
+    :data:`ELASTIC_MODES`. ``override`` is the explicit constructor arg
+    (``Master(elastic=...)`` / ``ProcessCommSlave(elastic=...)``) — it
+    bypasses the env read but gets the SAME validation (one validator
+    per knob, the PR 5 discipline). JOB-wide: the master drives the
+    membership protocol, but every slave validates the same value so a
+    misconfigured rank fails at setup, not mid-recovery.
+
+    CONFLICT RULE (ISSUE 10 bugfix guard): ``MP4J_MAX_RETRIES=0`` is
+    the exact fail-stop reference contract — the first transport error
+    is final and no abort round ever runs — while both elastic modes
+    NEED the fenced retry to re-run the interrupted collective after a
+    membership change. An elastic mode next to a zero retry budget is
+    therefore a contradiction, and it raises here as a validated-knob
+    error instead of one knob silently winning. ``max_retries`` is the
+    caller's explicit budget (None reads ``MP4J_MAX_RETRIES``)."""
+    if override is not None:
+        raw = str(override)
+    else:
+        raw = os.environ.get("MP4J_ELASTIC")
+        if raw is None or raw.strip() == "":
+            raw = DEFAULT_ELASTIC_MODE
+    name = raw.strip().lower()
+    if name not in ELASTIC_MODES:
+        raise Mp4jError(
+            f"MP4J_ELASTIC={raw!r} is not one of {list(ELASTIC_MODES)}")
+    if name != "off":
+        budget = (max_retries if max_retries is not None
+                  else env_int("MP4J_MAX_RETRIES", DEFAULT_MAX_RETRIES,
+                               minimum=0))
+        if budget == 0:
+            raise Mp4jError(
+                f"MP4J_ELASTIC={name} conflicts with MP4J_MAX_RETRIES=0: "
+                "fail-stop mode disables the epoch-fenced retry that "
+                "elastic membership re-runs the interrupted collective "
+                "through; set MP4J_MAX_RETRIES>=1 or MP4J_ELASTIC=off")
+    return name
+
+
+def spares(override=None) -> int:
+    """How many warm-spare registrations rendezvous waits for before
+    the job starts (``MP4J_SPARES``); spares may also register mid-job.
+    ``override`` is the explicit ``Master(spares=...)`` value, same
+    validation as the env path."""
+    if override is None:
+        return env_int("MP4J_SPARES", DEFAULT_SPARES, minimum=0)
+    val = int(override)
+    if val < 0:
+        raise Mp4jError(f"spares={override} must be >= 0")
+    return val
+
+
+def adopt_secs(override=None) -> float:
+    """The spare-adoption deadline (``MP4J_ADOPT_SECS``): how long the
+    master waits for an adopted spare's ack before trying the next
+    spare; must be positive (a zero deadline would burn the whole pool
+    before any spare could answer)."""
+    if override is None:
+        return env_float("MP4J_ADOPT_SECS", DEFAULT_ADOPT_SECS,
+                         minimum=0.001)
+    val = float(override)
+    if not val > 0:
+        raise Mp4jError(f"adopt_secs={override} must be > 0")
+    return val
 
 
 def fault_plan_spec() -> str:
