@@ -157,11 +157,14 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
     from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
 
     ctx = mp.get_context("fork")
-    # frozen legs pin MP4J_ELASTIC=off (the shm/audit/sink precedent):
-    # historical figures stay comparable whatever the caller's env says
+    # frozen legs pin MP4J_ELASTIC=off and the nonblocking scheduler
+    # off (the shm/audit/sink precedent): historical figures stay
+    # comparable whatever the caller's env says; the async legs opt
+    # back in explicitly
     master = Master(procs, timeout=60.0, elastic="off").serve_in_thread()
     q = ctx.Queue()
     slave_kwargs.setdefault("elastic", "off")
+    slave_kwargs.setdefault("async_collectives", False)
 
     def worker():
         try:
@@ -397,6 +400,113 @@ def bench_socket_allreduce_sweep(procs=4, reps=8, native_transport=True):
             row[algo] = round(size * 4 / dt / 1e9, 4)
         sweep[f"{size * 4}B"] = row
     return sweep, stats
+
+
+def bench_socket_async_overlap(procs=4, k=4, size=262_144, reps=8):
+    """ISSUE 11 figures: ``socket_async_overlap_gbs`` — k outstanding
+    1 MB ``iallreduce`` futures driven by the helper-thread scheduler
+    (the native leg-graph driver: every leg of every outstanding
+    collective in ONE C++ poll loop) — against
+    ``socket_async_sequential_gbs``, the same k collectives as
+    sequential blocking calls. Isolated leg, all-TCP, audit/sink off
+    (the frozen-leg precedent); the k sequential leg runs with the
+    scheduler pinned off (``async_collectives=False``) so it is the
+    exact pre-ISSUE-11 path.
+
+    MEASURED REALITY on this bench host (documented like PR 7's
+    shm-parity caveat): this is a ONE-core Firecracker guest, and the
+    sequential blocking path already saturates the core — its loopback
+    wire runs at the kernel-TCP CPU ceiling (~1.4 GB/s aggregate
+    duplex, measured) with 0% idle, so there is no latency to hide:
+    overlap cannot create CPU cycles, and every scheduling layer adds
+    some. The async figure lands BELOW sequential here (~0.6-0.7x;
+    rusage shows the delta is scheduler CPU + extra context switches,
+    the same class of 1-core scheduler-tail cost PR 7 measured for
+    user-space shm waits). The structural win of k outstanding
+    collectives — per-exchange wakeups and rounds amortized k-fold,
+    wire idle time on real multi-core/NIC hosts filled with other
+    collectives' work — needs a host where the wire is not the same
+    CPU the ranks compute on. The figure the async plane DOES win on
+    this host is ``socket_coalesce_keys_per_sec`` (fixed-cost
+    amortization, ~2.5x — see bench_socket_coalesce); bench-diff gates
+    both async figures so neither regresses further."""
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    def body_seq(slave, r):
+        bufs = [np.ones(size, np.float32) for _ in range(k)]
+        slave.barrier()
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(reps):
+            for b in bufs:
+                slave.allreduce_array(b, Operands.FLOAT,
+                                      Operators.SUM)
+                n += b.nbytes
+        return n / (time.perf_counter() - t0)
+
+    def body_async(slave, r):
+        bufs = [np.ones(size, np.float32) for _ in range(k)]
+        slave.barrier()
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(reps):
+            futs = [slave.iallreduce(b, Operands.FLOAT,
+                                     Operators.SUM) for b in bufs]
+            slave.wait_all()
+            n += sum(b.nbytes for b in bufs)
+        return n / (time.perf_counter() - t0)
+
+    seq, _ = _run_socket_job(procs, body_seq, True, shm=False,
+                             audit="off", sink_dir="",
+                             async_collectives=False)
+    asy, stats = _run_socket_job(procs, body_async, True, shm=False,
+                                 audit="off", sink_dir="",
+                                 async_collectives=True)
+    return {"async": min(asy) / 1e9, "sequential": min(seq) / 1e9,
+            "stats": stats}
+
+
+def bench_socket_coalesce(procs=4, maps=400, keys=16, window_us=500):
+    """ISSUE 11 coalescing figure: ``maps`` tiny ``iallreduce_map``
+    submissions (``keys`` int keys each) under the
+    ``MP4J_COALESCE_USECS`` window vs the same stream with coalescing
+    off (each map its own negotiation + tree walk). Fusion ships the
+    whole backlog as ONE vocabulary sync + columnar frame train per
+    negotiated batch, so the per-collective fixed cost (two tree walks
+    of small pickled frames, their syscalls and scheduler wakeups)
+    amortizes across the batch — measured ~2.5x keys/s at this config
+    on the bench host. Frozen legs elsewhere pin async off per the
+    shm/audit/sink precedent; this leg IS the async plane's figure."""
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    def body(slave, r):
+        ds = [{key + 1000 * i: np.float64((r + 1) * (key + 1))
+               for key in range(keys)} for i in range(maps)]
+        slave.barrier()
+        t0 = time.perf_counter()
+        for d in ds:
+            slave.iallreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        slave.wait_all()
+        return maps * keys / (time.perf_counter() - t0)
+
+    prior = os.environ.get("MP4J_COALESCE_USECS")
+    try:
+        os.environ["MP4J_COALESCE_USECS"] = str(window_us)
+        on, stats = _run_socket_job(procs, body, True, shm=False,
+                                    audit="off", sink_dir="",
+                                    async_collectives=True)
+        os.environ["MP4J_COALESCE_USECS"] = "0"
+        off, _ = _run_socket_job(procs, body, True, shm=False,
+                                 audit="off", sink_dir="",
+                                 async_collectives=True)
+    finally:
+        if prior is None:
+            os.environ.pop("MP4J_COALESCE_USECS", None)
+        else:
+            os.environ["MP4J_COALESCE_USECS"] = prior
+    return {"on": min(on), "off": min(off), "stats": stats}
 
 
 def bench_socket_recovery_latency(procs=4, reps=9, size=262_144):
@@ -1051,6 +1161,12 @@ def main():
     map_int_pickle_keys, _ = bench_socket_map(int_keys=True,
                                               columnar=False)
     map_sweep, map_sweep_stats = bench_socket_map_sweep()
+    # ISSUE 11: the nonblocking-collective figures — k outstanding
+    # iallreduces vs k sequential blocking calls (isolated leg; see
+    # bench_socket_async_overlap's 1-core caveat) and the tiny-map
+    # coalescing A/B (window on vs off)
+    async_overlap = bench_socket_async_overlap()
+    coalesce = bench_socket_coalesce()
     recovery, recovery_stats = bench_socket_recovery_latency()
     replacement = bench_socket_replacement_latency()
     shrinkage = bench_socket_shrink_latency()
@@ -1110,6 +1226,26 @@ def main():
             "socket_map_int_pickle_keys_per_sec": round(
                 map_int_pickle_keys, 0),
             "socket_map_allreduce_sweep": map_sweep,
+            # ISSUE 11 (mp4j-async): k outstanding iallreduces on the
+            # helper-thread scheduler vs the same k as sequential
+            # blocking calls, plus the coalescing A/B. On this 1-core
+            # host the sequential path saturates the core at the
+            # kernel-TCP CPU ceiling, so overlap has no idle to fill
+            # and the dense async figure lands BELOW sequential (the
+            # measured, documented reality — see the leg docstring);
+            # the coalescing figure is the async plane's honest win
+            # here (~2.5x, fixed-cost amortization)
+            "socket_async_overlap_gbs": round(async_overlap["async"], 4),
+            "socket_async_sequential_gbs": round(
+                async_overlap["sequential"], 4),
+            "socket_async_overlap_ratio": round(
+                async_overlap["async"] / async_overlap["sequential"],
+                3),
+            "socket_coalesce_keys_per_sec": round(coalesce["on"], 0),
+            "socket_coalesce_off_keys_per_sec": round(
+                coalesce["off"], 0),
+            "socket_coalesce_ratio": round(
+                coalesce["on"] / coalesce["off"], 3),
             # mp4j-resilience (ISSUE 5): one injected connection reset
             # in a 4-rank allreduce loop; recovery_latency_ms is the
             # full epoch-fenced abort/retry round end to end.

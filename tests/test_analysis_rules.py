@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 16)]
+    assert ids == [f"R{i}" for i in range(1, 17)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1157,4 +1157,91 @@ def test_r15_inline_and_baseline_suppression():
             def _sync_identity(self):
                 self._stats.rank = self._rank
     """, baseline=bl)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R16 — un-awaited CollectiveFuture crosses a collective boundary
+# ----------------------------------------------------------------------
+def test_r16_fires_on_unawaited_future_before_barrier():
+    r = run_rule("R16", """
+        def step(comm, x):
+            f = comm.iallreduce(x)
+            comm.barrier()
+    """)
+    [f] = r.findings
+    assert f.rule == "R16" and "'f'" in f.message
+    assert "wait" in f.message
+
+
+def test_r16_fires_on_unawaited_future_before_blocking_collective():
+    r = run_rule("R16", """
+        def step(comm, x, y):
+            f = comm.iallreduce_map(x)
+            comm.allreduce_array(y)
+    """)
+    [f] = r.findings
+    assert f.rule == "R16" and "allreduce_array" in f.message
+
+
+def test_r16_fires_on_unawaited_future_before_close():
+    r = run_rule("R16", """
+        def run(comm, x):
+            f = comm.igather(x)
+            comm.close(0)
+    """)
+    assert [f.rule for f in r.findings] == ["R16"]
+
+
+def test_r16_quiet_when_awaited():
+    r = run_rule("R16", """
+        def step(comm, x):
+            f = comm.iallreduce(x)
+            f.wait()
+            comm.barrier()
+
+        def step2(comm, x):
+            f = comm.iallreduce(x)
+            out = f.result()
+            comm.close(0)
+    """)
+    assert not r.findings
+
+
+def test_r16_quiet_on_wait_all_drain():
+    r = run_rule("R16", """
+        def step(comm, x, y):
+            f = comm.iallreduce(x)
+            g = comm.iallreduce_map(y)
+            comm.wait_all()
+            comm.allreduce_array(y)
+    """)
+    assert not r.findings
+
+
+def test_r16_quiet_on_other_comm_and_escape():
+    # a boundary on a DIFFERENT comm object is not this future's
+    # boundary; a future passed elsewhere escaped (its awaiting is the
+    # callee's contract)
+    r = run_rule("R16", """
+        def step(comm, other, x):
+            f = comm.iallreduce(x)
+            other.barrier()
+            f.wait()
+
+        def step2(comm, x):
+            f = comm.iallreduce(x)
+            track(f)
+            comm.barrier()
+    """)
+    assert not r.findings
+
+
+def test_r16_inline_suppression():
+    r = run_rule("R16", """
+        def step(comm, x):
+            f = comm.iallreduce(x)
+            # mp4j-lint: disable=R16 (harness drains at exit)
+            comm.barrier()
+    """)
     assert not r.findings and len(r.suppressed) == 1

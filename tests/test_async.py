@@ -1,0 +1,672 @@
+"""mp4j-async (ISSUE 11): nonblocking collectives + the helper-thread
+communication scheduler.
+
+The futures-conformance grid proves ``i*().wait()`` == the blocking
+twin BIT-FOR-BIT across all numeric operands x SUM/MAX/MIN/PROD x
+n in {2, 3, 5} on all four backends (socket engine + inline paths,
+thread, tpu, distributed), plus: future semantics (epoch tags,
+timeouts, error delivery, wait_all as the collective-boundary drain),
+the count-negotiated map coalescing (``allreduce_map_multi``: ragged
+offers converge on min, columnar and negotiated-pickle fusion both
+bit-exact, de-fuse leftovers), the new ``comm.stats()`` counters
+(outstanding_peak / coalesced_frames / overlap seconds) with analytic
+attribution, the ``mp4j_outstanding_collectives`` gauge + ``ovl%``
+live column, audit verify mode staying green (zero false divergences)
+over the async grid, and the async knob validation.
+"""
+
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from helpers import run_slaves
+from ytk_mp4j_tpu.comm import progress as progress_mod
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.comm.thread_comm import ThreadCommSlave
+from ytk_mp4j_tpu.exceptions import Mp4jError
+from ytk_mp4j_tpu.obs import metrics as metrics_mod
+from ytk_mp4j_tpu.obs import telemetry
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.utils import tuning
+
+NUMERIC = [Operands.DOUBLE, Operands.FLOAT, Operands.INT,
+           Operands.LONG, Operands.SHORT, Operands.BYTE]
+OPS = [Operators.SUM, Operators.MAX, Operators.MIN, Operators.PROD]
+JOIN = 60.0
+
+
+def _inputs(n, length, operand, rng):
+    if operand.dtype.kind == "f":
+        return [rng.standard_normal(length).astype(operand.dtype)
+                for _ in range(n)]
+    # values in {1, 2}: PROD over 5 ranks stays within every int width
+    return [rng.integers(1, 3, length).astype(operand.dtype)
+            for _ in range(n)]
+
+
+# ----------------------------------------------------------------------
+# futures-conformance grid: socket backend (engine + inline paths)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("n", [2, 3, 5])
+def test_socket_conformance_grid(n):
+    """i*().wait() == blocking, bit for bit, across every numeric
+    operand x operator on small payloads (the inline path: tree-algo
+    sizes run the blocking engine on the progression thread)."""
+    rng = np.random.default_rng(7)
+    cases = [(operand, op, _inputs(n, 1200, operand, rng))
+             for operand in NUMERIC for op in OPS]
+
+    def blocking(slave, r):
+        outs = []
+        for operand, op, data in cases:
+            a = data[r].copy()
+            slave.allreduce_array(a, operand, op)
+            outs.append(a)
+        return outs
+
+    def asyncb(slave, r):
+        futs = []
+        arrs = []
+        for operand, op, data in cases:
+            a = data[r].copy()
+            arrs.append(a)
+            futs.append(slave.iallreduce(a, operand, op))
+        slave.wait_all()
+        for f, a in zip(futs, arrs):
+            assert f.done()
+            assert f.wait() is a
+        return arrs
+
+    want = run_slaves(n, blocking, timeout=JOIN)
+    got = run_slaves(n, asyncb, timeout=JOIN)
+    for r in range(n):
+        for k in range(len(cases)):
+            np.testing.assert_array_equal(got[r][k], want[r][k])
+
+
+@pytest.mark.parametrize("n", [2, 3, 5])
+def test_socket_engine_grid_bit_exact(n):
+    """The interleaved raw engine (rhd / ring schedules, gather) at
+    engine-eligible sizes, several futures outstanding at once —
+    bit-exact against the blocking path, all four i* families."""
+    rng = np.random.default_rng(8)
+    data = [rng.standard_normal(150_000) for _ in range(n)]
+
+    def blocking(slave, r):
+        rhd = data[r].copy()
+        slave.allreduce_array(rhd, Operands.DOUBLE, Operators.SUM)
+        ring = data[r].copy()
+        slave.allreduce_array(ring, Operands.DOUBLE, Operators.SUM,
+                              algo="ring")
+        rs = data[r].copy()
+        slave.reduce_scatter_array(rs, Operands.DOUBLE, Operators.SUM)
+        ag = data[r].copy()
+        slave.allgather_array(ag, Operands.DOUBLE)
+        g = data[r].copy()
+        slave.gather_array(g, Operands.DOUBLE, root=n - 1)
+        return rhd, ring, rs, ag, g
+
+    def asyncb(slave, r):
+        rhd = data[r].copy()
+        ring = data[r].copy()
+        rs = data[r].copy()
+        ag = data[r].copy()
+        g = data[r].copy()
+        futs = [
+            slave.iallreduce(rhd, Operands.DOUBLE, Operators.SUM),
+            slave.iallreduce(ring, Operands.DOUBLE, Operators.SUM,
+                             algo="ring"),
+            slave.ireduce_scatter(rs, Operands.DOUBLE, Operators.SUM),
+            slave.iallgather(ag, Operands.DOUBLE),
+            slave.igather(g, Operands.DOUBLE, root=n - 1),
+        ]
+        slave.wait_all()
+        assert all(f.done() for f in futs)
+        return rhd, ring, rs, ag, g
+
+    want = run_slaves(n, blocking, timeout=JOIN)
+    got = run_slaves(n, asyncb, timeout=JOIN)
+    for r in range(n):
+        for k in range(5):
+            np.testing.assert_array_equal(got[r][k], want[r][k])
+
+
+def test_socket_map_conformance():
+    """iallreduce_map (coalesced AND classic) == allreduce_map, bit
+    for bit, including operator variety and string keys."""
+    def mk(r, tag):
+        return {f"{tag}{k}": np.float64((r + 1) * (k + 1))
+                for k in range(40)}
+
+    def blocking(slave, r):
+        outs = []
+        for i, op in enumerate(OPS):
+            d = mk(r, f"b{i}_")
+            slave.allreduce_map(d, Operands.DOUBLE, op)
+            outs.append(d)
+        return outs
+
+    def asyncb(slave, r):
+        ds = [mk(r, f"b{i}_") for i in range(len(OPS))]
+        futs = [slave.iallreduce_map(d, Operands.DOUBLE, op)
+                for d, op in zip(ds, OPS)]
+        slave.wait_all()
+        [f.wait() for f in futs]
+        return ds
+
+    want = run_slaves(3, blocking, timeout=JOIN)
+    prior = os.environ.get("MP4J_COALESCE_USECS")
+    try:
+        os.environ["MP4J_COALESCE_USECS"] = "300"
+        got = run_slaves(3, asyncb, timeout=JOIN)
+    finally:
+        if prior is None:
+            os.environ.pop("MP4J_COALESCE_USECS", None)
+        else:
+            os.environ["MP4J_COALESCE_USECS"] = prior
+    got_off = run_slaves(3, asyncb, timeout=JOIN)
+    for got_one in (got, got_off):
+        for r in range(3):
+            for k in range(len(OPS)):
+                assert set(got_one[r][k]) == set(want[r][k])
+                for key in want[r][k]:
+                    assert got_one[r][k][key] == want[r][k][key]
+
+
+def test_eager_mode_conformance():
+    """MP4J_ASYNC=0 (the frozen-leg pin): i* executes eagerly on the
+    caller thread behind the same future contract."""
+    rng = np.random.default_rng(9)
+    data = [rng.standard_normal(5000) for _ in range(3)]
+
+    def blocking(slave, r):
+        a = data[r].copy()
+        slave.allreduce_array(a, Operands.DOUBLE, Operators.SUM)
+        return a
+
+    def asyncb(slave, r):
+        a = data[r].copy()
+        fut = slave.iallreduce(a, Operands.DOUBLE, Operators.SUM)
+        assert fut.done()        # eager: resolved at submit
+        return fut.wait()
+
+    want = run_slaves(3, blocking, timeout=JOIN)
+    got = run_slaves(3, asyncb, timeout=JOIN,
+                     async_collectives=False)
+    for r in range(3):
+        np.testing.assert_array_equal(got[r], want[r])
+
+
+# ----------------------------------------------------------------------
+# the other three backends (eager / device-pipelined futures)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("n", [2, 3, 5])
+def test_thread_backend_conformance(n):
+    rng = np.random.default_rng(10)
+    cases = [(operand, op, _inputs(n, 600, operand, rng))
+             for operand in NUMERIC for op in OPS]
+    group = ThreadCommSlave.spawn_group(n)
+    want = [[None] * len(cases) for _ in range(n)]
+    got = [[None] * len(cases) for _ in range(n)]
+
+    def worker(slave, t):
+        for k, (operand, op, data) in enumerate(cases):
+            a = data[t].copy()
+            slave.allreduce_array(a, operand, op)
+            want[t][k] = a
+            b = data[t].copy()
+            fut = slave.iallreduce(b, operand, op)
+            got[t][k] = fut.wait()
+            assert fut.done()
+        slave.wait_all()         # no-op drain, kept for portability
+        d = {k: np.float64(t + k) for k in range(20)}
+        e = dict(d)
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        out = slave.iallreduce_map(e, Operands.DOUBLE,
+                                   Operators.SUM).wait()
+        assert out == d
+
+    threads = [threading.Thread(target=worker, args=(s, t),
+                                daemon=True)
+               for t, s in enumerate(group)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(JOIN)
+        assert not t.is_alive()
+    for t in range(n):
+        for k in range(len(cases)):
+            np.testing.assert_array_equal(got[t][k], want[t][k])
+
+
+@pytest.mark.parametrize("n", [2, 3, 5])
+def test_tpu_backend_conformance(n):
+    from ytk_mp4j_tpu.comm.tpu_comm import TpuCommCluster
+
+    cluster = TpuCommCluster(n)
+    rng = np.random.default_rng(11)
+    for operand in (Operands.DOUBLE, Operands.FLOAT, Operands.INT,
+                    Operands.LONG):
+        for op in OPS:
+            data = _inputs(n, 400, operand, rng)
+            want = [d.copy() for d in data]
+            cluster.allreduce_array(want, operand, op)
+            got = [d.copy() for d in data]
+            fut = cluster.iallreduce(got, operand, op)
+            fut.wait()           # driver mode mutates `got` in place
+            for r in range(n):
+                np.testing.assert_array_equal(got[r], want[r])
+    # the device map twin rides the chained-dispatch PendingMap
+    maps_w = [{k: np.float64(r + k) for k in range(30)}
+              for r in range(n)]
+    maps_g = [dict(m) for m in maps_w]
+    cluster.allreduce_map(maps_w, Operands.DOUBLE, Operators.SUM)
+    fut = cluster.iallreduce_map(maps_g, Operands.DOUBLE,
+                                 Operators.SUM)
+    assert not fut.done()        # fetch+decode deferred to wait()
+    fut.wait()
+    assert maps_g == maps_w
+    cluster.wait_all()
+
+
+def test_distributed_backend_conformance():
+    from ytk_mp4j_tpu.comm import distributed as dist_mod
+
+    comm = dist_mod.DistributedComm()
+    try:
+        rng = np.random.default_rng(12)
+        for operand in (Operands.DOUBLE, Operands.INT):
+            for op in OPS:
+                data = _inputs(comm.slave_num, 300, operand, rng)
+                a = data[comm.rank].copy()
+                comm.allreduce_array(a, operand, op)
+                b = data[comm.rank].copy()
+                fut = comm.iallreduce(b, operand, op)
+                np.testing.assert_array_equal(fut.wait(), a)
+        d = {k: np.float64(k) for k in range(20)}
+        e = dict(d)
+        comm.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        assert comm.iallreduce_map(e, Operands.DOUBLE,
+                                   Operators.SUM).wait() == d
+        comm.wait_all()
+    finally:
+        comm.close(0)
+
+
+# ----------------------------------------------------------------------
+# future semantics
+# ----------------------------------------------------------------------
+def test_future_semantics_and_boundary_drain():
+    def fn(slave, r):
+        a = np.ones(150_000)
+        fut = slave.iallreduce(a, Operands.DOUBLE, Operators.SUM)
+        assert fut.op == "allreduce_array"
+        assert fut.epoch == 0          # the submit epoch rides along
+        out = fut.wait(timeout=JOIN)
+        assert out is a and fut.exception() is None
+        # blocking collectives drain outstanding futures first: the
+        # blocking result must order after the async one
+        b = np.ones(150_000)
+        slave.iallreduce(b, Operands.DOUBLE, Operators.SUM)
+        c = np.ones(1000)
+        slave.allreduce_array(c, Operands.DOUBLE, Operators.SUM)
+        assert slave.outstanding() == 0   # the drain happened
+        # a validation failure is delivered at wait(), not swallowed
+        bad = np.ones((10, 10))
+        fbad = slave.iallreduce(bad, Operands.DOUBLE, Operators.SUM)
+        with pytest.raises(Mp4jError):
+            fbad.wait(timeout=JOIN)
+        assert isinstance(fbad.exception(), Mp4jError)
+        # barrier is also a drain point
+        d = np.ones(2000)
+        slave.iallreduce(d, Operands.DOUBLE, Operators.SUM)
+        slave.barrier()
+        assert slave.outstanding() == 0
+        np.testing.assert_array_equal(d, 3 * np.ones(2000))
+        return True
+
+    assert all(run_slaves(3, fn, timeout=JOIN))
+
+
+def test_wait_all_reraises_unawaited_failure():
+    def fn(slave, r):
+        bad = np.ones((4, 4))
+        slave.iallreduce(bad, Operands.DOUBLE, Operators.SUM)
+        with pytest.raises(Mp4jError):
+            slave.wait_all()
+        # the failure was delivered; a second drain is clean
+        slave.wait_all()
+        return True
+
+    assert all(run_slaves(2, fn, timeout=JOIN))
+
+
+def test_future_wait_timeout_does_not_consume():
+    fut = progress_mod.CollectiveFuture("allreduce_array")
+    with pytest.raises(Mp4jError, match="not complete"):
+        fut.wait(timeout=0.01)
+    fut._resolve("x")
+    assert fut.wait(timeout=0.01) == "x"
+
+
+# ----------------------------------------------------------------------
+# the fused map collective (count negotiation)
+# ----------------------------------------------------------------------
+def test_multi_ragged_offers_converge_on_min():
+    """Ranks offering different batch depths negotiate m = min and
+    stay in lockstep over successive calls; every map's result is
+    bit-identical to its own allreduce_map."""
+    def mk(r, i):
+        return {int(k + 100 * i): np.float64((r + 1) * (k + 1))
+                for k in range(25)}
+
+    def blocking(slave, r):
+        outs = []
+        for i in range(3):
+            d = mk(r, i)
+            slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+            outs.append(d)
+        return outs
+
+    def fused(slave, r):
+        ds = [mk(r, i) for i in range(3)]
+        if r == 0:
+            m1 = slave.allreduce_map_multi([ds[0]], Operands.DOUBLE,
+                                           Operators.SUM)
+            assert m1 == 1
+            m2 = slave.allreduce_map_multi(ds[1:], Operands.DOUBLE,
+                                           Operators.SUM)
+            assert m2 == 2
+        else:
+            m1 = slave.allreduce_map_multi(list(ds), Operands.DOUBLE,
+                                           Operators.SUM)
+            assert m1 == 1          # min over offers (rank 0 offered 1)
+            # un-merged maps were left untouched
+            assert ds[1] == mk(r, 1)
+            m2 = slave.allreduce_map_multi(ds[1:], Operands.DOUBLE,
+                                           Operators.SUM)
+            assert m2 == 2
+        return ds
+
+    want = run_slaves(3, blocking, timeout=JOIN)
+    got = run_slaves(3, fused, timeout=JOIN)
+    for r in range(3):
+        for i in range(3):
+            assert got[r][i] == want[r][i]
+
+
+def test_multi_negotiated_pickle_fallback_and_nop():
+    """A batch whose maps cannot ride the columnar plane (mixed key
+    kinds) fuses over the negotiated pickled plane; an all-empty batch
+    negotiates a nop."""
+    def mk(r):
+        return {1: np.float64(r + 1), "s": np.float64(2 * r)}
+
+    def blocking(slave, r):
+        d = mk(r)
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        return d
+
+    def fused(slave, r):
+        ds = [mk(r), mk(r)]
+        m = slave.allreduce_map_multi(ds, Operands.DOUBLE,
+                                      Operators.SUM)
+        assert m == 2
+        empties = [{}, {}]
+        assert slave.allreduce_map_multi(
+            empties, Operands.DOUBLE, Operators.SUM) == 2
+        assert empties == [{}, {}]
+        return ds
+
+    want = run_slaves(3, blocking, timeout=JOIN)
+    got = run_slaves(3, fused, timeout=JOIN)
+    for r in range(3):
+        assert got[r][0] == want[r] and got[r][1] == want[r]
+
+
+def test_multi_rejects_garbage():
+    def fn(slave, r):
+        with pytest.raises(Mp4jError, match="non-empty list"):
+            slave.allreduce_map_multi([], Operands.DOUBLE,
+                                      Operators.SUM)
+        with pytest.raises(Mp4jError, match="non-empty list"):
+            slave.allreduce_map_multi({}, Operands.DOUBLE,
+                                      Operators.SUM)
+        return True
+
+    assert all(run_slaves(1, fn, timeout=JOIN))
+
+
+# ----------------------------------------------------------------------
+# stats / metrics / live view (analytic attribution)
+# ----------------------------------------------------------------------
+def test_async_stats_counters_analytic():
+    K = 6
+
+    def fn(slave, r):
+        bufs = [np.ones(150_000) for _ in range(K)]
+        futs = [slave.iallreduce(b, Operands.DOUBLE, Operators.SUM)
+                for b in bufs]
+        slave.wait_all()
+        [f.wait() for f in futs]
+        return slave.stats(), slave._comm_stats.metrics.snapshot()
+
+    out = run_slaves(3, fn, timeout=JOIN)
+    for st, mets in out:
+        asy = st["<async>"]
+        # peak: the submit loop outruns the engine on this host, but
+        # whatever the race, the peak is within [1, K] and the delta
+        # algebra kept it monotone
+        assert 1 <= asy["outstanding_peak"] <= K
+        assert asy["async_inflight"] > 0.0
+        assert 0.0 <= asy["async_overlap"] <= asy["async_inflight"]
+        # every engine collective booked calls + wire on its family
+        fam = st["allreduce_array"]
+        assert fam["calls"] == K
+        assert fam["bytes_sent"] > 0 and fam["bytes_recv"] > 0
+        # the outstanding gauge exists and is back to 0 at the drain
+        assert mets["gauges"]["async/outstanding"] == 0.0
+
+
+def test_coalesced_frames_counter_and_keys():
+    MAPS, KEYS = 12, 10
+
+    def fn(slave, r):
+        ds = [{k + 100 * i: np.float64(r + 1) for k in range(KEYS)}
+              for i in range(MAPS)]
+        futs = [slave.iallreduce_map(d, Operands.DOUBLE,
+                                     Operators.SUM) for d in ds]
+        slave.wait_all()
+        [f.wait() for f in futs]
+        return slave.stats()
+
+    prior = os.environ.get("MP4J_COALESCE_USECS")
+    try:
+        os.environ["MP4J_COALESCE_USECS"] = "400"
+        out = run_slaves(3, fn, timeout=JOIN)
+    finally:
+        if prior is None:
+            os.environ.pop("MP4J_COALESCE_USECS", None)
+        else:
+            os.environ["MP4J_COALESCE_USECS"] = prior
+    for st in out:
+        multi = st["allreduce_map_multi"]
+        assert multi["coalesced_frames"] >= 1
+        # keys: every map entry encoded columnar exactly once
+        assert multi["keys"] == MAPS * KEYS
+        assert multi["calls"] < MAPS     # fusion actually fused
+
+
+def test_live_view_ovl_column_and_prometheus_gauge():
+    doc = {
+        "slave_num": 2, "window_secs": 60.0,
+        "cluster": {"rates": {}, "stats": {}},
+        "ranks": {
+            "0": {"progress": {"seq": 4}, "age": 0.1,
+                  "rates": {"bytes_per_sec": 1e6},
+                  "gauges": {"async/outstanding": 3.0},
+                  "stats": {"<async>": {"async_inflight": 2.0,
+                                        "async_overlap": 1.0}}},
+            "1": {"progress": {"seq": 4}, "age": 0.1, "rates": {},
+                  "stats": {}},
+        },
+    }
+    live = telemetry.format_live(doc)
+    assert "ovl%" in live
+    row0 = next(ln for ln in live.splitlines()
+                if ln.strip().startswith("0"))
+    assert "50" in row0              # 1.0 / 2.0 overlap fraction
+    row1 = next(ln for ln in live.splitlines()
+                if ln.strip().startswith("1"))
+    assert row1.split()[6] == "-"    # no async work -> no ovl%
+    prom = metrics_mod.to_prometheus(doc)
+    assert 'mp4j_outstanding_collectives{rank="0"} 3' in prom
+    assert 'mp4j_outstanding_collectives{rank="cluster"} 3' in prom
+
+
+# ----------------------------------------------------------------------
+# audit verify mode stays green over the async grid
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("shm", [True, False])
+def test_audit_verify_green_on_async_grid(shm):
+    """The acceptance grid: engine batches + coalesced maps under
+    MP4J_AUDIT=verify — every seq cross-rank verified, ZERO false
+    divergences (per-collective wire folds stay exact whatever the
+    local interleaving). shm=False matters: the thread harness
+    co-locates ranks, and only the all-TCP run exercises the engine's
+    at-wire-time folds (the round-13 drive caught post-hoc send folds
+    reading buffers later rounds had overwritten)."""
+    n = 4
+    log = io.StringIO()
+    master = Master(n, timeout=JOIN, log_stream=log).serve_in_thread()
+    results = [None] * n
+    errors: list = [None] * n
+    rng = np.random.default_rng(13)
+    data = [rng.standard_normal(150_000) for _ in range(n)]
+
+    def fn(slave, r):
+        n_coll = 0
+        futs = [slave.iallreduce(data[r].copy() * (k + 1),
+                                 Operands.DOUBLE, Operators.SUM)
+                for k in range(4)]
+        slave.wait_all()
+        [f.wait() for f in futs]
+        n_coll += 4
+        ds = [{int(k + 50 * i): np.float64((r + 1) * (k + 1))
+               for k in range(30)} for i in range(5)]
+        mfuts = [slave.iallreduce_map(d, Operands.DOUBLE,
+                                      Operators.SUM) for d in ds]
+        slave.wait_all()
+        [f.wait() for f in mfuts]
+        # the fused plane consumes one ordinal per negotiated batch;
+        # read the actual count from the schedule position
+        return slave.progress()["seq"]
+
+    def worker(i):
+        slave = None
+        try:
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=JOIN,
+                audit="verify", dead_rank_secs=20.0, shm=shm)
+            results[slave.rank] = fn(slave, slave.rank)
+            time.sleep(1.2)      # two heartbeats: deltas reach master
+            slave.close(0)
+        except Exception as e:
+            errors[i] = e
+
+    prior = os.environ.get("MP4J_COALESCE_USECS")
+    try:
+        os.environ["MP4J_COALESCE_USECS"] = "300"
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True) for i in range(n)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + JOIN
+        for t in threads:
+            t.join(max(0.1, deadline - time.monotonic()))
+        assert not any(t.is_alive() for t in threads), log.getvalue()
+    finally:
+        if prior is None:
+            os.environ.pop("MP4J_COALESCE_USECS", None)
+        else:
+            os.environ["MP4J_COALESCE_USECS"] = prior
+    master.join(10.0)
+    assert all(e is None for e in errors), (errors, log.getvalue())
+    st = master.audit_status()
+    assert st["divergences"] == 0, (st, log.getvalue())
+    assert st["verified_seq"] > 0, st
+
+
+# ----------------------------------------------------------------------
+# knob validation
+# ----------------------------------------------------------------------
+def test_async_knob_validation(monkeypatch):
+    monkeypatch.setenv("MP4J_ASYNC", "2")
+    with pytest.raises(Mp4jError, match="MP4J_ASYNC"):
+        tuning.async_enabled()
+    monkeypatch.setenv("MP4J_ASYNC", "0")
+    assert tuning.async_enabled() is False
+    monkeypatch.delenv("MP4J_ASYNC")
+    assert tuning.async_enabled() is True
+
+    monkeypatch.setenv("MP4J_COALESCE_USECS", "-5")
+    with pytest.raises(Mp4jError, match="MP4J_COALESCE_USECS"):
+        tuning.coalesce_usecs()
+    monkeypatch.setenv("MP4J_COALESCE_USECS", "250")
+    assert tuning.coalesce_usecs() == 250
+
+    monkeypatch.setenv("MP4J_MAX_OUTSTANDING", "0")
+    with pytest.raises(Mp4jError, match="MP4J_MAX_OUTSTANDING"):
+        tuning.max_outstanding()
+    monkeypatch.setenv("MP4J_MAX_OUTSTANDING", "8")
+    assert tuning.max_outstanding() == 8
+
+
+def test_max_outstanding_backpressure():
+    def fn(slave, r):
+        bufs = [np.ones(150_000) for _ in range(6)]
+        futs = [slave.iallreduce(b, Operands.DOUBLE, Operators.SUM)
+                for b in bufs]
+        slave.wait_all()
+        [f.wait() for f in futs]
+        st = slave.stats()["<async>"]
+        # the cap bounded concurrency: the peak can never exceed it
+        assert st["outstanding_peak"] <= 2
+        return True
+
+    prior = os.environ.get("MP4J_MAX_OUTSTANDING")
+    try:
+        os.environ["MP4J_MAX_OUTSTANDING"] = "2"
+        assert all(run_slaves(3, fn, timeout=JOIN))
+    finally:
+        if prior is None:
+            os.environ.pop("MP4J_MAX_OUTSTANDING", None)
+        else:
+            os.environ["MP4J_MAX_OUTSTANDING"] = prior
+
+
+def test_eager_mode_wait_all_reraises_unawaited_failure():
+    """MP4J_ASYNC=0: the drain's re-raise contract must not depend on
+    the knob — an eager failure nobody awaited surfaces at
+    wait_all()."""
+    def fn(slave, r):
+        bad = np.ones((4, 4))
+        slave.iallreduce(bad, Operands.DOUBLE, Operators.SUM)
+        with pytest.raises(Mp4jError):
+            slave.wait_all()
+        slave.wait_all()         # delivered once; second drain clean
+        f2 = slave.iallreduce(np.ones((2, 2)), Operands.DOUBLE,
+                              Operators.SUM)
+        with pytest.raises(Mp4jError):
+            f2.wait()
+        slave.wait_all()         # observed at wait(): nothing to raise
+        return True
+
+    assert all(run_slaves(2, fn, timeout=JOIN,
+                          async_collectives=False))

@@ -664,3 +664,87 @@ def test_vocab_export_import_roundtrip():
     assert ic2.encode([10, 99, 50, 5], 4).tolist() == [1, 3, 0, 2]
     with pytest.raises(Mp4jError):
         ic2.import_keys([1, 2])
+
+
+# ----------------------------------------------------------------------
+# mid-map-sync vocabulary replay (the PR 10 follow-up, closed in
+# ISSUE 11)
+# ----------------------------------------------------------------------
+def test_replace_mid_map_sync_vocab_replay():
+    """A rank killed BETWEEN the novelty-up and decision-down legs of
+    the job's FIRST map collective: the codec kind was created by the
+    in-flight attempt, so it is absent from the donor's pre-attempt
+    pin — the manifest must export that kind EMPTY (every survivor's
+    retry truncates it to zero), never the attempt's tentative growth.
+    Shipping the tentative table instead seeds the joiner with keys no
+    survivor re-offers after the rollback: its novelty exchange skips
+    them (already encoded locally), the canonical growth never assigns
+    them on the survivors, and the job's code tables diverge for good.
+    The regression: adoption converges bit-exactly in ONE retry round,
+    and a SECOND map mixing old and novel keys — the call diverged
+    tables corrupt even when the first looks right — stays bit-exact
+    too."""
+    def mk(r):
+        # per-rank-unique keys: the dead rank's keys exist nowhere
+        # else, so a stale joiner vocabulary cannot hide
+        return {int(r * 1000 + k): np.float64((r + 1) * (k + 1))
+                for k in range(40)}
+
+    def mk2(r, d):
+        d2 = {int(5000 + k): np.float64(r + 1) for k in range(20)}
+        for kk in list(d)[:5]:
+            d2[kk] = np.float64(1.0)
+        return d2
+
+    def body(slave, r, sabotage=False):
+        d = mk(r)
+        if sabotage:
+            orig = slave._grow_map_codec
+            state = {"fired": False}
+
+            def grow(decision):
+                if not state["fired"]:
+                    state["fired"] = True
+                    # die mid-sync: the novelty went up and the
+                    # decision came down (so the DONOR survivor's
+                    # codec holds the attempt's full tentative
+                    # growth), but no column moved — the worst case
+                    # for the manifest export
+                    slave._fault_kill(None)
+                    raise FaultKill(
+                        "fault injection: rank 2 killed mid-map-sync")
+                return orig(decision)
+
+            slave._grow_map_codec = grow
+        slave.allreduce_map(d, Operands.DOUBLE, Operators.SUM)
+        d2 = mk2(r, mk(r))
+        slave.allreduce_map(d2, Operands.DOUBLE, Operators.SUM)
+        return d, d2
+
+    def fn_clean(slave, r):
+        return body(slave, r)
+
+    def fn_faulted(slave, r):
+        return body(slave, r, sabotage=(r == 2))
+
+    def spare_fn(sp):
+        assert sp.resume_seq == 0, sp.resume_seq
+        return body(sp, 2)
+
+    want, werr, _, _, _ = run_elastic(N, fn_clean, shm=False)
+    assert all(e is None for e in werr), werr
+    got, errors, spares, master, log = run_elastic(
+        N, fn_faulted, spare_fns=[spare_fn], shm=False,
+        master_kwargs={"elastic": "replace"}, elastic="replace")
+    assert isinstance(errors[2], FaultKill), f"{errors}\n{log}"
+    survivors = [errors[r] for r in range(N) if r != 2]
+    assert all(e is None for e in survivors), \
+        f"survivor errors: {errors}\n{log}"
+    assert spares[0].get("adopted_rank") == 2, f"{spares}\n{log}"
+    assert "error" not in spares[0], f"{spares[0].get('error')}\n{log}"
+    for r in range(N):
+        for i in range(2):
+            assert set(got[r][i]) == set(want[r][i]), (r, i)
+            for k in got[r][i]:
+                assert got[r][i][k] == want[r][i][k], (r, i, k)
+    assert master.final_code == 0, log

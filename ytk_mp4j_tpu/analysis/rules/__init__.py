@@ -17,6 +17,7 @@
 | R13 | error   | raw-byte read of a possibly non-contiguous array |
 | R14 | error   | telemetry artifact write skipping tmp+os.replace |
 | R15 | error   | roster-derived topology cached in an attribute |
+| R16 | error   | un-awaited CollectiveFuture crosses a boundary |
 """
 
 from __future__ import annotations
@@ -49,6 +50,8 @@ from ytk_mp4j_tpu.analysis.rules.r13_digest_contiguity import (
 from ytk_mp4j_tpu.analysis.rules.r14_torn_write import R14TornWrite
 from ytk_mp4j_tpu.analysis.rules.r15_topology_cache import (
     R15TopologyCache)
+from ytk_mp4j_tpu.analysis.rules.r16_unawaited_future import (
+    R16UnawaitedFuture)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -66,6 +69,7 @@ ALL_RULES = [
     R13DigestContiguity,
     R14TornWrite,
     R15TopologyCache,
+    R16UnawaitedFuture,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

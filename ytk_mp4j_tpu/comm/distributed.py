@@ -47,6 +47,7 @@ from jax.sharding import Mesh
 
 from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.comm import progress as progress_mod
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
@@ -768,6 +769,53 @@ class DistributedComm(CommSlave):
         d.clear()
         d.update(mine)
         return d
+
+    # ------------------------------------------------------------------
+    # nonblocking collectives (ISSUE 11): the multi-host device plane
+    # runs one jitted program per collective whose dispatch is already
+    # asynchronous under JAX — the i* twins execute eagerly and return
+    # resolved futures, keeping one API across all four backends.
+    # ------------------------------------------------------------------
+    def iallreduce(self, arr, operand: Operand = Operands.FLOAT,
+                   operator: Operator = Operators.SUM,
+                   from_: int = 0, to: int | None = None):
+        """Eager nonblocking :meth:`allreduce_array` (resolved
+        future)."""
+        return progress_mod.eager_future(
+            self, "allreduce_array", arr, operand, operator,
+            from_=from_, to=to)
+
+    def ireduce_scatter(self, arr, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        ranges=None):
+        """Eager nonblocking :meth:`reduce_scatter_array`."""
+        return progress_mod.eager_future(
+            self, "reduce_scatter_array", arr, operand, operator,
+            ranges=ranges)
+
+    def iallgather(self, arr, operand: Operand = Operands.FLOAT,
+                   ranges=None):
+        """Eager nonblocking :meth:`allgather_array`."""
+        return progress_mod.eager_future(
+            self, "allgather_array", arr, operand, ranges=ranges)
+
+    def igather(self, arr, operand: Operand = Operands.FLOAT,
+                root: int = 0, ranges=None):
+        """Eager nonblocking :meth:`gather_array`."""
+        return progress_mod.eager_future(
+            self, "gather_array", arr, operand, root=root,
+            ranges=ranges)
+
+    def iallreduce_map(self, d: dict,
+                       operand: Operand = Operands.DOUBLE,
+                       operator: Operator = Operators.SUM):
+        """Eager nonblocking :meth:`allreduce_map`."""
+        return progress_mod.eager_future(
+            self, "allreduce_map", d, operand, operator)
+
+    def wait_all(self, timeout: float | None = None) -> None:
+        """Collective-boundary drain; the eager backend never has
+        outstanding work — no-op, kept for portable code."""
 
 
 # per-collective tracing (utils.trace; zero overhead when disabled)

@@ -44,6 +44,7 @@ import numpy as np
 from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm import keycodec
 from ytk_mp4j_tpu.comm import master as master_mod
+from ytk_mp4j_tpu.comm import progress as progress_mod
 from ytk_mp4j_tpu.comm.context import CommSlave
 from ytk_mp4j_tpu.obs import audit as audit_mod
 from ytk_mp4j_tpu.obs import metrics as metrics_mod
@@ -141,7 +142,8 @@ class ProcessCommSlave(CommSlave):
                  audit: str | None = None,
                  sink_dir: str | None = None,
                  elastic: str | None = None,
-                 spare: bool = False):
+                 spare: bool = False,
+                 async_collectives: bool | None = None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -221,6 +223,17 @@ class ProcessCommSlave(CommSlave):
         (the fenced retry is the mechanism that re-runs the
         interrupted collective after a membership change).
 
+        ``async_collectives`` (ISSUE 11; None reads ``MP4J_ASYNC``,
+        default on) selects how the nonblocking ``i*`` methods
+        execute: on the per-slave helper progression thread
+        (``comm/progress.py`` — many outstanding collectives driven
+        through one poll loop, with wire/reduce overlap across them),
+        or — when False — eagerly on the caller's thread, returning
+        already-resolved futures. A LOCAL execution-strategy choice
+        (wire-identical either way), unlike the JOB-wide
+        ``MP4J_COALESCE_USECS`` coalescing window also validated
+        here.
+
         ``spare=True`` registers this slave as a WARM SPARE (ISSUE 10)
         instead of claiming a rank: construction blocks — pinging the
         master from a background thread — until the master adopts it
@@ -291,6 +304,18 @@ class ProcessCommSlave(CommSlave):
         self._map_columnar = (tuning.map_columnar_enabled()
                               if map_columnar is None
                               else bool(map_columnar))
+        # nonblocking collectives (ISSUE 11): knobs validated up front
+        # like every other; the scheduler itself starts lazily on the
+        # first i* submission, so a fully blocking job pays nothing
+        self._async_on = (tuning.async_enabled()
+                          if async_collectives is None
+                          else bool(async_collectives))
+        self._coalesce_usecs = tuning.coalesce_usecs()
+        self._max_outstanding = tuning.max_outstanding()
+        self._async: progress_mod.ProgressScheduler | None = None
+        self._async_lock = threading.Lock()
+        self._eager_failed: list = []   # MP4J_ASYNC=0 failures for
+        # wait_all's re-raise contract (caller thread only)
         # persistent key<->code vocabularies for the columnar map
         # plane, kept IDENTICAL across ranks (grown only inside the
         # synchronized novelty exchange — see _map_sync)
@@ -479,6 +504,11 @@ class ProcessCommSlave(CommSlave):
         self._master_send((master_mod.LOG, {"level": "ERROR", "msg": msg}))
 
     def barrier(self) -> None:
+        # the collective-boundary drain (ISSUE 11): outstanding
+        # nonblocking collectives complete before the barrier so the
+        # job-wide collective order stays the submit order
+        if self._async is not None:
+            self._async.drain_for_blocking()
         gen = self._barrier_gen
         self._barrier_gen += 1
         self._master_send((master_mod.BARRIER, {"gen": gen}))
@@ -871,6 +901,12 @@ class ProcessCommSlave(CommSlave):
     def close(self, code: int = 0) -> None:
         if self._closed:
             return
+        # drain the nonblocking scheduler first (bounded): in-flight
+        # futures either complete or fail with the terminal error —
+        # close must never strand a waiter (mp4j-lint R16 flags the
+        # un-awaited-future-before-close hazard statically)
+        if self._async is not None:
+            self._async.shutdown()
         self._hb_stop.set()
         # flush-on-close (ISSUE 9): the final collective's spans and
         # deltas reach the segment before the close handshake — a
@@ -1505,11 +1541,31 @@ class ProcessCommSlave(CommSlave):
         native.reduce_into(operator, acc, src)
         self._comm_stats.add("reduce_seconds", time.perf_counter() - t0)
 
+    def _send_reduce_contrib(self, peer: int, chunk,
+                             operand: Operand) -> None:
+        """The send half that PAIRS with :meth:`_recv_reduce`: the
+        receiver drains in ``MP4J_CHUNK_BYTES`` exchanges, so the raw
+        sender must ship the same exchange schedule — on the shm plane
+        the ring/carrier routing is a per-EXCHANGE size rule, and a
+        monolithic send against a chunked receive deadlocks the moment
+        a segment exceeds one chunk with a sub-``_RING_MIN`` tail (the
+        tail rides the ring on one side and the carrier on the other).
+        A pure function of the same job-wide sizes as the receiver's
+        schedule, so both ends always agree (mp4j-lint R8
+        discipline)."""
+        if self._raw_ok(operand) and isinstance(chunk, np.ndarray):
+            self._chunked_exchange(peer, peer, chunk, None)
+        else:
+            self._send_segment(peer, chunk, operand)
+
     def _recv_reduce(self, peer: int, acc: np.ndarray, operator: Operator,
                      operand: Operand) -> None:
         """Receive a segment the size of ``acc`` and merge it in,
         chunk-by-chunk (merge of chunk k overlaps the wire transfer of
-        chunk k+1); raw or framed per the job-wide wire decision."""
+        chunk k+1); raw or framed per the job-wide wire decision.
+        Paired senders must use :meth:`_send_reduce_contrib` — the
+        chunked exchange schedule is part of the wire contract on the
+        shm plane (see there)."""
         rbuf = self._recv_buf(operand, acc.size)
         try:
             def merge(lo, hi):
@@ -1727,7 +1783,10 @@ class ProcessCommSlave(CommSlave):
         if r >= p:  # folded rank: contribute, then wait for the result
             fold = gmap[r - p]
             if raw:
-                self._exchange_raw(fold, fold, arr[lo:hi], None)
+                # chunked to mirror the fold partner's _recv_reduce
+                # schedule (the shm routing contract — see
+                # _send_reduce_contrib)
+                self._chunked_exchange(fold, fold, arr[lo:hi], None)
                 self._exchange_raw_into(fold, fold, None, arr[lo:hi],
                                         operand)
             else:
@@ -1812,7 +1871,8 @@ class ProcessCommSlave(CommSlave):
         itself."""
         self._tree_reduce_walk(
             acc, group[0],
-            lambda peer, a: self._send_segment(peer, a, operand),
+            lambda peer, a: self._send_reduce_contrib(peer, a,
+                                                      operand),
             lambda peer, a: (self._recv_reduce(peer, a, operator,
                                                operand), a)[1],
             group=group)
@@ -2147,7 +2207,9 @@ class ProcessCommSlave(CommSlave):
         while mask < self._n:
             if vr & mask:
                 peer = ((vr - mask) + root) % self._n
-                self._send_segment(peer, acc, operand)
+                # the parent drains via _recv_reduce: chunk-matched
+                # send (the shm routing contract)
+                self._send_reduce_contrib(peer, acc, operand)
                 break
             else:
                 src_vr = vr + mask
@@ -2864,6 +2926,244 @@ class ProcessCommSlave(CommSlave):
         return self.scatter_map(d, operand, root=0)
 
     # ------------------------------------------------------------------
+    # nonblocking collectives (ISSUE 11) — see comm/progress.py
+    #
+    # Each i* method submits to the per-slave helper progression
+    # thread and returns a CollectiveFuture; the blocking twin is
+    # exactly i*(...).wait() in semantics AND bytes (the engine mirrors
+    # the blocking schedules bit-for-bit; ineligible submissions
+    # execute the blocking method itself on the progression thread).
+    # Blocking collectives, barrier() and close() drain outstanding
+    # futures first — comm.wait_all() is the explicit drain.
+    # ------------------------------------------------------------------
+    def _sched(self) -> progress_mod.ProgressScheduler:
+        sched = self._async
+        if sched is None:
+            with self._async_lock:
+                sched = self._async
+                if sched is None:
+                    sched = progress_mod.ProgressScheduler(self)
+                    self._async = sched
+        return sched
+
+    def _iclassify(self, name: str, args: tuple, kwargs: dict) -> str:
+        if name == "allreduce_map":
+            # the multi (count-negotiating) protocol is a JOB-wide
+            # choice: selected purely by the coalescing knob and the
+            # call's operand/operator — never by rank-local queue depth
+            if self._coalesce_usecs > 0 \
+                    and self._map_columnar_ok(args[1], args[2]):
+                return "map"
+            return "inline"
+        if progress_mod.engine_eligible(self, name, args, kwargs):
+            return "engine"
+        return "inline"
+
+    def _isubmit(self, name: str, args: tuple,
+                 kwargs: dict) -> progress_mod.CollectiveFuture:
+        if not self._async_on:
+            # MP4J_ASYNC=0: eager caller-thread execution behind the
+            # same future contract (the A/B + frozen-leg knob);
+            # failures nobody awaits still surface at wait_all — the
+            # drain's re-raise contract must not depend on the knob
+            fut = progress_mod.CollectiveFuture(
+                name, epoch=self._recovery.epoch)
+            try:
+                fut._resolve(getattr(self, name)(*args, **kwargs))
+            except Exception as e:
+                fut._fail(e)
+                self._eager_failed.append(fut)
+            return fut
+        return self._sched().submit(name, args, kwargs,
+                                    self._iclassify(name, args, kwargs))
+
+    def iallreduce(self, arr, operand: Operand = Operands.FLOAT,
+                   operator: Operator = Operators.SUM,
+                   from_: int = 0, to: int | None = None,
+                   algo: str = "auto") -> progress_mod.CollectiveFuture:
+        """Nonblocking :meth:`allreduce_array`; ``.wait()`` returns the
+        in-place reduced array."""
+        return self._isubmit("allreduce_array", (arr, operand, operator),
+                             {"from_": from_, "to": to, "algo": algo})
+
+    def ireduce_scatter(self, arr, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        ranges=None, algo: str = "auto"
+                        ) -> progress_mod.CollectiveFuture:
+        """Nonblocking :meth:`reduce_scatter_array`."""
+        return self._isubmit("reduce_scatter_array",
+                             (arr, operand, operator),
+                             {"ranges": ranges, "algo": algo})
+
+    def iallgather(self, arr, operand: Operand = Operands.FLOAT,
+                   ranges=None, algo: str = "auto"
+                   ) -> progress_mod.CollectiveFuture:
+        """Nonblocking :meth:`allgather_array`."""
+        return self._isubmit("allgather_array", (arr, operand),
+                             {"ranges": ranges, "algo": algo})
+
+    def igather(self, arr, operand: Operand = Operands.FLOAT,
+                root: int = 0, ranges=None
+                ) -> progress_mod.CollectiveFuture:
+        """Nonblocking :meth:`gather_array`."""
+        return self._isubmit("gather_array", (arr, operand),
+                             {"root": root, "ranges": ranges})
+
+    def iallreduce_map(self, d: dict,
+                       operand: Operand = Operands.DOUBLE,
+                       operator: Operator = Operators.SUM
+                       ) -> progress_mod.CollectiveFuture:
+        """Nonblocking :meth:`allreduce_map`. Under
+        ``MP4J_COALESCE_USECS > 0``, submissions arriving within the
+        window fuse into one negotiation + columnar frame train
+        (:meth:`allreduce_map_multi`) and de-fuse on completion."""
+        return self._isubmit("allreduce_map", (d, operand, operator),
+                             {})
+
+    def wait_all(self, timeout: float | None = None) -> None:
+        """The collective-boundary drain: block until every
+        outstanding nonblocking collective resolved; re-raises the
+        first failure among futures nobody awaited (eager-mode
+        failures included — the contract must not depend on
+        ``MP4J_ASYNC``)."""
+        if self._async is not None:
+            self._async.wait_all(timeout)
+        while self._eager_failed:
+            fut = self._eager_failed.pop(0)
+            if not fut._observed:
+                fut._observed = True
+                raise fut._exc
+
+    def outstanding(self) -> int:
+        """How many nonblocking collectives are queued or in flight."""
+        return (0 if self._async is None
+                else self._async._outstanding)
+
+    # -- the fused (coalesced) map collective ---------------------------
+    @staticmethod
+    def _merge_map_headers_multi(a, b):
+        """Header merge for the count-negotiating sync: the classic
+        4-field merge plus the fused-batch count, combined with MIN —
+        the largest batch every rank can serve this round."""
+        return ProcessCommSlave._merge_map_headers(
+            a[:4], b[:4]) + (min(a[4], b[4]),)
+
+    def _map_sync_multi(self, header, root: int):
+        """Count-negotiating variant of :meth:`_map_sync` (ISSUE 11
+        coalescing): the 5-field header ``(ok, kind, vshape, novel,
+        count)`` merges up the tree, the root's decision gains the
+        agreed batch size m = min(counts), and every rank grows its
+        codec with the same canonical novelty. Novelty may cover maps
+        beyond m (a deep coalescer offered more than the round
+        serves): the growth is identical job-wide — harmless, and the
+        next round's novelty exchange is near-empty for it."""
+        header = self._tree_reduce_walk(
+            header, root, self._send,
+            lambda peer, h: self._merge_map_headers_multi(
+                h, self._recv(peer)))
+        decision = None
+        if self._rank == root:
+            decision = self._map_decision(header[:4]) + (header[4],)
+        decision = self._map_bcast_obj(decision, root)
+        if decision[0] == "col":
+            self._grow_map_codec(decision[:-1])
+        return decision
+
+    def allreduce_map_multi(self, dicts: list,
+                            operand: Operand = Operands.DOUBLE,
+                            operator: Operator = Operators.SUM) -> int:
+        """Fused key-union allreduce of SEVERAL maps under ONE
+        vocabulary-sync negotiation (the small-message coalescing
+        engine, ISSUE 11): each rank offers ``len(dicts)`` maps, the
+        sync header negotiates the agreed batch ``m = min`` over every
+        rank's offer, and the first ``m`` maps ship as ``m``
+        back-to-back columnar frame pairs per tree exchange — one
+        negotiation round trip amortized over the whole batch, merged
+        per slot (same acc-first operand order as the classic plane,
+        so each map's result is bit-identical to its own
+        ``allreduce_map``). Returns ``m``; callers re-offer the
+        remainder. In-place on every merged map; maps past ``m`` are
+        untouched."""
+        if not isinstance(dicts, list) or not dicts:
+            raise Mp4jError(
+                "allreduce_map_multi needs a non-empty list of dicts")
+        if self._n == 1:
+            return len(dicts)
+        offered = len(dicts)
+        vals: list = [None] * offered
+        if self._map_columnar_ok(operand, operator):
+            ok, kind, vshape, novel = True, None, None, []
+            for i, d in enumerate(dicts):
+                h, vals[i] = self._map_local_header(d, operand)
+                ok, kind, vshape, novel = self._merge_map_headers(
+                    (ok, kind, vshape, novel), h)
+            header = (ok, kind, vshape, novel, offered)
+        else:
+            # non-columnar operand/operator: negotiate the count all
+            # the same, fuse over the pickled plane
+            header = (False, None, None, [], offered)
+        decision = self._map_sync_multi(header, 0)
+        m = int(decision[-1])
+        if decision[0] == "nop":
+            return m
+        if decision[0] == "col":
+            cdec = decision[:-1]
+            # per-slot encode (books its own serialize time)
+            cols = [self._encode_map_columns(dicts[i], cdec, vals[i],
+                                             operand)
+                    for i in range(m)]
+
+            def send(peer, cs):
+                for c in cs:
+                    self._send_map_columns(peer, c, operand)
+
+            def recv_merge(peer, cs):
+                # recv slot i then merge slot i, in slot order — the
+                # peer sends its m pairs back-to-back in the same order
+                return [self._merge_map_columns(
+                    cs[i], self._recv_map_columns(peer), operator)
+                    for i in range(m)]
+
+            cols = self._tree_reduce_walk(cols, 0, send, recv_merge)
+
+            def recv(peer):
+                return [self._recv_map_columns(peer)
+                        for _ in range(m)]
+
+            cols = self._tree_bcast_walk(cols, 0, send, recv)
+            for i in range(m):
+                merged = self._decode_map_columns(cdec, *cols[i])
+                dicts[i].clear()
+                dicts[i].update(merged)
+            if m > 1:
+                self._comm_stats.add("coalesced_frames", 1)
+            return m
+        # negotiated pickled fallback, still fused: a list-of-dicts
+        # payload per tree exchange, merged per slot (value-level
+        # copies keep the caller's objects out of the user operator —
+        # the _SNAPSHOT_FREE discipline of reduce_map)
+        acc = [{k: _copy_value(v) for k, v in dicts[i].items()}
+               for i in range(m)]
+
+        def send_obj(peer, a):
+            self._send_map_obj(peer, a, operand)
+
+        def recv_merge_obj(peer, a):
+            r = self._recv(peer)
+            for i in range(m):
+                self._merge_maps(operator, a[i], r[i])
+            return a
+
+        acc = self._tree_reduce_walk(acc, 0, send_obj, recv_merge_obj)
+        acc = self._tree_bcast_walk(acc, 0, send_obj, self._recv)
+        for i in range(m):
+            dicts[i].clear()
+            dicts[i].update(acc[i])
+        if m > 1:
+            self._comm_stats.add("coalesced_frames", 1)
+        return m
+
+    # ------------------------------------------------------------------
     def _check_root(self, root: int):
         if not (0 <= root < self._n):
             raise Mp4jError(f"root {root} out of range [0, {self._n})")
@@ -2899,6 +3199,10 @@ _SNAPSHOT_FREE = frozenset({
     "broadcast_array", "gather_array", "scatter_array",
     "allgather_array", "reduce_array", "reduce_map", "broadcast_map",
     "scatter_map",
+    # the fused map batch (ISSUE 11): merges run in internal column/
+    # value copies; the caller's dicts mutate only after the last wire
+    # operation of the walk — the broadcast_map reasoning, per slot
+    "allreduce_map_multi",
 })
 
 # Root-only mutators: every non-root rank only SENDS (both planes of
@@ -2947,7 +3251,10 @@ def _preserve_payload(self, x):
 def _restore_payload(x, saved) -> None:
     """Put the snapshot back before a retry. Mutable container values
     are re-copied on EVERY restore so ``saved`` stays pristine — a
-    second recovery round must not see the first retry's mutations."""
+    second recovery round must not see the first retry's mutations.
+    Dict elements of a list payload (the fused map batch, ISSUE 11)
+    restore IN PLACE: the caller (the scheduler's futures) holds
+    references to those exact dict objects."""
     if saved is None:
         return
     if isinstance(x, np.ndarray):
@@ -2956,7 +3263,11 @@ def _restore_payload(x, saved) -> None:
         x.clear()
         x.update((k, _copy_value(v)) for k, v in saved.items())
     elif isinstance(x, list):
-        x[:] = [_copy_value(v) for v in saved]
+        for i, v in enumerate(saved):
+            if isinstance(v, dict) and isinstance(x[i], dict):
+                _restore_payload(x[i], v)
+            else:
+                x[i] = _copy_value(v)
 
 
 def _recovered(fn, snapshot: bool):
@@ -3024,6 +3335,14 @@ def _recovered(fn, snapshot: bool):
         rec = getattr(self, "_recovery", None)
         if rec is None:
             return fn(self, *args, **kwargs)
+        # collective-boundary drain (ISSUE 11): a blocking collective
+        # entered while nonblocking futures are outstanding waits them
+        # out first, so the job-wide collective order stays the submit
+        # order (no-op on the progression thread itself — inline
+        # execution runs the blocking methods there)
+        sched = getattr(self, "_async", None)
+        if sched is not None:
+            sched.drain_for_blocking()
         outermost = rec.enter()
         try:
             if not outermost:
@@ -3055,7 +3374,8 @@ def _recovered(fn, snapshot: bool):
                             else kwargs.get("root", rdefault))
                     if root != self._rank:
                         payload = None   # see _SNAPSHOT_ROOT_ONLY
-            is_map = fn.__name__.endswith("_map")
+            is_map = (fn.__name__.endswith("_map")
+                      or fn.__name__ == "allreduce_map_multi")
             saved_box = []
 
             def preserve():

@@ -30,6 +30,7 @@ import numpy as np
 
 from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm.context import CommSlave
+from ytk_mp4j_tpu.comm import progress as progress_mod
 from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
@@ -701,6 +702,57 @@ class ThreadCommSlave(CommSlave):
         only receives its threads' share)."""
         self.reduce_map(d, operand, operator, root=0)
         return self.scatter_map(d, operand, root=0)
+
+    # ------------------------------------------------------------------
+    # nonblocking collectives (ISSUE 11): the thread backend's
+    # collectives are shared-memory synchronous — every thread of the
+    # group must enter the same call before any can leave — so the i*
+    # twins execute eagerly and return resolved futures; the futures-
+    # conformance contract (i*().wait() == blocking, bit-for-bit)
+    # holds trivially, and portable code keeps one API across backends.
+    # ------------------------------------------------------------------
+    def iallreduce(self, arr, operand: Operand = Operands.FLOAT,
+                   operator: Operator = Operators.SUM,
+                   from_: int = 0, to: int | None = None,
+                   algo: str = "auto"):
+        """Eager nonblocking :meth:`allreduce_array` (resolved
+        future)."""
+        return progress_mod.eager_future(
+            self, "allreduce_array", arr, operand, operator,
+            from_=from_, to=to, algo=algo)
+
+    def ireduce_scatter(self, arr, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        ranges=None, algo: str = "auto"):
+        """Eager nonblocking :meth:`reduce_scatter_array`."""
+        return progress_mod.eager_future(
+            self, "reduce_scatter_array", arr, operand, operator,
+            ranges=ranges, algo=algo)
+
+    def iallgather(self, arr, operand: Operand = Operands.FLOAT,
+                   ranges=None, algo: str = "auto"):
+        """Eager nonblocking :meth:`allgather_array`."""
+        return progress_mod.eager_future(
+            self, "allgather_array", arr, operand, ranges=ranges,
+            algo=algo)
+
+    def igather(self, arr, operand: Operand = Operands.FLOAT,
+                root: int = 0, ranges=None):
+        """Eager nonblocking :meth:`gather_array`."""
+        return progress_mod.eager_future(
+            self, "gather_array", arr, operand, root=root,
+            ranges=ranges)
+
+    def iallreduce_map(self, d: dict,
+                       operand: Operand = Operands.DOUBLE,
+                       operator: Operator = Operators.SUM):
+        """Eager nonblocking :meth:`allreduce_map`."""
+        return progress_mod.eager_future(
+            self, "allreduce_map", d, operand, operator)
+
+    def wait_all(self, timeout: float | None = None) -> None:
+        """Collective-boundary drain; the eager backend never has
+        outstanding work — no-op, kept for portable code."""
 
 
 # per-collective tracing (utils.trace; zero overhead when disabled)

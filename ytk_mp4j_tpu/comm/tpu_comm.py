@@ -39,6 +39,7 @@ from ytk_mp4j_tpu.utils.compat import shard_map
 
 from ytk_mp4j_tpu import meta
 from ytk_mp4j_tpu.comm import keycodec
+from ytk_mp4j_tpu.comm import progress as progress_mod
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.operands import Operand, Operands
 from ytk_mp4j_tpu.operators import Operator, Operators
@@ -791,6 +792,63 @@ class TpuCommCluster:
         keys. Compiled programs are kept (they are keyed on shapes, not
         vocabularies)."""
         self._codecs.clear()
+
+    # ------------------------------------------------------------------
+    # nonblocking collectives (ISSUE 11): the device path is a single-
+    # controller SPMD driver whose dispatches are ALREADY asynchronous
+    # under JAX's lazy execution — the dense i* twins execute eagerly
+    # (the launch returns before the device finishes; materialization
+    # blocks, exactly as for the blocking API) and return resolved
+    # futures, while iallreduce_map rides the existing chained-
+    # dispatch machinery (PendingMap) behind a lazily-resolving future
+    # so k chained maps pay ~one device round trip, not k.
+    # ------------------------------------------------------------------
+    def iallreduce(self, arrs, operand: Operand = Operands.FLOAT,
+                   operator: Operator = Operators.SUM,
+                   from_: int = 0, to: int | None = None,
+                   algo: str = "auto"):
+        """Eager nonblocking :meth:`allreduce_array` (resolved
+        future)."""
+        return progress_mod.eager_future(
+            self, "allreduce_array", arrs, operand, operator,
+            from_=from_, to=to, algo=algo)
+
+    def ireduce_scatter(self, arrs, operand: Operand = Operands.FLOAT,
+                        operator: Operator = Operators.SUM,
+                        ranges=None):
+        """Eager nonblocking :meth:`reduce_scatter_array`."""
+        return progress_mod.eager_future(
+            self, "reduce_scatter_array", arrs, operand, operator,
+            ranges=ranges)
+
+    def iallgather(self, arrs, operand: Operand = Operands.FLOAT,
+                   ranges=None):
+        """Eager nonblocking :meth:`allgather_array`."""
+        return progress_mod.eager_future(
+            self, "allgather_array", arrs, operand, ranges=ranges)
+
+    def igather(self, arrs, operand: Operand = Operands.FLOAT,
+                root: int = 0, ranges=None):
+        """Eager nonblocking :meth:`gather_array`."""
+        return progress_mod.eager_future(
+            self, "gather_array", arrs, operand, root=root,
+            ranges=ranges)
+
+    def iallreduce_map(self, maps, operand: Operand = Operands.DOUBLE,
+                       operator: Operator = Operators.SUM):
+        """Nonblocking :meth:`allreduce_map` riding
+        :meth:`allreduce_map_async`: the device collective and the
+        d2h copy are in flight when this returns; ``wait()`` performs
+        the single blocking fetch + decode (identical post-state to
+        the blocking twin)."""
+        pending = self.allreduce_map_async(maps, operand, operator)
+        return progress_mod.DeferredFuture("allreduce_map",
+                                           pending.result)
+
+    def wait_all(self, timeout: float | None = None) -> None:
+        """Collective-boundary drain: the dense device path is eager
+        and ``iallreduce_map`` futures resolve at ``wait()`` — no
+        scheduler state to drain; kept for portable code."""
 
     # ------------------------------------------------------------------
     def barrier(self):

@@ -167,4 +167,254 @@ int mp4j_sendrecv_raw(int send_fd, int recv_fd, const void* sbuf,
 // One-directional steps (fold/unfold) call mp4j_sendrecv_raw with a
 // null buffer on the inactive side; no separate entry points needed.
 
+// ---------------------------------------------------------------------
+// Multi-leg progress driver (ISSUE 11): the nonblocking-collective
+// scheduler's byte mover. The Python engine hands down the set of
+// RUNNABLE legs — the head of each per-(peer, direction) FIFO queue,
+// so at most one send and one recv leg per fd — and this drives them
+// all through ONE poll loop, moving bytes on whichever fd is ready.
+// Cross-collective burst coalescing falls out: when collective k's
+// send leg to a peer completes, k+1's leg enters the set on the next
+// call and its bytes stream back-to-back into the same socket buffer,
+// so the peer drains large bursts instead of ping-ponging per
+// exchange — the mechanism that makes k outstanding collectives
+// cheaper per byte than k sequential ones on a CPU-bound host.
+//
+// Contract: sockets are ALREADY nonblocking (the Python engine owns
+// the mode for the batch). dones[i] is in-out progress. Returns the
+// number of legs that newly completed (>= 1), 0 when timeout_ms
+// elapsed without a completion (the Python side's fence-poll tick),
+// or a negative error (-1 syscall, -2 peer closed) with status[i] set
+// on the failing leg.
+// ---------------------------------------------------------------------
+int mp4j_progress_multi(const int32_t* fds, const int32_t* dirs,
+                        void** bufs, const int64_t* lens,
+                        int64_t* dones, int8_t* status, int32_t nlegs,
+                        int64_t timeout_ms) {
+  const int64_t deadline = now_ms() + (timeout_ms < 0 ? 0 : timeout_ms);
+  for (int i = 0; i < nlegs; ++i) status[i] = 0;
+  constexpr int kMaxFds = 256;  // the Python side slices leg sets to
+                                // this bound per pass (FIFO-fair), so
+                                // the cap is never an error in practice
+  while (true) {
+    // poll set: unique fds of incomplete legs, events OR-combined
+    pollfd pfds[kMaxFds];
+    int leg_of_pfd_send[kMaxFds];
+    int leg_of_pfd_recv[kMaxFds];
+    int npfd = 0;
+    int pending = 0;
+    for (int i = 0; i < nlegs; ++i) {
+      if (dones[i] >= lens[i]) continue;
+      ++pending;
+      int slot = -1;
+      for (int j = 0; j < npfd; ++j) {
+        if (pfds[j].fd == fds[i]) {
+          slot = j;
+          break;
+        }
+      }
+      if (slot < 0) {
+        if (npfd >= kMaxFds) {
+          status[i] = -1;  // name the overflowing leg for diagnostics
+          return -1;
+        }
+        slot = npfd++;
+        pfds[slot].fd = fds[i];
+        pfds[slot].events = 0;
+        leg_of_pfd_send[slot] = -1;
+        leg_of_pfd_recv[slot] = -1;
+      }
+      if (dirs[i] == 0) {
+        pfds[slot].events = static_cast<short>(pfds[slot].events | POLLOUT);
+        leg_of_pfd_send[slot] = i;
+      } else {
+        pfds[slot].events = static_cast<short>(pfds[slot].events | POLLIN);
+        leg_of_pfd_recv[slot] = i;
+      }
+    }
+    if (pending == 0) return 0;
+    int64_t left = deadline - now_ms();
+    if (left < 0) left = 0;
+    int pr = poll(pfds, static_cast<nfds_t>(npfd),
+                  left > 1000000000 ? 1000000000 : static_cast<int>(left));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pr == 0) return 0;  // tick: the Python side polls the fence
+    int completed = 0;
+    for (int j = 0; j < npfd; ++j) {
+      short rev = pfds[j].revents;
+      if (rev == 0) continue;
+      int ri = leg_of_pfd_recv[j];
+      if (ri >= 0 && (rev & (POLLIN | POLLHUP | POLLERR)) &&
+          dones[ri] < lens[ri]) {
+        int rc = try_recv(pfds[j].fd, static_cast<char*>(bufs[ri]),
+                          lens[ri], &dones[ri]);
+        if (rc < 0) {
+          status[ri] = static_cast<int8_t>(rc);
+          return rc;
+        }
+        if (dones[ri] >= lens[ri]) ++completed;
+      }
+      int si = leg_of_pfd_send[j];
+      if (si >= 0 && (rev & POLLOUT) && dones[si] < lens[si]) {
+        int rc = try_send(pfds[j].fd, static_cast<const char*>(bufs[si]),
+                          lens[si], &dones[si]);
+        if (rc < 0) {
+          status[si] = static_cast<int8_t>(rc);
+          return rc;
+        }
+        if (dones[si] >= lens[si]) ++completed;
+      }
+      if ((rev & (POLLERR | POLLNVAL)) && !(rev & POLLIN)) {
+        int bad = si >= 0 ? si : ri;
+        if (bad >= 0) status[bad] = -1;
+        return -1;
+      }
+      if ((rev & POLLHUP) && !(rev & POLLIN) && ri >= 0 &&
+          dones[ri] < lens[ri]) {
+        status[ri] = -2;
+        return -2;
+      }
+    }
+    if (completed > 0) return completed;
+  }
+}
+
+// ---------------------------------------------------------------------
+// Batch leg-graph driver (ISSUE 11): runs a WHOLE engine batch — every
+// leg of every outstanding collective, with its dependency gates and
+// reduce-merges — inside one native call, so the Python scheduler pays
+// one call per batch instead of one per leg completion. Gates encode
+// both orderings the engine needs: the per-(peer, direction) FIFO (a
+// leg's queue predecessor) and the per-collective op sequence (the
+// previous op's legs); a leg joins the poll set only once every gate
+// leg has completed. A completed recv leg with a merge spec reduces
+// natively (mp4j_reduce) before its dependents unblock.
+//
+// Returns: 1 = every leg complete; 0 = timeout tick (caller polls the
+// epoch fence and re-enters); 2 = wake_fd readable (new submissions to
+// admit — the byte(s) are drained here); negative = error with
+// status[i] set on the failing leg. dones[] is in-out, so the call is
+// re-entrant across ticks/wakes.
+// ---------------------------------------------------------------------
+extern "C" int mp4j_reduce(int32_t dtype, int32_t op, void* acc,
+                           const void* src, int64_t n);
+
+extern "C" int mp4j_run_legs(const int32_t* fds, const int32_t* dirs,
+                             void** bufs, const int64_t* lens,
+                             int64_t* dones, const int32_t* gates,
+                             void** mdst, void** msrc,
+                             const int32_t* mdtype,
+                             const int32_t* mopcode,
+                             const int64_t* mcount, int8_t* merged,
+                             int8_t* status, int32_t nlegs,
+                             int32_t wake_fd, int64_t timeout_ms) {
+  const int64_t deadline = now_ms() + (timeout_ms < 0 ? 0 : timeout_ms);
+  constexpr int kMax = 256;
+  if (nlegs > kMax) return -1;
+  while (true) {
+    pollfd pfds[kMax + 1];
+    int leg_send[kMax];
+    int leg_recv[kMax];
+    int npfd = 0;
+    int pending = 0;
+    for (int i = 0; i < nlegs; ++i) {
+      if (dones[i] >= lens[i]) continue;
+      ++pending;
+      bool gated = false;
+      for (int g = 0; g < 3; ++g) {
+        int32_t pre = gates[i * 3 + g];
+        if (pre >= 0 && dones[pre] < lens[pre]) {
+          gated = true;
+          break;
+        }
+      }
+      if (gated) continue;
+      int slot = -1;
+      for (int j = 0; j < npfd; ++j) {
+        if (pfds[j].fd == fds[i]) {
+          slot = j;
+          break;
+        }
+      }
+      if (slot < 0) {
+        slot = npfd++;
+        pfds[slot].fd = fds[i];
+        pfds[slot].events = 0;
+        leg_send[slot] = -1;
+        leg_recv[slot] = -1;
+      }
+      if (dirs[i] == 0) {
+        pfds[slot].events = static_cast<short>(pfds[slot].events | POLLOUT);
+        leg_send[slot] = i;
+      } else {
+        pfds[slot].events = static_cast<short>(pfds[slot].events | POLLIN);
+        leg_recv[slot] = i;
+      }
+    }
+    if (pending == 0) return 1;
+    if (wake_fd >= 0) {
+      pfds[npfd].fd = wake_fd;
+      pfds[npfd].events = POLLIN;
+      ++npfd;
+    }
+    int64_t left = deadline - now_ms();
+    if (left < 0) left = 0;
+    int pr = poll(pfds, static_cast<nfds_t>(npfd),
+                  left > 1000000000 ? 1000000000 : static_cast<int>(left));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pr == 0) return 0;  // tick: the caller polls the fence
+    const int last = wake_fd >= 0 ? npfd - 1 : npfd;
+    if (wake_fd >= 0 && (pfds[npfd - 1].revents & POLLIN)) {
+      char sink[64];
+      while (read(wake_fd, sink, sizeof(sink)) > 0) {
+      }
+      return 2;  // new submissions to admit
+    }
+    for (int j = 0; j < last; ++j) {
+      short rev = pfds[j].revents;
+      if (rev == 0) continue;
+      int ri = leg_recv[j];
+      if (ri >= 0 && (rev & (POLLIN | POLLHUP | POLLERR)) &&
+          dones[ri] < lens[ri]) {
+        int rc = try_recv(pfds[j].fd, static_cast<char*>(bufs[ri]),
+                          lens[ri], &dones[ri]);
+        if (rc < 0) {
+          status[ri] = static_cast<int8_t>(rc);
+          return rc;
+        }
+        if (dones[ri] >= lens[ri] && mdst[ri] != nullptr && !merged[ri]) {
+          merged[ri] = 1;
+          mp4j_reduce(mdtype[ri], mopcode[ri], mdst[ri], msrc[ri],
+                      mcount[ri]);
+        }
+      }
+      int si = leg_send[j];
+      if (si >= 0 && (rev & POLLOUT) && dones[si] < lens[si]) {
+        int rc = try_send(pfds[j].fd, static_cast<const char*>(bufs[si]),
+                          lens[si], &dones[si]);
+        if (rc < 0) {
+          status[si] = static_cast<int8_t>(rc);
+          return rc;
+        }
+      }
+      if ((rev & (POLLERR | POLLNVAL)) && !(rev & POLLIN)) {
+        int bad = si >= 0 ? si : ri;
+        if (bad >= 0) status[bad] = -1;
+        return -1;
+      }
+      if ((rev & POLLHUP) && !(rev & POLLIN) && ri >= 0 &&
+          dones[ri] < lens[ri]) {
+        status[ri] = -2;
+        return -2;
+      }
+    }
+  }
+}
+
 }  // extern "C"

@@ -348,6 +348,19 @@ class AuditRing:
                 # mp4j-lint: disable=R13 (length read, not a byte serialization)
                 ent[1] += memoryview(b).nbytes
 
+    def put_wire(self, folds: dict) -> None:
+        """Install precomputed per-collective wire folds for the
+        record about to :meth:`commit` (ISSUE 11): the nonblocking
+        engine interleaves several collectives on the wire, so it
+        folds each collective's legs into its OWN accumulator —
+        ``{(peer, direction): [crc, nbytes, transport]}`` — and
+        installs them here one record at a time, keeping the
+        cross-rank pairwise wire comparison exact whatever the local
+        interleaving was."""
+        with self._lock:
+            self._wire.clear()
+            self._wire.update({k: list(v) for k, v in folds.items()})
+
     def reset_wire(self) -> None:
         """Drop the in-flight attempt's wire folds — called from the
         recovery restore path: a retried collective's failed attempt

@@ -44,6 +44,16 @@ THRESHOLDS: dict[str, float] = {
     # ISSUE 9: the durable sink armed on the headline leg — gated so
     # the background-drain tax cannot silently creep; same noise floor
     "socket_collective_gbs_sink_on": 0.25,
+    # ISSUE 11 (mp4j-async): k outstanding iallreduces on the
+    # scheduler (overlap leg) and the tiny-map coalescing figure —
+    # gated so neither the scheduler's dense cost nor the fused map
+    # plane regresses silently; same loopback noise floor as the
+    # other socket figures. The frozen legs pin async off, so every
+    # historical figure stays comparable.
+    "socket_async_overlap_gbs": 0.25,
+    "socket_async_sequential_gbs": 0.25,
+    "socket_coalesce_keys_per_sec": 0.25,
+    "socket_coalesce_off_keys_per_sec": 0.25,
     "socket_framed_collective_gbs": 0.20,
     "socket_collective_in_workload_gbs": 0.25,
     "ffm_sparse_steps_per_sec": 0.10,

@@ -433,6 +433,26 @@ def to_prometheus(doc: dict) -> str:
         out.append("# TYPE mp4j_sink_lag_seconds gauge")
         out.extend(lag_block)
 
+    # nonblocking-collective gauge (ISSUE 11): how many collectives
+    # each rank's scheduler currently holds outstanding, plus a
+    # cluster sum; present only for ranks that went async (no
+    # zero-noise for fully blocking jobs)
+    out_block = []
+    total_out = 0.0
+    for r in whos:
+        g = doc["ranks"][r].get("gauges", {}).get("async/outstanding")
+        if g is not None:
+            total_out += float(g)
+            out_block.append(
+                f'mp4j_outstanding_collectives{{rank="{_esc(r)}"}} '
+                f"{_fmt(float(g))}")
+    if out_block:
+        out_block.append(
+            f'mp4j_outstanding_collectives{{rank="cluster"}} '
+            f"{_fmt(total_out)}")
+        out.append("# TYPE mp4j_outstanding_collectives gauge")
+        out.extend(out_block)
+
     out.append("# TYPE mp4j_collective_latency_seconds histogram")
     hists = doc.get("cluster", {}).get("histograms", {})
     for name in sorted(hists):

@@ -154,7 +154,7 @@ def format_live(doc: dict) -> str:
                   for info in ranks.values())
     lines = [head,
              f"{'rank':>4}  {'seq':>5}  {'lag':>4}  {'ep':>3}  "
-             f"{'state':<34}  {'MB/s':>8}  {'shm%':>5}  "
+             f"{'state':<34}  {'MB/s':>8}  {'shm%':>5}  {'ovl%':>5}  "
              f"{'aud':>5}  {'sink':>7}  {'retries':>7}  "
              f"{'roster':<14}  hb age"]
     for r in sorted(ranks, key=int):
@@ -180,6 +180,14 @@ def format_live(doc: dict) -> str:
         tagged = shm_b + sum(e.get("wire_bytes_tcp", 0)
                              for e in info.get("stats", {}).values())
         shm_pct = f"{100.0 * shm_b / tagged:.0f}" if tagged else "-"
+        # overlap column (ISSUE 11): of the wall time this rank had
+        # nonblocking collectives in flight, the fraction where >= 2
+        # overlapped — the scheduler's ovl% headline; "-" until the
+        # rank submits any i* work
+        asy = info.get("stats", {}).get("<async>", {})
+        inflight = asy.get("async_inflight", 0.0)
+        ovl_pct = (f"{100.0 * asy.get('async_overlap', 0.0) / inflight:.0f}"
+                   if inflight else "-")
         # audit column (ISSUE 8): the rank's last audited collective
         # ordinal; "-" until the rank ships audit records
         aud = info.get("audit_seq", 0)
@@ -206,6 +214,7 @@ def format_live(doc: dict) -> str:
             f"{state:<34.34}  "
             f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:>8.2f}  "
             f"{shm_pct:>5}  "
+            f"{ovl_pct:>5}  "
             f"{aud if aud else '-':>5}  "
             f"{sink_col:>7}  "
             f"{retries:>7}  "

@@ -235,6 +235,45 @@ class FaultInjector:
                 f"fault injection: rank {self._rank} killed at "
                 f"collective {ordinal}")
 
+    def on_collective_window(self, ordinal: int, kill_cb=None) -> None:
+        """Concurrent-batch variant of :meth:`on_collective`
+        (ISSUE 11): the nonblocking scheduler admits SEVERAL ordinals
+        back-to-back before any of their I/O moves, so arming ordinal
+        k+1 must not disarm ordinal k's still-unfired directives (the
+        per-ordinal prune assumes sequential collectives). Arms
+        ``nth == ordinal`` directives and executes kills; stale armed
+        directives are pruned at the next batch boundary
+        (:meth:`prune_below`)."""
+        kill: Fault | None = None
+        with self._lock:
+            still: list[Fault] = []
+            for f in self._pending:
+                if f.nth == ordinal or (f.action == "slow"
+                                        and f.nth <= ordinal):
+                    if f.action == "kill":
+                        kill = f
+                    else:
+                        self._armed.append(f)
+                else:
+                    still.append(f)
+            self._pending = still
+        if kill is not None:
+            if kill_cb is not None:
+                kill_cb(kill)
+            raise FaultKill(
+                f"fault injection: rank {self._rank} killed at "
+                f"collective {ordinal}")
+
+    def prune_below(self, ordinal: int) -> None:
+        """Disarm one-shot directives armed for ordinals before
+        ``ordinal`` — the batch-boundary half of
+        :meth:`on_collective_window`: those collectives completed
+        without matching I/O, so their directives must not leak into a
+        later batch the plan never targeted."""
+        with self._lock:
+            self._armed = [f for f in self._armed
+                           if f.action == "slow" or f.nth >= ordinal]
+
     def take_corrupt(self, channel, nbytes: int):
         """Pop one armed ``corrupt`` directive for this channel's peer
         if ``nbytes`` clears :data:`CORRUPT_MIN`; returns the

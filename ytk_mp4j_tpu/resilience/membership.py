@@ -122,11 +122,24 @@ def export_vocab(codecs: dict, pin: dict | None) -> dict[str, list]:
     ``preserve``) truncates the export to the state every survivor's
     retry rolls back to — a failed map attempt may have tentatively
     grown the donor's codec, and shipping that growth would hand the
-    joiner codes the retry's sync round is about to reassign."""
+    joiner codes the retry's sync round is about to reassign.
+
+    A kind ABSENT from a non-None pin did not exist at attempt entry
+    (the codec was created by the in-flight attempt — the job's FIRST
+    map of that kind, killed mid-sync): every survivor's retry
+    truncates it to 0 (``sizes.get(kind, 0)`` in the wrapper's
+    restore), so the export must ship it EMPTY too. Shipping the
+    tentative growth instead hands the joiner a code table no survivor
+    holds — its unique keys are silently absent from the retry's
+    novelty round (already encoded locally, so never offered), and the
+    job's code->key tables diverge permanently: the mid-map-sync
+    replay gap of ISSUE 10's follow-up, closed in ISSUE 11."""
     out: dict[str, list] = {}
     for kind, codec in codecs.items():
-        size = codec.size if pin is None else pin.get(kind, codec.size)
-        out[kind] = codec.export(size)
+        size = codec.size if pin is None else pin.get(kind, 0)
+        keys = codec.export(size)
+        if keys:
+            out[kind] = keys
     return out
 
 

@@ -77,7 +77,7 @@ def _load():
             # tree) would lack it, and missing symbols must mean
             # "native unavailable", never an AttributeError crash in
             # every consumer
-            lib.mp4j_parse_libsvm
+            lib.mp4j_progress_multi
         except (OSError, subprocess.CalledProcessError,
                 AttributeError):
             HAVE_NATIVE = False
@@ -92,6 +92,21 @@ def _load():
             ctypes.c_int, ctypes.c_int,
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.mp4j_progress_multi.restype = ctypes.c_int
+        lib.mp4j_progress_multi.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int64,
+        ]
+        lib.mp4j_run_legs.restype = ctypes.c_int
+        lib.mp4j_run_legs.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int64,
         ]
         lib.mp4j_parse_libsvm.restype = ctypes.c_int64
@@ -184,6 +199,92 @@ def sendrecv_raw(send_fd: int, recv_fd: int, sarr: np.ndarray | None,
     if rc != 0:
         raise Mp4jError(_RAW_ERRORS.get(rc, f"raw exchange failed ({rc})"))
     return True
+
+
+def have_progress_multi() -> bool:
+    """Whether the native multi-leg progress driver is available (the
+    nonblocking scheduler falls back to its pure-Python pumps when
+    not)."""
+    return _load() is not None
+
+
+def progress_multi(fds: np.ndarray, dirs: np.ndarray, bufs,
+                   lens: np.ndarray, dones: np.ndarray,
+                   status: np.ndarray, timeout: float) -> int:
+    """Drive a set of runnable legs through ONE native poll loop
+    (ISSUE 11; see ``csrc/mp4j_transport.cpp``).
+
+    ``fds``/``dirs`` int32 arrays (dir 0=send, 1=recv), ``bufs`` a
+    ``(ctypes.c_void_p * n)`` array of buffer pointers, ``lens`` int64,
+    ``dones`` int64 IN-OUT progress, ``status`` int8 OUT. Sockets must
+    already be nonblocking (the scheduler owns the mode for the
+    batch). Returns the number of legs that newly completed, or 0 on a
+    timeout tick (the caller polls the epoch fence and re-enters);
+    raises on wire failure, naming the failing leg index."""
+    lib = _load()
+    n = int(fds.size)
+    rc = lib.mp4j_progress_multi(
+        ctypes.c_void_p(fds.ctypes.data),
+        ctypes.c_void_p(dirs.ctypes.data),
+        ctypes.cast(bufs, ctypes.c_void_p),
+        ctypes.c_void_p(lens.ctypes.data),
+        ctypes.c_void_p(dones.ctypes.data),
+        ctypes.c_void_p(status.ctypes.data),
+        n, max(1, int(timeout * 1000)))
+    if rc < 0:
+        bad = int(np.flatnonzero(status != 0)[0]) \
+            if np.any(status != 0) else -1
+        raise Mp4jError(
+            f"{_RAW_ERRORS.get(rc, f'progress failed ({rc})')} "
+            f"(leg {bad})")
+    return rc
+
+
+def run_legs(fds, dirs, bufs, lens, dones, gates, mdst, msrc, mdtype,
+             mopcode, mcount, merged, status, wake_fd: int,
+             timeout: float) -> int:
+    """Drive a whole engine batch's leg graph natively (ISSUE 11; see
+    ``csrc/mp4j_transport.cpp mp4j_run_legs``). Returns 1 (all legs
+    complete), 0 (timeout tick — poll the fence and re-enter) or 2
+    (``wake_fd`` readable — new submissions to admit); raises on wire
+    failure. ``dones``/``merged`` are in-out, so the call is
+    re-entrant."""
+    lib = _load()
+    rc = lib.mp4j_run_legs(
+        ctypes.c_void_p(fds.ctypes.data),
+        ctypes.c_void_p(dirs.ctypes.data),
+        ctypes.cast(bufs, ctypes.c_void_p),
+        ctypes.c_void_p(lens.ctypes.data),
+        ctypes.c_void_p(dones.ctypes.data),
+        ctypes.c_void_p(gates.ctypes.data),
+        ctypes.cast(mdst, ctypes.c_void_p),
+        ctypes.cast(msrc, ctypes.c_void_p),
+        ctypes.c_void_p(mdtype.ctypes.data),
+        ctypes.c_void_p(mopcode.ctypes.data),
+        ctypes.c_void_p(mcount.ctypes.data),
+        ctypes.c_void_p(merged.ctypes.data),
+        ctypes.c_void_p(status.ctypes.data),
+        int(fds.size), wake_fd, max(1, int(timeout * 1000)))
+    if rc < 0:
+        bad = int(np.flatnonzero(status != 0)[0]) \
+            if np.any(status != 0) else -1
+        raise Mp4jError(
+            f"{_RAW_ERRORS.get(rc, f'batch progress failed ({rc})')} "
+            f"(leg {bad})")
+    return rc
+
+
+def reduce_opcode(operator, dtype) -> int | None:
+    """The (dtype, operator) native codes for a batch merge spec, or
+    None when this combination has no native kernel (the engine then
+    keeps the per-leg path whose merges run through reduce_into's
+    fallback)."""
+    if _load() is None or operator.native_code is None:
+        return None
+    dt = np.dtype(dtype)
+    if dt not in _DTYPE_CODES:
+        return None
+    return _DTYPE_CODES[dt], operator.native_code
 
 
 def parse_libsvm_chunk(blob: bytes, n_rows: int, max_nnz: int):

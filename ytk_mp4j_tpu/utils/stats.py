@@ -72,10 +72,21 @@ _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
 # replacements_seen/shrinks_seen (ISSUE 10): membership changes this
 # rank lived through — an adoption on the joiner, a renumbering on
 # every shrink survivor.
+# outstanding_peak/coalesced_frames + async_inflight/async_overlap
+# (ISSUE 11): the nonblocking scheduler's counters. outstanding_peak
+# is kept monotone by booking increases only (per-rank value = the
+# true peak; cluster folds sum peaks across ranks); coalesced_frames
+# counts fused map executions that merged >= 2 maps in one frame
+# train; async_inflight/async_overlap are WALL seconds with >= 1 /
+# >= 2 collectives outstanding (suffix-free on purpose — they are
+# wall intervals, not busy phases, and must stay out of the phase
+# span/critpath machinery), the substrate of the ovl% column.
 _COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys",
              "retries", "reconnects", "aborts_seen",
              "replacements_seen", "shrinks_seen",
-             "wire_bytes_tcp", "wire_bytes_shm")
+             "wire_bytes_tcp", "wire_bytes_shm",
+             "outstanding_peak", "coalesced_frames",
+             "async_inflight", "async_overlap")
 
 # transports the wire split books (ISSUE 7); anything else (bare test
 # channels, transport-agnostic callers) keeps the untagged totals only
@@ -199,6 +210,70 @@ class CommStats:
         if shared is not None:
             return shared, self._shared_seq
         return "<untracked>", self._seq
+
+    # -- nonblocking-scheduler attribution (ISSUE 11) ------------------
+    def async_begin(self, name: str) -> int:
+        """Open a scheduler-driven collective scope WITHOUT the
+        thread-local nesting of :meth:`begin` — the progression thread
+        holds several collectives open at once, and per-thread depth
+        tracking would fold them into one. Bumps the sequence number,
+        counts the call, and publishes the shared helper-thread
+        attribution name; pair with :meth:`async_end`."""
+        now = time.perf_counter()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._current = name
+            self._current_since = now
+            self._last_phase = None
+            self._bucket_locked(name)["calls"] += 1
+            self._shared_name = name
+            self._shared_seq = seq
+            self._shared_depth += 1
+        return seq
+
+    def async_end(self, name: str, seconds: float) -> None:
+        """Close an :meth:`async_begin` scope, feeding the per-family
+        latency histogram with the collective's submit-to-complete
+        wall time."""
+        with self._lock:
+            self._last = name
+            self._shared_depth -= 1
+            if self._shared_depth <= 0:
+                self._shared_depth = 0
+                self._shared_name = None
+                self._current = None
+        self.metrics.observe(
+            f"latency/{name}", seconds,
+            metrics_mod.LATENCY_LO, metrics_mod.LATENCY_BUCKETS)
+
+    class _Scope:
+        __slots__ = ("stats", "name", "seq", "prev")
+
+        def __init__(self, stats, name, seq):
+            self.stats = stats
+            self.name = name
+            self.seq = seq
+
+        def __enter__(self):
+            tl = self.stats._tl
+            self.prev = (getattr(tl, "name", None),
+                         getattr(tl, "seq", 0))
+            tl.name = self.name
+            tl.seq = self.seq
+            return self
+
+        def __exit__(self, *exc):
+            tl = self.stats._tl
+            tl.name, tl.seq = self.prev
+            return False
+
+    def scope(self, name: str, seq: int):
+        """Thread-local attribution override (no depth/seq side
+        effects): the nonblocking engine wraps a blocking primitive it
+        executes on a collective's behalf so the primitive's internal
+        bookings land on that collective's bucket."""
+        return self._Scope(self, name, seq)
 
     def seed_seq(self, seq: int) -> None:
         """Seed the collective sequence number of a freshly adopted

@@ -120,6 +120,28 @@ Knobs (all validated where they are consumed; garbage raises
 - ``MP4J_ADOPT_SECS`` — how long the master waits for an adopted
   spare's ack before declaring the spare dead and trying the next one
   (or going terminal when the pool is empty).
+- ``MP4J_ASYNC`` — the nonblocking-collective scheduler (ISSUE 11;
+  ``comm/progress.py``): ``1`` (default) runs ``i*`` submissions on
+  the per-slave helper progression thread (interleaved raw-plane
+  engine + coalescing + inline execution); ``0`` makes every ``i*``
+  call execute EAGERLY on the caller's thread and return an
+  already-resolved future — the bench A/B knob, and the frozen-leg
+  pin (the shm/audit/sink precedent). A LOCAL execution-strategy
+  knob: the wire bytes and their per-channel order are identical
+  either way, so ranks need not agree.
+- ``MP4J_COALESCE_USECS`` — the small-message coalescing window
+  (ISSUE 11): ``iallreduce_map`` submissions arriving within this
+  many microseconds fuse into ONE ``allreduce_map_multi`` negotiation
+  + columnar frame train, de-fused on completion. ``0`` (default)
+  disables fusion (every ``iallreduce_map`` runs the classic
+  single-map plane). JOB-wide like ``native_transport``: whether a
+  map collective call uses the count-negotiating multi protocol or
+  the classic one must match on every rank (the negotiated batch
+  size then absorbs ragged coalescing depth).
+- ``MP4J_MAX_OUTSTANDING`` — how many nonblocking collectives may be
+  queued + in flight per slave before ``i*`` submission blocks
+  (backpressure); also caps the engine batch and the coalescing
+  fuse depth.
 """
 
 from __future__ import annotations
@@ -546,6 +568,45 @@ def adopt_secs(override=None) -> float:
     if not val > 0:
         raise Mp4jError(f"adopt_secs={override} must be > 0")
     return val
+
+
+# Nonblocking-collective defaults (ISSUE 11): the scheduler is ON by
+# default (a job that never calls i* pays nothing — the progression
+# thread starts lazily); coalescing is opt-in (it changes the map wire
+# protocol job-wide, so the default must be the classic plane); the
+# outstanding cap bounds snapshot memory (each outstanding collective
+# may hold one payload-sized retry snapshot).
+DEFAULT_MAX_OUTSTANDING = 64
+
+
+def async_enabled() -> bool:
+    """Whether ``i*`` submissions run on the helper progression thread
+    (``MP4J_ASYNC``); ``0`` = eager caller-thread execution returning
+    resolved futures (the bench A/B knob). Local execution strategy —
+    wire-identical either way."""
+    raw = os.environ.get("MP4J_ASYNC")
+    if raw is None or raw.strip() == "":
+        return True
+    val = raw.strip()
+    if val not in ("0", "1"):
+        raise Mp4jError(f"MP4J_ASYNC={raw!r} must be 0 or 1")
+    return val == "1"
+
+
+def coalesce_usecs() -> int:
+    """The small-message coalescing window in MICROseconds
+    (``MP4J_COALESCE_USECS``); 0 disables fusion. JOB-wide: selects
+    between the classic and the count-negotiating multi map protocol,
+    so every rank must agree."""
+    return env_int("MP4J_COALESCE_USECS", 0, minimum=0)
+
+
+def max_outstanding() -> int:
+    """Outstanding-collective cap per slave (``MP4J_MAX_OUTSTANDING``);
+    submission blocks past it. Must be >= 1 — disabling async is
+    ``MP4J_ASYNC=0``, not a zero window."""
+    return env_int("MP4J_MAX_OUTSTANDING", DEFAULT_MAX_OUTSTANDING,
+                   minimum=1)
 
 
 def fault_plan_spec() -> str:
