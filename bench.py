@@ -132,7 +132,7 @@ def _numpy_histograms(bins, g, h, node_ids, n_nodes, f, b):
 
 
 def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
-                    **slave_kwargs):
+                    master_kwargs=None, **slave_kwargs):
     """Master + ``procs`` slave worker PROCESSES; ``body(slave, rank)``
     returns a per-rank result. Returns ``(results, stats)`` where
     ``stats`` is the merged cross-rank ``comm.stats()`` snapshot of the
@@ -157,14 +157,17 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
     from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
 
     ctx = mp.get_context("fork")
-    # frozen legs pin MP4J_ELASTIC=off and the nonblocking scheduler
-    # off (the shm/audit/sink precedent): historical figures stay
-    # comparable whatever the caller's env says; the async legs opt
-    # back in explicitly
-    master = Master(procs, timeout=60.0, elastic="off").serve_in_thread()
+    # frozen legs pin MP4J_ELASTIC=off, the nonblocking scheduler off
+    # and the health plane off (the shm/audit/sink precedent):
+    # historical figures stay comparable whatever the caller's env
+    # says; the async/health legs opt back in explicitly
+    mk = {"elastic": "off", "health": False}
+    mk.update(master_kwargs or {})
+    master = Master(procs, timeout=60.0, **mk).serve_in_thread()
     q = ctx.Queue()
     slave_kwargs.setdefault("elastic", "off")
     slave_kwargs.setdefault("async_collectives", False)
+    slave_kwargs.setdefault("health", False)
 
     def worker():
         try:
@@ -298,7 +301,8 @@ def bench_socket(n=200_000, f=28, b=256, depth=6, procs=4,
 
 def bench_socket_collective(f=28, b=256, depth=6, procs=4, reps=3,
                             native_transport=True, shm=False,
-                            algo="auto", audit="off", sink_dir=""):
+                            algo="auto", audit="off", sink_dir="",
+                            health=False):
     """Allreduce rate alone over the tree-level histogram buffer shapes
     (no numpy histogram/split work — used for the native-transport
     extras figure without re-running the whole socket workload).
@@ -347,7 +351,9 @@ def bench_socket_collective(f=28, b=256, depth=6, procs=4, reps=3,
 
     rates, stats = _run_socket_job(procs, body, native_transport,
                                    join_timeout=120.0, shm=shm,
-                                   audit=audit, sink_dir=sink_dir)
+                                   audit=audit, sink_dir=sink_dir,
+                                   health=health,
+                                   master_kwargs={"health": health})
     return min(rates) / 1e9, stats
 
 
@@ -604,10 +610,14 @@ def _run_elastic_job(procs, body, fault_plan, elastic, spare_body=None,
     from ytk_mp4j_tpu.resilience.faults import FaultKill
 
     ctx = mp.get_context("fork")
+    # frozen-leg pin (the shm/audit/sink/async precedent): the
+    # replacement/shrink latency figures predate the health plane and
+    # must not drift with MP4J_HEALTH
     master = Master(procs, timeout=60.0, elastic=elastic,
                     spares=1 if spare_body is not None else 0,
-                    adopt_secs=15.0).serve_in_thread()
+                    adopt_secs=15.0, health=False).serve_in_thread()
     q = ctx.Queue()
+    slave_kwargs.setdefault("health", False)
 
     def worker():
         try:
@@ -846,6 +856,40 @@ def bench_sink_overhead(rounds=2):
         "socket_collective_gbs_sink_off": round(off, 4),
         "socket_collective_gbs_sink_on": round(rates["on"], 4),
         "sink_overhead_pct": round((off - rates["on"]) / off * 100, 2)
+        if off else None,
+    }
+
+
+def bench_health_overhead(rounds=2):
+    """ISSUE 12 acceptance workload: interleaved A/B of the streaming
+    health plane on the isolated headline collective leg — health off
+    (the frozen-leg pin) vs armed on BOTH sides (slaves fold + ship
+    per-ordinal span cells on each heartbeat; the master runs the
+    detector set and online dominator attribution per fold),
+    best-of-``rounds`` per mode with modes interleaved per round so
+    system-load drift spreads evenly (the ``metrics_overhead`` /
+    ``bench_audit_overhead`` / ``bench_sink_overhead`` precedent).
+    Budget: <= 3%.
+
+    Cost anatomy: the collective hot path pays NOTHING new (the span
+    appends the folder reads were already booked by ISSUE 3); the
+    slave side adds one O(delta) span-ring fold per heartbeat
+    (~0.5 s), the master side a handful of dict updates plus one
+    ``critpath.attribute`` call per completed ordinal — all on
+    control-plane threads. On this shared 1-core host those threads
+    time-share the collective's core, so the printed delta carries
+    the usual ~10% run-to-run noise floor."""
+    rates = {m: 0.0 for m in ("off", "on")}
+    for _ in range(rounds):
+        for mode in rates:
+            gbs, _ = bench_socket_collective(native_transport=True,
+                                             health=(mode == "on"))
+            rates[mode] = max(rates[mode], gbs)
+    off = rates["off"]
+    return {
+        "socket_collective_gbs_health_off": round(off, 4),
+        "socket_collective_gbs_health_on": round(rates["on"], 4),
+        "health_overhead_pct": round((off - rates["on"]) / off * 100, 2)
         if off else None,
     }
 
@@ -1130,6 +1174,7 @@ def main():
     # leg with segments streaming to a throwaway dir (frozen legs pin
     # sink_dir="" the way they pin shm=False / audit="off")
     sink_overhead = bench_sink_overhead()
+    health_overhead = bench_health_overhead()
     # metrics-plane overhead A/B (ISSUE 6 acceptance: <= 3% on the
     # headline leg): the same isolated collective leg with
     # MP4J_METRICS=0 — histogram observes become flag checks, the
@@ -1325,6 +1370,13 @@ def main():
             "sink_overhead": sink_overhead,
             "socket_collective_gbs_sink_on":
                 sink_overhead["socket_collective_gbs_sink_on"],
+            # health-plane overhead (ISSUE 12 acceptance: <= 3% on the
+            # isolated headline leg, inside this host's ~10% noise
+            # floor); the armed figure is bench-diff-gated so the
+            # detector tax cannot silently creep
+            "health_overhead": health_overhead,
+            "socket_collective_gbs_health_on":
+                health_overhead["socket_collective_gbs_health_on"],
             "metrics_overhead": {
                 # False means the caller exported MP4J_METRICS=0 and
                 # the "on" leg really ran off — overhead_pct is then

@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 17)]
+    assert ids == [f"R{i}" for i in range(1, 18)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1245,3 +1245,98 @@ def test_r16_inline_suppression():
             comm.barrier()
     """)
     assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R17 — metric family missing from METRICS_DOC (doc drift)
+# ----------------------------------------------------------------------
+def test_r17_fires_on_undocumented_registry_family():
+    r = run_rule("R17", """
+        def book(self):
+            self._metrics.inc("nope/undocumented_family", 1)
+    """)
+    [f] = r.findings
+    assert f.rule == "R17" and "nope/undocumented_family" in f.message
+    assert "METRICS_DOC" in f.message
+
+
+def test_r17_fires_on_undocumented_gauge_and_observe():
+    r = run_rule("R17", """
+        def book(m):
+            m.set_gauge("mystery/gauge", 1.0)
+            m.observe("mystery/hist", 0.5, 1e-6, 36)
+    """)
+    assert len(r.findings) == 2
+
+
+def test_r17_quiet_on_documented_families():
+    r = run_rule("R17", """
+        def book(self, m):
+            self._metrics.inc("sink/bytes", 10)
+            m.set_gauge("async/outstanding", 3.0)
+            m.set_gauge("sink/lag_secs", 0.1)
+    """)
+    assert not r.findings
+
+
+def test_r17_fstring_prefix_matches_wildcard_key():
+    # f"latency/{family}" matches the "latency/<family>" wildcard;
+    # an unknown dynamic prefix fires
+    r = run_rule("R17", """
+        def book(self, name):
+            self.metrics.observe(f"latency/{name}", 0.1, 1e-6, 36)
+            self.metrics.observe(f"wat/{name}", 0.1, 1e-6, 36)
+    """)
+    [f] = r.findings
+    assert "wat/" in f.message and "wildcard" in f.message
+
+
+def test_r17_quiet_on_non_metrics_receiver():
+    # .inc()/.observe() on unrelated objects is not a registration
+    r = run_rule("R17", """
+        def other(counter):
+            counter.inc("not/a/metric")
+    """)
+    assert not r.findings
+
+
+def test_r17_fires_on_undocumented_prometheus_family():
+    r = run_rule("R17", """
+        def render(out):
+            out.append("# TYPE mp4j_made_up_series gauge")
+    """, path="ytk_mp4j_tpu/obs/metrics.py")
+    [f] = r.findings
+    assert "mp4j_made_up_series" in f.message
+
+
+def test_r17_type_lines_only_checked_in_metrics_module():
+    r = run_rule("R17", """
+        def doc():
+            return "# TYPE mp4j_made_up_series gauge"
+    """)
+    assert not r.findings
+
+
+def test_r17_inline_suppression():
+    r = run_rule("R17", """
+        def book(self):
+            # mp4j-lint: disable=R17 (experimental series)
+            self._metrics.inc("lab/experiment", 1)
+    """)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+def test_r17_repo_catalogue_is_complete():
+    """The shipped tree itself must be R17-clean: every family the
+    package registers or renders has its METRICS_DOC row."""
+    import os
+
+    from ytk_mp4j_tpu.analysis import baseline as _bl
+    from ytk_mp4j_tpu.analysis.cli import DEFAULT_BASELINE
+    import ytk_mp4j_tpu
+
+    pkg = os.path.dirname(ytk_mp4j_tpu.__file__)
+    engine = Engine(rules=get_rules(["R17"]),
+                    baseline=_bl.load(DEFAULT_BASELINE))
+    result = engine.lint_paths([pkg])
+    assert not result.findings, result.findings

@@ -1,0 +1,811 @@
+"""mp4j-health (ISSUE 12): streaming anomaly detection and per-rank
+verdicts. Detector unit grid on synthetic snapshot deltas (each
+detector fires on its scenario, stays quiet on clean/noisy baselines,
+hysteresis prevents flapping), the online dominator port, alert
+plumbing (sink ``alerts`` records, recovery log, Prometheus, live
+view, postmortem timeline), the ``mp4j-scope health`` CLI, knob
+validation, and the chaos acceptance grid: an injected-``slow`` rank
+reaches SUSPECT with the dominator detector named within a bounded
+ordinal count while a clean 4-rank grid stays HEALTHY end-to-end with
+zero alerts."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.exceptions import Mp4jError, Mp4jFatalError
+from ytk_mp4j_tpu.obs import critpath, health, metrics, sink, spans
+from ytk_mp4j_tpu.obs import postmortem, telemetry
+from ytk_mp4j_tpu.obs.cli import main as scope_main
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.utils import tuning
+
+N = 4
+LIVE = {0, 1, 2, 3}
+
+
+@pytest.fixture
+def fresh_spans():
+    spans.clear()
+    yield
+    spans.clear()
+
+
+def _engine(**kw):
+    kw.setdefault("window", 16)
+    kw.setdefault("dominator_ordinals", 8)
+    kw.setdefault("drift_pct", 100.0)
+    kw.setdefault("hb_secs", 0.1)
+    return health.HealthEngine(N, **kw)
+
+
+def _cell(seq, dur, wire=0.0, links=None, family="allreduce_array"):
+    return {"seq": seq, "family": family, "t0": 1000.0 + seq,
+            "dur": dur,
+            "phases": {"wire": wire, "reduce": dur * 0.1,
+                       "serialize": 0.0},
+            "links": links or {}}
+
+
+def _beat(e, rank, seq, now, **payload):
+    payload.setdefault("progress", {"seq": seq})
+    return e.fold(rank, payload, now, LIVE)
+
+
+def _clean_round(e, seq, now, dur=0.001):
+    """One symmetric healthy ordinal folded from all four ranks."""
+    out = []
+    for r in range(N):
+        out += _beat(e, r, seq, now, health_delta={
+            "cells": [_cell(seq, dur, wire=dur / 2)]})
+    return out
+
+
+def _slow_round(e, seq, now, slow_rank=3, dur=0.021):
+    """One ordinal gated by ``slow_rank``: its wire time dominates and
+    every peer's wire wait votes blame on it."""
+    out = []
+    for r in range(N):
+        if r == slow_rank:
+            c = _cell(seq, dur, wire=dur * 0.95,
+                      links={0: {"secs": dur * 0.9,
+                                 "transport": "tcp", "bytes": 800_000}})
+        else:
+            c = _cell(seq, dur, wire=dur * 0.9,
+                      links={slow_rank: {"secs": dur * 0.9,
+                                         "transport": "tcp",
+                                         "bytes": 800_000}})
+        out += _beat(e, r, seq, now, health_delta={"cells": [c]})
+    return out
+
+
+# ----------------------------------------------------------------------
+# knob validation
+# ----------------------------------------------------------------------
+def test_health_knob_validation(monkeypatch):
+    monkeypatch.setenv("MP4J_HEALTH", "maybe")
+    with pytest.raises(Mp4jError):
+        tuning.health_enabled()
+    monkeypatch.setenv("MP4J_HEALTH", "0")
+    assert tuning.health_enabled() is False
+    monkeypatch.setenv("MP4J_HEALTH", "on")
+    assert tuning.health_enabled() is True
+    assert tuning.health_enabled(override=False) is False
+    monkeypatch.setenv("MP4J_HEALTH_WINDOW", "2")
+    with pytest.raises(Mp4jError):
+        tuning.health_window()
+    monkeypatch.setenv("MP4J_HEALTH_WINDOW", "32")
+    assert tuning.health_window() == 32
+    monkeypatch.setenv("MP4J_HEALTH_DOMINATOR_ORDINALS", "1")
+    with pytest.raises(Mp4jError):
+        tuning.health_dominator_ordinals()
+    monkeypatch.setenv("MP4J_HEALTH_DOMINATOR_ORDINALS", "500")
+    assert tuning.health_dominator_ordinals() == 500
+    monkeypatch.setenv("MP4J_HEALTH_DRIFT_PCT", "0.5")
+    with pytest.raises(Mp4jError):
+        tuning.health_drift_pct()
+    monkeypatch.setenv("MP4J_HEALTH_DRIFT_PCT", "150")
+    assert tuning.health_drift_pct() == 150.0
+
+
+# ----------------------------------------------------------------------
+# slave side: SpanFolder + AlertLog
+# ----------------------------------------------------------------------
+def test_span_folder_completes_cells(fresh_spans):
+    f = health.SpanFolder(rank=1)
+    # phases first, collective span closes the ordinal (the ring's
+    # real ordering)
+    spans.phase("wire", 0.002, 1, "allreduce_array", 7, peer=3,
+                transport="tcp", bytes_sent=1000, bytes_recv=1000)
+    spans.phase("reduce", 0.001, 1, "allreduce_array", 7)
+    assert f.take() is None          # incomplete: no collective span
+    spans.collective("allreduce_array", 0.0, 0.004, 1, 7)
+    d = f.take()
+    [c] = d["cells"]
+    assert c["seq"] == 7 and c["family"] == "allreduce_array"
+    assert c["dur"] == pytest.approx(0.004)
+    assert c["phases"]["wire"] == pytest.approx(0.002)
+    assert c["links"][3]["transport"] == "tcp"
+    assert c["links"][3]["bytes"] == 2000
+    assert d["dropped"] == 0
+    assert f.take() is None          # nothing new
+
+
+def test_span_folder_filters_other_ranks(fresh_spans):
+    f = health.SpanFolder(rank=0)
+    spans.collective("allreduce_array", 0.0, 0.001, 2, 5)
+    spans.collective("allreduce_array", 0.0, 0.001, 0, 5)
+    d = f.take()
+    assert [c["seq"] for c in d["cells"]] == [5]
+
+
+def test_span_folder_caps_and_counts_drops(fresh_spans):
+    f = health.SpanFolder(rank=0, max_cells=4)
+    for seq in range(1, 11):
+        spans.collective("allreduce_array", 0.0, 0.001, 0, seq)
+    d = f.take()
+    assert len(d["cells"]) == 4
+    # newest survive the cap
+    assert [c["seq"] for c in d["cells"]] == [7, 8, 9, 10]
+    assert d["dropped"] == 6
+
+
+def test_alert_log_cursor_delta():
+    log = health.AlertLog(maxlen=4)
+    for i in range(6):
+        log.note({"id": i})
+    cur, evs, dropped = log.events_since(0)
+    assert cur == 6 and dropped == 2
+    assert [e["id"] for e in evs] == [2, 3, 4, 5]
+    cur2, evs2, d2 = log.events_since(cur)
+    assert evs2 == [] and d2 == 0
+
+
+# ----------------------------------------------------------------------
+# pure detector units
+# ----------------------------------------------------------------------
+def _hist(mean, count=8, bucket=10):
+    counts = [0] * (metrics.LATENCY_BUCKETS + 1)
+    counts[bucket] = count
+    return {"lo": metrics.LATENCY_LO, "n": metrics.LATENCY_BUCKETS,
+            "counts": counts, "count": count, "sum": mean * count}
+
+
+def test_latency_drift_fires_after_two_folds_and_bucket_shift():
+    base = {}
+    for _ in range(health.WARMUP_FOLDS):
+        assert health.detect_latency_drift(base, _hist(0.001),
+                                           100.0) is None
+    # 4x mean AND +2 buckets: first drifting fold only ARMS
+    assert health.detect_latency_drift(base, _hist(0.004, bucket=12),
+                                       100.0) is None
+    hit = health.detect_latency_drift(base, _hist(0.004, bucket=12),
+                                      100.0)
+    assert hit is not None and hit[0] >= 1
+    assert "baseline" in hit[1]
+
+
+def test_latency_drift_quiet_on_mean_only_noise():
+    """A noisy mean WITHOUT the log2-bucket shift stays quiet — the
+    histogram confirmation the detector exists for."""
+    base = {}
+    for _ in range(health.WARMUP_FOLDS):
+        health.detect_latency_drift(base, _hist(0.001), 100.0)
+    for _ in range(6):
+        assert health.detect_latency_drift(
+            base, _hist(0.0025, bucket=10), 100.0) is None
+
+
+def test_latency_drift_small_samples_ignored():
+    base = {}
+    for _ in range(health.WARMUP_FOLDS):
+        health.detect_latency_drift(base, _hist(0.001), 100.0)
+    assert health.detect_latency_drift(
+        base, _hist(0.02, count=2, bucket=14), 100.0) is None
+
+
+def test_latency_drift_adopts_new_normal():
+    base = {}
+    for _ in range(health.WARMUP_FOLDS):
+        health.detect_latency_drift(base, _hist(0.001), 100.0)
+    for _ in range(health.DRIFT_ADAPT_FOLDS):
+        health.detect_latency_drift(base, _hist(0.004, bucket=12),
+                                    100.0)
+    # adopted: the sustained level is the new baseline, detector quiet
+    assert health.detect_latency_drift(base, _hist(0.004, bucket=12),
+                                       100.0) is None
+
+
+def test_storm_quiet_on_single_recovery_round():
+    base = {}
+    assert health.detect_storm(base, 1) is None
+    assert health.detect_storm(base, 0) is None
+
+
+def test_storm_fires_on_sustained_events():
+    base = {}
+    hit = None
+    for _ in range(4):
+        hit = health.detect_storm(base, 2) or hit
+    assert hit is not None and "storm" in hit[1]
+
+
+def test_sink_drop_detector():
+    base = {}
+    assert health.detect_sink_drop(base, 0) is None
+    hit = health.detect_sink_drop(base, 5)
+    assert hit is not None and "dropping" in hit[1]
+
+
+def test_backlog_fires_on_monotone_growth_only():
+    base = {}
+    for v in (1.0, 2.0, 3.0, 4.0):
+        hit = health.detect_backlog(base, v)
+    assert hit is not None
+    base2 = {}
+    for v in (1.0, 3.0, 2.0, 4.0, 1.0, 3.0, 2.0):   # oscillating
+        assert health.detect_backlog(base2, v) is None
+
+
+def test_hb_flap_detector():
+    base = {}
+    for _ in range(health.WARMUP_FOLDS + 1):
+        assert health.detect_hb_flap(base, 0.1, 0.1) is None
+    hit = health.detect_hb_flap(base, 1.0, 0.1)
+    assert hit is not None and "flap" in hit[1]
+    # the flap did not inflate the baseline out of detectability
+    assert health.detect_hb_flap(base, 1.0, 0.1) is not None
+
+
+# ----------------------------------------------------------------------
+# engine: hysteresis + per-detector escalation on synthetic deltas
+# ----------------------------------------------------------------------
+def test_engine_clean_folds_zero_alerts():
+    e = _engine()
+    now = 0.0
+    for seq in range(1, 25):
+        assert _clean_round(e, seq, now) == []
+        now += 0.1
+    st = e.status()
+    assert all(v["state"] == "HEALTHY" for v in st["ranks"].values())
+    assert st["alerts_total"] == 0
+    assert st["dominator"]["attributed"] == 24
+    assert st["first_degraded"] is None
+
+
+def test_engine_storm_escalates_one_level_per_fold():
+    e = _engine()
+    states = []
+    for i in range(8):
+        a = _beat(e, 0, 10, i * 0.1, stats_delta={
+            "allreduce_array": {"retries": 3.0}})
+        states += [x["to"] for x in a if x["kind"] == "state"]
+    assert states[:2] == ["DEGRADED", "SUSPECT"]
+    # storms cap at SUSPECT: no EVICT without the dominator contract
+    assert "EVICT_RECOMMENDED" not in states
+    assert e.status()["ranks"]["0"]["state"] == "SUSPECT"
+
+
+def test_engine_hysteresis_prevents_flapping():
+    """Alternating hit/clean folds must not bounce the state — and
+    recovery needs CLEAR_FOLDS clean folds per level down."""
+    e = _engine()
+    now = 0.0
+    transitions = []
+    for i in range(12):
+        payload = ({"stats_delta": {"a": {"retries": 3.0}}}
+                   if i % 2 == 0 else {})
+        a = _beat(e, 0, 10, now, **payload)
+        transitions += [(x["from"], x["to"]) for x in a
+                        if x["kind"] == "state"]
+        now += 0.1
+    # escalated but never stepped DOWN mid-flap (the hysteresis)
+    code = {v: k for k, v in health.STATE_NAMES.items()}
+    downs = [t for t in transitions if code[t[0]] > code[t[1]]]
+    assert not downs, transitions
+    state_mid = e.status()["ranks"]["0"]["state"]
+    assert state_mid in ("DEGRADED", "SUSPECT")
+    # sustained clean folds: one level down per CLEAR_FOLDS streak
+    seen = []
+    for i in range(12):
+        a = _beat(e, 0, 10, now)
+        seen += [x["to"] for x in a if x["kind"] == "state"]
+        now += 0.1
+    assert e.status()["ranks"]["0"]["state"] == "HEALTHY"
+    if state_mid == "SUSPECT":
+        assert seen == ["DEGRADED", "HEALTHY"]
+    else:
+        assert seen == ["HEALTHY"]
+
+
+def test_engine_audit_divergence_forces_suspect():
+    e = _engine()
+    alerts = e.note_audit([{"seq": 9, "kind": "output",
+                            "msg": "minority rank(s) [2]",
+                            "ranks": [2]}], LIVE)
+    [ev] = alerts
+    assert ev["rank"] == 2 and ev["to"] == "SUSPECT"
+    assert ev["detector"] == "audit"
+    assert e.status()["ranks"]["2"]["state"] == "SUSPECT"
+
+
+def test_engine_dominator_ladder_and_onset():
+    """The online dominator: SUSPECT forced at half the streak, EVICT
+    at the full streak, onset counted once, shares exported."""
+    e = _engine()        # dominator_ordinals=8, window=16
+    now = 0.0
+    seq = 0
+    for _ in range(20):                      # learn the baseline
+        seq += 1
+        assert _clean_round(e, seq, now) == []
+        now += 0.1
+    events = []
+    for _ in range(12):
+        seq += 1
+        events += _slow_round(e, seq, now)
+        now += 0.1
+    states = [(x["to"], x["detector"]) for x in events
+              if x["kind"] == "state"]
+    assert ("SUSPECT", "dominator") in states
+    assert ("EVICT_RECOMMENDED", "dominator") in states
+    # SUSPECT arrived within dominator_ordinals slow ordinals
+    st = e.status()
+    assert st["ranks"]["3"]["state"] == "EVICT_RECOMMENDED"
+    assert st["evict_recommended"] == [3]
+    assert st["dominator"]["onsets"] == 1
+    assert st["dominator"]["shares"]["3"] >= 0.5
+    assert st["dominator"]["streak_rank"] == 3
+    assert [x for x in events if x["kind"] == "onset"]
+    assert st["first_degraded"]["rank"] == 3
+    assert st["first_degraded"]["detector"] == "dominator"
+
+
+def test_engine_fast_dominator_stays_quiet():
+    """A topology-biased but FAST dominator (every ordinal at the
+    baseline duration) must never escalate — dominance without
+    slowness is not degradation."""
+    e = _engine()
+    now = 0.0
+    for seq in range(1, 40):
+        # rank 0 wins the blame vote every ordinal, at baseline speed
+        for r in range(N):
+            if r == 0:
+                c = _cell(seq, 0.001, wire=0.00095)
+            else:
+                c = _cell(seq, 0.001, wire=0.0005,
+                          links={0: {"secs": 0.0005,
+                                     "transport": "tcp",
+                                     "bytes": 1000}})
+            assert _beat(e, r, seq, now, health_delta={
+                "cells": [c]}) == []
+        now += 0.1
+    assert e.status()["alerts_total"] == 0
+
+
+def test_engine_dead_and_replacement():
+    e = _engine()
+    [ev] = e.note_dead(2, "connection lost")
+    assert ev["to"] == "DEAD" and ev["detector"] == "liveness"
+    assert e.status()["ranks"]["2"]["state"] == "DEAD"
+    # zombie beats after the declaration fold to nothing
+    assert _beat(e, 2, 5, 1.0) == []
+    [back] = e.note_replacement(2)
+    assert back["from"] == "DEAD" and back["to"] == "HEALTHY"
+    assert e.status()["ranks"]["2"]["state"] == "HEALTHY"
+    # replacing an already-HEALTHY rank is silent
+    assert e.note_replacement(1) == []
+
+
+def test_engine_shrink_remaps_verdicts():
+    e = _engine()
+    for i in range(6):
+        _beat(e, 3, 10, i * 0.1,
+              stats_delta={"a": {"retries": 3.0}})
+    assert e.status()["ranks"]["3"]["state"] == "SUSPECT"
+    e.note_dead(2, "killed")
+    e.note_shrink(3, {0: 0, 1: 1, 3: 2})
+    st = e.status()
+    assert st["ranks"]["2"]["state"] == "SUSPECT"   # old rank 3
+    assert "3" not in st["ranks"]
+
+
+def test_engine_disabled_is_inert():
+    e = health.HealthEngine(N, enabled=False)
+    assert e.fold(0, {"progress": {"seq": 1}}, 0.0, LIVE) == []
+    assert e.note_dead(0, "x") == []
+    assert e.status()["enabled"] is False
+
+
+def test_engine_link_baselines_learned():
+    e = _engine()
+    now = 0.0
+    for seq in range(1, 4):
+        _beat(e, 0, seq, now, health_delta={"cells": [
+            _cell(seq, 0.001, wire=0.0005,
+                  links={1: {"secs": 0.001, "transport": "tcp",
+                             "bytes": 1_000_000}})]})
+        now += 0.1
+    gbs = e.status()["ranks"]["0"]["links_gbs"]
+    assert gbs["1"] == pytest.approx(1.0, rel=0.1)
+
+
+# ----------------------------------------------------------------------
+# rendering: Prometheus, live view, CLI formatters
+# ----------------------------------------------------------------------
+def _health_doc(st):
+    return {"slave_num": N, "window_secs": 60.0, "hb_secs": 0.1,
+            "ranks": {}, "cluster": {"stats": {}, "rates": {},
+                                     "histograms": {}, "health": st}}
+
+
+def _degraded_engine():
+    e = _engine()
+    now = 0.0
+    seq = 0
+    for _ in range(20):
+        seq += 1
+        _clean_round(e, seq, now)
+        now += 0.1
+    for _ in range(12):
+        seq += 1
+        _slow_round(e, seq, now)
+        now += 0.1
+    return e
+
+
+def test_prometheus_health_series():
+    st = _degraded_engine().status()
+    text = metrics.to_prometheus(_health_doc(st))
+    assert 'mp4j_rank_health_state{rank="3"} 3' in text
+    assert 'mp4j_rank_health_state{rank="0"} 0' in text
+    assert "# TYPE mp4j_evict_recommended gauge" in text
+    assert "mp4j_evict_recommended 1" in text
+    assert 'mp4j_alerts_total{rank="3",detector="dominator"}' in text
+    assert "mp4j_straggler_onsets_total 1" in text
+    dom_line = next(ln for ln in text.splitlines()
+                    if ln.startswith('mp4j_critpath_dominator'
+                                     '{rank="3"}'))
+    assert float(dom_line.rsplit(" ", 1)[1]) >= 0.5
+    # disabled plane: no health series at all (no zero-noise)
+    off = metrics.to_prometheus(_health_doc(None))
+    assert "mp4j_rank_health_state" not in off
+    assert "mp4j_evict_recommended" not in off
+
+
+def _live_doc(health_st=None, age=0.1):
+    doc = {
+        "slave_num": N, "window_secs": 60.0, "hb_secs": 0.5,
+        "ranks": {
+            str(r): {"progress": {"seq": 30, "current":
+                                  "allreduce_array" if r == 1 else None,
+                                  "last": "allreduce_array",
+                                  "phase": "wire" if r == 1 else None,
+                                  "current_secs": 1.2, "epoch": 1},
+                     "age": age,
+                     "stats": {"allreduce_array": {
+                         "calls": 30, "bytes_sent": 1e8,
+                         "bytes_recv": 1e8, "retries": 2,
+                         "wire_bytes_tcp": 1e8, "wire_seconds": 1.0,
+                         "reduce_seconds": 0.5,
+                         "serialize_seconds": 0.1}},
+                     "rates": {"bytes_per_sec": 123.45e6},
+                     "counters": {"sink/bytes": 2.4e6},
+                     "gauges": {}, "audit_seq": 30}
+            for r in range(N)},
+        "cluster": {"stats": {}, "rates": {"bytes_per_sec": 5e8,
+                                           "collectives_per_sec": 10.0,
+                                           "keys_per_sec": 0.0},
+                    "histograms": {}, "health": health_st},
+    }
+    return doc
+
+
+def test_live_view_health_column_and_width():
+    st = _degraded_engine().status()
+    frame = telemetry.format_live(_live_doc(st))
+    lines = frame.splitlines()
+    assert any("health:" in ln for ln in lines)      # head-line
+    header = next(ln for ln in lines if "health" in ln and "rank" in ln)
+    assert "health" in header
+    row3 = next(ln for ln in lines if ln.lstrip(" *").startswith("3 "))
+    assert "EVICT" in row3
+    row0 = next(ln for ln in lines if ln.lstrip(" *").startswith("0 "))
+    assert " ok " in row0 + " "
+    # the whole frame stays within 120 columns (the live-view budget)
+    for ln in lines:
+        assert len(ln) <= 120, f"{len(ln)} cols: {ln!r}"
+
+
+def test_live_view_without_health_plane():
+    frame = telemetry.format_live(_live_doc(None))
+    lines = frame.splitlines()
+    header = next(ln for ln in lines if "health" in ln and "rank" in ln)
+    off = header.index("health")
+    row0 = next(ln for ln in lines if ln.lstrip(" *").startswith("0 "))
+    # health column renders "-" when the master runs without the plane
+    assert row0[off:off + 6].strip() == "-"
+    assert "health:" not in frame     # no head-line
+
+
+def test_live_view_stale_rank_rates_annotated():
+    """A wedged rank's frozen rate window must not render as healthy
+    throughput: columns older than 2x the heartbeat interval are
+    annotated (ISSUE 12 satellite fix)."""
+    doc = _live_doc(None)
+    doc["ranks"]["2"]["age"] = 5.0    # 10x the 0.5 s heartbeat
+    frame = telemetry.format_live(doc)
+    row2 = next(ln for ln in frame.splitlines()
+                if ln.lstrip(" *").startswith("2 "))
+    assert "stale" in row2
+    assert "123.45" not in row2
+    row0 = next(ln for ln in frame.splitlines()
+                if ln.lstrip(" *").startswith("0 "))
+    assert "123.45" in row0           # fresh ranks keep real rates
+    # heartbeats disabled (hb_secs 0) -> no stale marking possible
+    doc["hb_secs"] = 0.0
+    frame2 = telemetry.format_live(doc)
+    row2b = next(ln for ln in frame2.splitlines()
+                 if ln.lstrip(" *").startswith("2 "))
+    assert "stale" not in row2b
+
+
+def test_format_status_and_history():
+    st = _degraded_engine().status()
+    text = health.format_status(st)
+    assert "EVICT RECOMMENDED: rank(s) 3" in text
+    assert "first degradation: rank 3" in text
+    alerts = st["last_alerts"]
+    hist = health.format_history(alerts, [0, 1, 2, 3])
+    assert "first degradation: rank 3" in hist
+    assert "rank 3: EVICT_RECOMMENDED" in hist
+    assert "rank 0: HEALTHY" in hist
+    assert health.format_history([], [0]) .startswith("(no health")
+
+
+# ----------------------------------------------------------------------
+# alert plumbing: sink record kind + critpath/postmortem timeline
+# ----------------------------------------------------------------------
+def test_sink_drains_alert_log(tmp_path, fresh_spans):
+    log = health.AlertLog()
+    w = sink.SinkWriter(str(tmp_path), 0, slave_num=1, alerts=log,
+                        budget_bytes=1 << 20, flush_secs=60.0)
+    log.note({"id": 1, "wall": 123.0, "rank": 0, "detector": "storm",
+              "kind": "state", "from": "HEALTHY", "to": "DEGRADED",
+              "seq": 5, "msg": "m"})
+    w.flush()
+    w.close()
+    doc = sink.read_rank(sink.rank_dir(str(tmp_path), 0))
+    alerts = [rec for rec in doc["records"] if rec["t"] == "alerts"]
+    assert alerts and alerts[0]["alerts"][0]["detector"] == "storm"
+    # record-count accounting treats the batch by its alert count
+    assert sink._record_count({"t": "alerts",
+                               "alerts": [{}, {}, {}]}) == 3
+
+
+def test_critpath_collects_and_dedups_alerts(tmp_path, fresh_spans):
+    ev = {"id": 7, "wall": 50.0, "rank": 3, "detector": "dominator",
+          "kind": "state", "from": "HEALTHY", "to": "SUSPECT",
+          "seq": 9, "msg": "x"}
+    for r in (0, 1):     # the same alert orphaned onto two ranks
+        log = health.AlertLog()
+        log.note(ev)
+        w = sink.SinkWriter(str(tmp_path), r, slave_num=2, alerts=log,
+                            budget_bytes=1 << 20, flush_secs=60.0)
+        w.flush()
+        w.close()
+    analysis = critpath.analyze(sink.load_job(str(tmp_path)))
+    assert len(analysis["health_alerts"]) == 1       # dedup by id
+    report = critpath.format_report(analysis, str(tmp_path))
+    assert "health timeline" in report
+    assert "rank 3" in report and "SUSPECT" in report
+
+
+def test_postmortem_manifest_health_timeline(tmp_path):
+    st = _degraded_engine().status()
+    postmortem.write_master_manifest(
+        str(tmp_path), slave_num=N, reason="test fatal",
+        table={}, departed={}, diagnosis=["d"], health=st)
+    report = postmortem.merge_report(str(tmp_path))
+    assert "health verdicts at abort time:" in report
+    assert "rank 3: EVICT_RECOMMENDED" in report
+    assert "first degradation was rank 3" in report
+    assert "dominator" in report
+    assert "EVICT was recommended for rank(s) 3" in report
+
+
+def test_scope_health_cli_on_sink_dir(tmp_path, fresh_spans, capsys):
+    log = health.AlertLog()
+    log.note({"id": 1, "wall": 10.0, "rank": 2, "detector": "storm",
+              "kind": "state", "from": "HEALTHY", "to": "DEGRADED",
+              "seq": 3, "msg": "m"})
+    w = sink.SinkWriter(str(tmp_path), 2, slave_num=3, alerts=log,
+                        budget_bytes=1 << 20, flush_secs=60.0)
+    w.flush()
+    w.close()
+    assert scope_main(["health", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "health timeline" in out
+    assert "rank 2" in out and "DEGRADED" in out
+    assert scope_main(["health", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc[0]["detector"] == "storm"
+
+
+# ----------------------------------------------------------------------
+# acceptance: clean grid stays HEALTHY, slow rank reaches SUSPECT
+# ----------------------------------------------------------------------
+def _run_grid(rounds, tmp_dir=None, fault_plan=None, size=100_000,
+              hold=None, on_degraded=None, master_kwargs=None,
+              slave_kwargs=None, join=90.0):
+    """Master + N slave threads running ``rounds`` allreduces; returns
+    (master, errors). ``hold`` (an Event) delays close so the caller
+    can interrogate the live master; ``on_degraded`` is polled."""
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+
+    master = Master(N, timeout=60.0,
+                    **(master_kwargs or {})).serve_in_thread()
+    errors = [None] * N
+
+    def worker(i):
+        slave = None
+        try:
+            kw = dict(slave_kwargs or {})
+            if tmp_dir:
+                kw["sink_dir"] = tmp_dir
+            if fault_plan:
+                kw["fault_plan"] = fault_plan
+            slave = ProcessCommSlave("127.0.0.1", master.port,
+                                     timeout=60.0, **kw)
+            for _ in range(rounds):
+                a = np.ones(size, np.float64)
+                slave.allreduce_array(a, Operands.DOUBLE,
+                                      Operators.SUM)
+            if hold is not None:
+                hold.wait(45.0)
+            slave.close(0)
+        except Exception as e:
+            errors[slave.rank if slave is not None else i] = e
+            if slave is not None:
+                try:
+                    slave.close(1)
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    return master, errors, threads
+
+
+def test_clean_grid_stays_healthy_zero_alerts(monkeypatch,
+                                              fresh_spans, tmp_path):
+    """Acceptance: the clean 4-rank property grid reports ZERO alerts
+    and every rank ends HEALTHY — no false positives."""
+    monkeypatch.setenv("MP4J_HEARTBEAT_SECS", "0.1")
+    d = str(tmp_path / "trail")
+    master, errors, threads = _run_grid(24, tmp_dir=d, size=20_000)
+    for t in threads:
+        t.join(90.0)
+        assert not t.is_alive(), "rank hung"
+    assert all(e is None for e in errors), errors
+    master.join(15.0)
+    assert master.final_code == 0
+    st = master.health_status()
+    assert st is not None
+    assert all(v["state"] == "HEALTHY" for v in st["ranks"].values())
+    assert st["alerts_total"] == 0
+    assert st["dominator"]["onsets"] == 0
+    assert st["dominator"]["attributed"] >= 20
+    # zero alerts means zero durable alert records too
+    analysis = critpath.analyze(sink.load_job(d))
+    assert analysis["health_alerts"] == []
+
+
+def test_chaos_slow_rank_reaches_suspect_within_bound(monkeypatch,
+                                                      fresh_spans,
+                                                      tmp_path,
+                                                      capsys):
+    """Acceptance: a fault-plan ``slow`` rank is flagged SUSPECT with
+    the dominator detector named within MP4J_HEALTH_DOMINATOR_ORDINALS
+    ordinals; ``Master.health_status()`` and ``/metrics`` agree; the
+    alert lands in the durable sink."""
+    monkeypatch.setenv("MP4J_HEARTBEAT_SECS", "0.1")
+    monkeypatch.setenv("MP4J_HEALTH_DOMINATOR_ORDINALS", "12")
+    monkeypatch.setenv("MP4J_HEALTH_WINDOW", "24")
+    d = str(tmp_path / "trail")
+    hold = threading.Event()
+    # 20 clean ordinals learn the baseline, then 40 gated by rank 3's
+    # 20 ms injected sleeps (20x the healthy ordinal on this host)
+    master, errors, threads = _run_grid(
+        60, tmp_dir=d, fault_plan="slow:rank=3:secs=0.02:nth=20",
+        hold=hold, master_kwargs={"metrics_port": 0})
+    try:
+        deadline = time.monotonic() + 60.0
+        st = None
+        while time.monotonic() < deadline:
+            st = master.health_status()
+            s = (st or {}).get("ranks", {}).get("3", {}).get("state")
+            if s in ("SUSPECT", "EVICT_RECOMMENDED"):
+                break
+            time.sleep(0.2)
+        assert st is not None
+        r3 = st["ranks"]["3"]
+        assert r3["state"] in ("SUSPECT", "EVICT_RECOMMENDED"), st
+        # the dominator detector is the named evidence
+        assert ("dominator" in r3["alerts"]
+                or "dominator" in r3["pressure"]), r3
+        assert st["first_degraded"]["rank"] == 3
+        assert st["first_degraded"]["detector"] == "dominator"
+        # SUSPECT arrived within the configured ordinal bound of the
+        # fault arming (nth=20): first_degraded names the ordinal
+        assert st["first_degraded"]["seq"] <= 20 + 12 + 5
+        # /metrics agrees with health_status()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{master.metrics_port}/metrics",
+                timeout=5.0) as resp:
+            text = resp.read().decode()
+        code = {"SUSPECT": 2, "EVICT_RECOMMENDED": 3}[r3["state"]]
+        # the state may escalate between the two reads — accept >=
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith('mp4j_rank_health_state{rank="3"'))
+        assert int(line.rsplit(" ", 1)[1]) >= code - 1
+        assert "mp4j_straggler_onsets_total" in text
+        assert 'mp4j_critpath_dominator{rank="3"}' in text
+        # the CLI's URL mode renders the live verdicts
+        assert scope_main(
+            ["health", f"http://127.0.0.1:{master.metrics_port}"]) == 0
+        out = capsys.readouterr().out
+        assert "rank" in out and ("SUSPECT" in out or "EVICT" in out)
+    finally:
+        hold.set()
+    for t in threads:
+        t.join(90.0)
+        assert not t.is_alive(), "rank hung"
+    assert all(e is None for e in errors), errors
+    master.join(15.0)
+    # the verdict survived into the durable sink
+    analysis = critpath.analyze(sink.load_job(d))
+    suspects = [ev for ev in analysis["health_alerts"]
+                if ev.get("rank") == 3 and ev.get("kind") == "state"
+                and ev.get("to") in ("SUSPECT", "EVICT_RECOMMENDED")]
+    assert suspects, analysis["health_alerts"]
+    assert any(ev.get("detector") == "dominator" for ev in suspects)
+    report = critpath.format_report(analysis, d)
+    assert "health timeline" in report
+    assert scope_main(["health", d]) == 0
+
+
+def test_chaos_degraded_then_fatal_postmortem_timeline(monkeypatch,
+                                                       fresh_spans,
+                                                       tmp_path):
+    """A job that degrades and THEN dies: the postmortem manifest
+    freezes the verdicts and the merged report renders the health
+    timeline — what degraded first, when, which detector."""
+    monkeypatch.setenv("MP4J_HEARTBEAT_SECS", "0.1")
+    monkeypatch.setenv("MP4J_HEALTH_DOMINATOR_ORDINALS", "8")
+    monkeypatch.setenv("MP4J_HEALTH_WINDOW", "16")
+    pmdir = str(tmp_path / "pm")
+    monkeypatch.setenv("MP4J_POSTMORTEM_DIR", pmdir)
+    d = str(tmp_path / "trail")
+    master, errors, threads = _run_grid(
+        60, tmp_dir=d,
+        fault_plan="slow:rank=3:secs=0.02:nth=18; kill:rank=2:nth=50",
+        slave_kwargs={"dead_rank_secs": 20.0})
+    for t in threads:
+        t.join(90.0)
+        assert not t.is_alive(), "rank hung"
+    master.join(20.0)
+    survivors = [r for r in range(N) if r != 2]
+    assert all(isinstance(errors[r], (Mp4jError, Mp4jFatalError))
+               for r in survivors), errors
+    report = postmortem.merge_report(pmdir)
+    assert "health verdicts at abort time:" in report
+    assert "first degradation was rank 3" in report
+    assert "dominator" in report

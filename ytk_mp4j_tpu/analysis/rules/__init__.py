@@ -18,6 +18,7 @@
 | R14 | error   | telemetry artifact write skipping tmp+os.replace |
 | R15 | error   | roster-derived topology cached in an attribute |
 | R16 | error   | un-awaited CollectiveFuture crosses a boundary |
+| R17 | error   | metric family missing from METRICS_DOC |
 """
 
 from __future__ import annotations
@@ -52,6 +53,7 @@ from ytk_mp4j_tpu.analysis.rules.r15_topology_cache import (
     R15TopologyCache)
 from ytk_mp4j_tpu.analysis.rules.r16_unawaited_future import (
     R16UnawaitedFuture)
+from ytk_mp4j_tpu.analysis.rules.r17_metric_doc import R17MetricDoc
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -70,6 +72,7 @@ ALL_RULES = [
     R14TornWrite,
     R15TopologyCache,
     R16UnawaitedFuture,
+    R17MetricDoc,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

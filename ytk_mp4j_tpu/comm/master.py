@@ -47,6 +47,7 @@ import time
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.obs import audit as audit_mod
+from ytk_mp4j_tpu.obs import health as health_mod
 from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import postmortem as postmortem_mod
 from ytk_mp4j_tpu.obs import telemetry as telemetry_mod
@@ -104,7 +105,8 @@ class Master:
                  sink_dir: str | None = None,
                  elastic: str | None = None,
                  spares: int | None = None,
-                 adopt_secs: float | None = None):
+                 adopt_secs: float | None = None,
+                 health: bool | None = None):
         """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
         bounds each accepted connection's registration message, so one
         stray dial-in stalls rendezvous briefly instead of consuming the
@@ -149,7 +151,16 @@ class Master:
         registrations rendezvous waits for before the job starts;
         spares may also register later, mid-job. ``adopt_secs`` (None
         reads ``MP4J_ADOPT_SECS``) bounds each adoption handshake
-        before the next spare is tried."""
+        before the next spare is tried.
+
+        ``health`` (ISSUE 12; None reads ``MP4J_HEALTH``, default on)
+        arms the streaming health engine (:mod:`ytk_mp4j_tpu.obs.
+        health`): every heartbeat fold also feeds per-rank baselines
+        and the detector set, verdict transitions are pushed to the
+        subject rank's recovery log + durable sink and exported on
+        ``/metrics``, and :meth:`health_status` is the operator hook a
+        future autoscaler calls — this plane recommends, it never
+        acts."""
         self.slave_num = slave_num
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
@@ -221,6 +232,18 @@ class Master:
         # passive — it only ever sees records when slaves run
         # MP4J_AUDIT=verify|capture
         self._auditor = audit_mod.ClusterAuditor(slave_num)
+        # health plane (ISSUE 12): the streaming verdict engine,
+        # folded right next to the auditor in _record_telemetry; None
+        # when disabled so every fold site pays one attribute check
+        self._hb_secs = tuning.heartbeat_secs()
+        self._health: health_mod.HealthEngine | None = (
+            health_mod.HealthEngine(
+                slave_num,
+                window=tuning.health_window(),
+                dominator_ordinals=tuning.health_dominator_ordinals(),
+                drift_pct=tuning.health_drift_pct(),
+                hb_secs=self._hb_secs)
+            if tuning.health_enabled(health) else None)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -496,6 +519,17 @@ class Master:
             # under MP4J_ELASTIC=off. The elastic modes (ISSUE 10)
             # dispatch through _on_rank_dead instead: replacement from
             # a warm spare, or a contiguous shrink of the survivors.
+            if slot.dead:
+                # this rank was ALREADY declared dead (its channel
+                # erroring now is the expected aftermath) — a shrink
+                # may meanwhile have renumbered a healthy survivor
+                # into slot.rank, and a fresh declaration here would
+                # kill THAT rank (found by the ISSUE 12 chaos loop:
+                # the health-alert dispatch shifted this race's
+                # timing, but the hole predates it)
+                self._log(slot.rank, "INFO",
+                          f"declared-dead rank's channel closed: {e!r}")
+                return
             rank = slot.rank
             self._log(rank, "ERROR", f"slave connection lost: {e!r}")
             with self._lock:
@@ -619,6 +653,11 @@ class Master:
         with self._lock:
             already = self._fatal_msg is not None
             pending = self._abort_since is not None
+            # the health plane's DEAD verdict rides the SAME liveness
+            # decision, never a second opinion (ISSUE 12)
+            dead_alerts = (self._health.note_dead(rank, why)
+                           if self._health is not None else [])
+        self._dispatch_health_alerts(dead_alerts)
         if self.elastic == "off" or already:
             with self._lock:
                 self._departed.setdefault(rank, why)
@@ -846,6 +885,13 @@ class Master:
             extra_lines.extend(
                 self._auditor.note_replacement(
                     r, self._round_seq or 0))
+            if self._health is not None:
+                # the joiner starts HEALTHY with fresh baselines; the
+                # reset alert is informational (the DEAD alert already
+                # reached the durable sinks)
+                extra_lines.extend(
+                    "health: " + health_mod.format_alert(ev)
+                    for ev in self._health.note_replacement(r))
         info = {"replaced": joiners, "roster": self._roster,
                 "epoch": epoch}
         targets = sorted(live)
@@ -889,6 +935,8 @@ class Master:
         self._departed = {}
         self._abort_progress = {}
         self._auditor.note_shrink(self.slave_num, mapping)
+        if self._health is not None:
+            self._health.note_shrink(self.slave_num, mapping)
         self._membership.note_shrink(dead_list, mapping, epoch,
                                      self._round_why)
         # pending barriers renumber too; one now-complete generation
@@ -1162,15 +1210,33 @@ class Master:
         progress = payload.get("progress") or {}
         now = time.monotonic()
         audit_lines: list[str] = []
+        health_alerts: list[dict] = []
         with self._lock:
+            live = set(range(self.slave_num)) - set(self._departed)
+            new_divergences: list[dict] = []
             if "audit_delta" in payload:
                 # verification happens as records complete — a flagged
                 # divergence is logged within one heartbeat of the last
                 # rank's record arriving; log lines emitted OUTSIDE the
                 # lock below
-                live = set(range(self.slave_num)) - set(self._departed)
+                before_div = self._auditor.divergence_total
                 audit_lines = self._auditor.fold(
                     rank, payload.get("audit_delta"), live)
+                grew = self._auditor.divergence_total - before_div
+                if grew:
+                    new_divergences = list(
+                        self._auditor.divergences)[-grew:]
+            if self._health is not None:
+                # the health plane folds the SAME beat: baselines,
+                # detectors, the online dominator over the shipped
+                # cells, and audit-divergence escalation — alert
+                # dispatch (log + push to the subject rank) happens
+                # outside the lock below
+                health_alerts = self._health.fold(
+                    rank, payload, now, live)
+                if new_divergences:
+                    health_alerts.extend(self._health.note_audit(
+                        new_divergences, live))
             prev = self._telemetry.get(rank)
             if "stats_delta" in payload:
                 stats = stats_mod.merge_snapshots(
@@ -1214,6 +1280,33 @@ class Master:
             self._cluster_window.note(now, self._cluster_totals)
         for line in audit_lines:
             self._log("M", "ERROR", line)
+        self._dispatch_health_alerts(health_alerts)
+
+    def _dispatch_health_alerts(self, alerts: list[dict]) -> None:
+        """Emit freshly minted health alerts: one master log line
+        each, plus a control-plane push to the SUBJECT rank (its
+        recovery log and durable sink make the verdict durable). A
+        dead/missing subject's alert lands on the lowest live rank
+        instead — the evidence must outlive the patient."""
+        if not alerts:
+            return
+        live = self._live_ranks()
+        for ev in alerts:
+            level = ("ERROR" if ev.get("to") in (
+                "SUSPECT", "EVICT_RECOMMENDED", "DEAD") else "WARN")
+            self._log("M", level,
+                      "health: " + health_mod.format_alert(ev))
+            target = ev.get("rank")
+            if ev.get("to") == "DEAD" or target not in live:
+                # never push a DEAD verdict at its own subject — the
+                # channel is the thing that just died, and the failed
+                # push would re-enter the death path as "unreachable
+                # on push"; the evidence lands on the lowest OTHER
+                # live rank instead
+                target = next((r for r in sorted(live)
+                               if r != ev.get("rank")), None)
+            if target is not None and 0 <= target < len(self._slots):
+                self._send_to(target, ("health_alert", ev))
 
     def _handle_diagnose(self, rank: int, payload: dict) -> None:
         """A slave's bounded collective wait expired: refresh its table
@@ -1364,6 +1457,8 @@ class Master:
             cluster_metrics = self._cluster_metrics
             audit_status = self._auditor.status()
             membership_status = self._membership_status_locked()
+            health_status = (self._health.status()
+                             if self._health is not None else None)
         cluster_stats = stats_mod.merge_snapshots(
             *(info["stats"] for info in ranks.values()))
         for r, info in ranks.items():
@@ -1372,6 +1467,9 @@ class Master:
         return {
             "slave_num": self.slave_num,
             "window_secs": self._metrics_window,
+            # heartbeat period (ISSUE 12 satellite): the live view
+            # needs it to annotate a stale rank's derived rate columns
+            "hb_secs": self._hb_secs,
             "ranks": ranks,
             "cluster": {
                 "stats": cluster_stats,
@@ -1379,6 +1477,7 @@ class Master:
                 "histograms": cluster_metrics["histograms"],
                 "audit": audit_status,
                 "membership": membership_status,
+                "health": health_status,
             },
         }
 
@@ -1408,6 +1507,21 @@ class Master:
         with self._lock:
             return self._auditor.status()
 
+    def health_status(self) -> dict | None:
+        """The health plane's verdict document (ISSUE 12) — THE
+        operator hook the future elastic autoscaler calls: per-rank
+        state (``HEALTHY``/``DEGRADED``/``SUSPECT``/
+        ``EVICT_RECOMMENDED``/``DEAD``) with detector-pressure
+        evidence, the ``evict_recommended`` list, dominator window
+        shares/streak, onset count and the recent alert tail (schema:
+        obs.health.HealthEngine.status). This plane only ever
+        RECOMMENDS — acting on a verdict (replacing a SUSPECT rank
+        from a spare, shrinking around an EVICT_RECOMMENDED one) is
+        the caller's decision. None when ``MP4J_HEALTH=0``."""
+        with self._lock:
+            return (self._health.status()
+                    if self._health is not None else None)
+
     def _write_postmortem_manifest(self) -> None:
         """Flight-recorder manifest (once per write site, idempotent
         overwrite): only on a terminal abort — a clean job leaves no
@@ -1417,6 +1531,8 @@ class Master:
             departed = dict(self._departed)
             audit_status = self._auditor.status()
             membership_status = self._membership_status_locked()
+            health_status = (self._health.status()
+                             if self._health is not None else None)
         if not self._postmortem_dir or reason is None:
             return
         # ONE table snapshot feeds both fields, so the manifest's
@@ -1430,7 +1546,8 @@ class Master:
                     table, self.slave_num),
                 audit=audit_status,
                 sink_dir=self._sink_dir or None,
-                membership=membership_status)
+                membership=membership_status,
+                health=health_status)
         except OSError:
             pass  # best-effort: the job is already terminal
 

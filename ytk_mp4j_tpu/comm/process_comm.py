@@ -47,9 +47,11 @@ from ytk_mp4j_tpu.comm import master as master_mod
 from ytk_mp4j_tpu.comm import progress as progress_mod
 from ytk_mp4j_tpu.comm.context import CommSlave
 from ytk_mp4j_tpu.obs import audit as audit_mod
+from ytk_mp4j_tpu.obs import health as health_mod
 from ytk_mp4j_tpu.obs import metrics as metrics_mod
 from ytk_mp4j_tpu.obs import postmortem
 from ytk_mp4j_tpu.obs import sink as sink_mod
+from ytk_mp4j_tpu.obs import spans as spans_mod
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 from ytk_mp4j_tpu.exceptions import (
     Mp4jError, Mp4jFatalError, Mp4jSpareReleased, Mp4jTransportError)
@@ -143,7 +145,8 @@ class ProcessCommSlave(CommSlave):
                  sink_dir: str | None = None,
                  elastic: str | None = None,
                  spare: bool = False,
-                 async_collectives: bool | None = None):
+                 async_collectives: bool | None = None,
+                 health: bool | None = None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -234,6 +237,16 @@ class ProcessCommSlave(CommSlave):
         ``MP4J_COALESCE_USECS`` coalescing window also validated
         here.
 
+        ``health`` (ISSUE 12; None reads ``MP4J_HEALTH``, default on)
+        arms this rank's half of the streaming health plane: each
+        heartbeat also carries the rank's completed per-ordinal span
+        cells (``health_delta`` — the live feed the master's online
+        dominator attribution consumes) and the control thread lands
+        the master's health-alert pushes in the recovery log and the
+        durable sink's ``alerts`` records. Run every rank with the
+        same value — a rank with it off ships no cells, so the master
+        can attribute nothing.
+
         ``spare=True`` registers this slave as a WARM SPARE (ISSUE 10)
         instead of claiming a rank: construction blocks — pinging the
         master from a background thread — until the master adopts it
@@ -284,6 +297,14 @@ class ProcessCommSlave(CommSlave):
         else:
             self._sink_dir = str(sink_dir)
         self._sink: sink_mod.SinkWriter | None = None
+        # health plane (ISSUE 12): knob validated up front like every
+        # other; the span folder itself starts after rendezvous (it
+        # needs the rank), the alert log exists unconditionally so a
+        # master running health against a health-off slave still
+        # lands its pushes somewhere durable
+        self._health_on = tuning.health_enabled(health)
+        self._health_folder: health_mod.SpanFolder | None = None
+        self._health_alerts = health_mod.AlertLog()
         # job-wide transport tuning (env-validated here, before any
         # connection exists, so a typo'd knob fails the job cleanly)
         # and pipeline state — all of it must exist BEFORE the accept
@@ -463,6 +484,13 @@ class ProcessCommSlave(CommSlave):
         # the one that stalls
         self._hb_stop = threading.Event()
         self._hb_secs = tuning.heartbeat_secs()
+        # health plane (ISSUE 12): the span folder needs the rank —
+        # it filters the process-global ring (thread-backed multi-
+        # slave processes share it) and folds completed ordinals into
+        # the heartbeat's health_delta cells
+        if self._health_on and spans_mod.enabled():
+            # mp4j-lint: disable=R15 (retargeted by _sync_identity on renumbering)
+            self._health_folder = health_mod.SpanFolder(self._rank)
         self._hb_thread: threading.Thread | None = None
         if self._hb_secs > 0:
             self._hb_thread = threading.Thread(
@@ -476,7 +504,8 @@ class ProcessCommSlave(CommSlave):
             self._sink = sink_mod.SinkWriter(
                 self._sink_dir, self._rank, slave_num=self._n,
                 stats=self._comm_stats, audit=self._audit,
-                recovery=self._recovery).start()
+                recovery=self._recovery,
+                alerts=self._health_alerts).start()
 
     # ------------------------------------------------------------------
     # identity / control plane
@@ -643,6 +672,20 @@ class ProcessCommSlave(CommSlave):
                         }))
                     except (Mp4jError, OSError):
                         pass  # master gone; its watchdog owns this
+                elif kind == "health_alert":
+                    # a health-plane verdict transition naming this
+                    # rank (or orphaned onto it): land it in the
+                    # recovery log and the alert log the durable sink
+                    # drains — the evidence must survive the process
+                    ev = msg[1] if isinstance(msg[1], dict) else {}
+                    self._health_alerts.note(ev)
+                    self._recovery.note(
+                        "health",
+                        f"rank {ev.get('rank')} {ev.get('from')}->"
+                        f"{ev.get('to')} ({ev.get('detector')})"
+                        if ev.get("kind") == "state" else
+                        f"rank {ev.get('rank')} onset "
+                        f"({ev.get('detector')})")
                 elif kind == "abort_fatal":
                     self._recovery.on_fatal(str(msg[1]))
                 else:
@@ -829,6 +872,13 @@ class ProcessCommSlave(CommSlave):
         prog["epoch"] = self._recovery.epoch
         payload = {"progress": prog,
                    "stats_delta": sd, "metrics_delta": md}
+        if self._health_folder is not None:
+            # completed per-ordinal span cells (ISSUE 12): the online
+            # dominator's live feed — bounded per beat like every
+            # other delta, overflow counted, never silent
+            hd = self._health_folder.take()
+            if hd is not None:
+                payload["health_delta"] = hd
         if self._audit is not None:
             # verify/capture ship digest records as deltas (the audit
             # ring keeps its own cursor, bounded like the stats delta);
@@ -1038,6 +1088,12 @@ class ProcessCommSlave(CommSlave):
         rec = getattr(self, "_recovery", None)
         if rec is not None:
             rec.rank = self._rank           # names this rank in aborts
+        folder = getattr(self, "_health_folder", None)
+        if folder is not None:
+            # the span folder filters the process-global ring by this
+            # rank's id — a shrink renumbering must retarget it or it
+            # ships the OLD occupant's cells (ISSUE 12)
+            folder._rank = self._rank
 
     def _accept_loop(self):
         while True:

@@ -31,9 +31,16 @@ Layers on top of the PR-2 measurement substrate:
   timeline reconstruction over sink segments with critical-path
   dominator attribution, per-phase wait decomposition and
   straggler-onset trend detection (``mp4j-scope analyze``/``tail``).
+- :mod:`ytk_mp4j_tpu.obs.health` — mp4j-health (ISSUE 12): the
+  streaming health plane interpreting the other three — rolling
+  per-rank baselines, a detector set (online critpath dominance,
+  latency drift, storms, sink outages, backlog growth, heartbeat
+  flapping, audit escalation) and the per-rank hysteresis verdict
+  machine behind ``Master.health_status()``, the ``alerts`` sink
+  records and ``mp4j-scope health``.
 - :mod:`ytk_mp4j_tpu.obs.cli` — the ``mp4j-scope`` CLI: merge per-rank
   Chrome-trace files into one timeline; render the cross-rank skew
   table from per-rank ``comm.stats()`` JSON dumps; ``live`` /
-  ``postmortem`` / ``replay`` / ``analyze`` / ``tail`` /
+  ``postmortem`` / ``replay`` / ``analyze`` / ``tail`` / ``health`` /
   ``bench-diff``.
 """

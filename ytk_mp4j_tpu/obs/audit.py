@@ -550,11 +550,19 @@ class ClusterAuditor:
         recs = {r: got[r] for r in sorted(got)}
         fams = {r: rec.get("fam") for r, rec in recs.items()}
         if len(set(fams.values())) > 1:
+            # the minority schedule's ranks are the implicated ones
+            fam_groups: dict = {}
+            for r, f in fams.items():
+                fam_groups.setdefault(f, []).append(r)
+            fam_major = max(fam_groups.values(), key=len)
+            dissent = [r for f, rs in fam_groups.items()
+                       if rs is not fam_major for r in rs]
             lines.append(self._flag(
                 seq, "schedule",
                 f"ranks disagree about collective #{seq}: "
                 + ", ".join(f"rank {r} ran {f!r}"
-                            for r, f in fams.items())))
+                            for r, f in fams.items()),
+                ranks=dissent))
             return lines
         fam = next(iter(fams.values()))
         errs = [r for r, rec in recs.items() if "err" in rec]
@@ -581,7 +589,8 @@ class ClusterAuditor:
                     f"collective #{seq} ({fam}): replicated outputs "
                     f"DIVERGE — minority rank(s) {minority} disagree "
                     f"with ranks {sorted(majority)} "
-                    f"({len(groups)} distinct digests)"))
+                    f"({len(groups)} distinct digests)",
+                    ranks=minority))
         if not lines:
             self.verified_total += 1
             if seq > self.verified_seq:
@@ -606,12 +615,19 @@ class ClusterAuditor:
                         f"{ent.get('t', '?')}: sent "
                         f"crc={sent[0]:#010x}/{sent[1]}B but received "
                         f"crc={rcvd[0]:#010x}/{rcvd[1]}B — bytes "
-                        "corrupted in flight"))
+                        "corrupted in flight",
+                        ranks=[a, b]))
         return lines
 
-    def _flag(self, seq: int, kind: str, msg: str) -> str:
+    def _flag(self, seq: int, kind: str, msg: str,
+              ranks: list[int] | tuple = ()) -> str:
+        """Record one divergence. ``ranks`` names the implicated
+        ranks structurally (minority / wire endpoints / schedule
+        dissenters) so the health plane (ISSUE 12) can escalate them
+        without parsing the human-readable message."""
         self.divergence_total += 1
-        self.divergences.append({"seq": seq, "kind": kind, "msg": msg})
+        self.divergences.append({"seq": seq, "kind": kind, "msg": msg,
+                                 "ranks": sorted(int(r) for r in ranks)})
         return f"audit: DIVERGENCE ({kind}) {msg}"
 
     # -- elastic membership (ISSUE 10) ----------------------------------

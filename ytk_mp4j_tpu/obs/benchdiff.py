@@ -44,6 +44,10 @@ THRESHOLDS: dict[str, float] = {
     # ISSUE 9: the durable sink armed on the headline leg — gated so
     # the background-drain tax cannot silently creep; same noise floor
     "socket_collective_gbs_sink_on": 0.25,
+    # ISSUE 12: the streaming health plane armed (slave span-cell
+    # folds + master detector set) on the headline leg — gated so the
+    # verdict engine's tax cannot silently creep; same noise floor
+    "socket_collective_gbs_health_on": 0.25,
     # ISSUE 11 (mp4j-async): k outstanding iallreduces on the
     # scheduler (overlap leg) and the tiny-map coalescing figure —
     # gated so neither the scheduler's dense cost nor the fused map

@@ -8,6 +8,7 @@ Usage::
     mp4j-scope postmortem /path/to/MP4J_POSTMORTEM_DIR
     mp4j-scope replay /path/to/BUNDLE_DIR
     mp4j-scope analyze /path/to/MP4J_SINK_DIR [--json]
+    mp4j-scope health /path/to/MP4J_SINK_DIR | http://master:PORT
     mp4j-scope tail /path/to/MP4J_SINK_DIR [--interval 1.0] [--once]
     mp4j-scope bench-diff BENCH_rA.json BENCH_rB.json [--threshold PCT]
     python -m ytk_mp4j_tpu.obs report ...
@@ -47,6 +48,13 @@ counts. ``tail`` follows the same directory live, printing each
 collective's timeline line as all ranks' records land (``--once``
 prints the current backlog and exits).
 
+``health`` (ISSUE 12) renders per-rank health verdicts: given a
+durable sink DIRECTORY it reconstructs the full verdict history from
+the ``alerts`` records (every transition, the first-degradation
+timeline, final verdicts); given a master URL it shows the live
+health document (current states, detector-pressure evidence,
+dominator window, recent alerts).
+
 ``bench-diff`` compares two ``bench.py`` JSON outputs against
 per-metric regression budgets (``obs.benchdiff``); exit 1 on a
 regression — the perf gate.
@@ -59,12 +67,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.error
 import urllib.request
 
-from ytk_mp4j_tpu.obs import (audit, benchdiff, critpath, postmortem,
+from ytk_mp4j_tpu.obs import (audit, benchdiff, critpath,
+                              health as health_mod, postmortem,
                               sink as sink_mod, spans, telemetry)
 
 
@@ -115,6 +125,15 @@ def _build_parser() -> argparse.ArgumentParser:
     an.add_argument("dir", help="sink dir (rank_*/seg_*.mp4j)")
     an.add_argument("--json", action="store_true",
                     help="emit the structured analysis as JSON")
+
+    hp = sub.add_parser("health",
+                        help="per-rank health verdicts: history from "
+                             "a sink dir, or live from a master URL")
+    hp.add_argument("target",
+                    help="a MP4J_SINK_DIR (verdict history) or a "
+                         "master metrics URL (current verdicts)")
+    hp.add_argument("--json", action="store_true",
+                    help="emit the raw health document/alert list")
 
     tl = sub.add_parser("tail",
                         help="follow a durable sink directory live, "
@@ -222,6 +241,27 @@ def _tail(args) -> int:
             return 0
 
 
+def _health(args) -> int:
+    """Verdict history from a sink dir, or current verdicts from a
+    live master (the ISSUE 12 operator view)."""
+    if os.path.isdir(args.target):
+        analysis = critpath.analyze(sink_mod.load_job(args.target))
+        alerts = analysis.get("health_alerts") or []
+        if args.json:
+            print(json.dumps(alerts, sort_keys=True, default=str))
+        else:
+            print(health_mod.format_history(alerts,
+                                            analysis["ranks"]))
+        return 0
+    doc = _fetch_doc(args.target)
+    hl = (doc.get("cluster") or {}).get("health")
+    if args.json:
+        print(json.dumps(hl, sort_keys=True, default=str))
+    else:
+        print(health_mod.format_status(hl or {}))
+    return 0
+
+
 def _live(args) -> int:
     while True:
         frame = telemetry.format_live(_fetch_doc(args.url))
@@ -255,6 +295,8 @@ def main(argv=None) -> int:
             return 1 if diverged else 0
         if args.cmd == "analyze":
             return _analyze(args)
+        if args.cmd == "health":
+            return _health(args)
         if args.cmd == "tail":
             return _tail(args)
         if args.cmd == "bench-diff":

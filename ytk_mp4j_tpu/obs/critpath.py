@@ -60,11 +60,13 @@ def collect(job: dict[int, dict]) -> dict:
     """Fold ``sink.load_job`` output into per-ordinal per-rank state:
     ``{"ordinals": {seq: {rank: {family, t0, dur, phases: {phase:
     secs}, links: {peer: {"secs", "transport"}}}}}, "ranks": [...],
-    "audit": [...], "recovery": {rank: [...]}, "torn": {rank: n},
-    "meta": {rank: {...}}}``."""
+    "audit": [...], "recovery": {rank: [...]}, "alerts": [...],
+    "torn": {rank: n}, "meta": {rank: {...}}}``."""
     ordinals: dict[int, dict[int, dict]] = {}
     audit_recs: list[dict] = []
     recovery: dict[int, list] = {}
+    alerts: list[dict] = []
+    seen_alerts: set = set()
     torn: dict[int, int] = {}
     meta: dict[int, dict] = {}
 
@@ -90,9 +92,20 @@ def collect(job: dict[int, dict]) -> dict:
             elif kind == "recovery":
                 recovery.setdefault(rank, []).extend(
                     rec.get("events", ()))
+            elif kind == "alerts":
+                # health-plane verdict events (ISSUE 12): dedup by the
+                # master's monotone alert id — an alert orphaned onto
+                # a fallback rank must not double in the timeline
+                for ev in rec.get("alerts", ()):
+                    key = ev.get("id")
+                    if key is not None and key in seen_alerts:
+                        continue
+                    seen_alerts.add(key)
+                    alerts.append(ev)
+    alerts.sort(key=lambda e: (e.get("wall") or 0, e.get("id") or 0))
     return {"ordinals": ordinals, "ranks": sorted(job),
-            "audit": audit_recs, "recovery": recovery, "torn": torn,
-            "meta": meta}
+            "audit": audit_recs, "recovery": recovery,
+            "alerts": alerts, "torn": torn, "meta": meta}
 
 
 def _fold_span(cell, rank: int, s: list) -> None:
@@ -302,15 +315,24 @@ def analyze(job: dict[int, dict]) -> dict:
         "phase_totals": phase_totals,
         "torn": state["torn"],
         "recovery": state["recovery"],
+        "health_alerts": state["alerts"],
         "audit_records": len(state["audit"]),
         "audit_errors": divergences,
         "meta": state["meta"],
     }
 
 
-def _fmt_wall(ts: float) -> str:
+def fmt_wall(ts) -> str:
+    """THE wall-timestamp formatter every obs report shares (analyze
+    rows, health timelines, postmortem sections) — one place for the
+    format, not three drifting copies."""
+    if not isinstance(ts, (int, float)):
+        return "?"
     return time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(ts)) \
         + f".{int(ts % 1 * 1000):03d}"
+
+
+_fmt_wall = fmt_wall
 
 
 def format_report(analysis: dict, root: str = "",
@@ -331,6 +353,7 @@ def format_report(analysis: dict, root: str = "",
         lines.append("(no attributable collectives — need collective "
                      "spans from >= 2 ranks; is the sink enabled and "
                      "MP4J_SPAN_RING > 0?)")
+        lines.extend(_health_lines(a))
         return "\n".join(lines)
 
     lines.append("")
@@ -365,6 +388,7 @@ def format_report(analysis: dict, root: str = "",
                 f"({_fmt_wall(ev['onset_wall'])}), "
                 f"{ev['share'] * 100:.0f}% of the window, "
                 f"cause {ev['cause']}")
+    lines.extend(_health_lines(a))
     for rank, events in sorted(a["recovery"].items()):
         if events:
             tail = "; ".join(f"{kind}({detail})" if detail else kind
@@ -384,6 +408,17 @@ def format_report(analysis: dict, root: str = "",
             f"{row['dur'] * 1e3:>8.2f} ms  gated by rank "
             f"{row['dominator']} ({cause})")
     return "\n".join(lines)
+
+
+def _health_lines(a: dict) -> list[str]:
+    """The health plane's durable verdict history (ISSUE 12): what
+    degraded first, when, and which detector saw it. Local import —
+    :mod:`health` imports this module for the online attribution."""
+    if not a.get("health_alerts"):
+        return []
+    from ytk_mp4j_tpu.obs import health as health_mod
+    return ["", *health_mod.format_history(
+        a["health_alerts"], a["ranks"]).splitlines()]
 
 
 def format_row(row: dict) -> str:

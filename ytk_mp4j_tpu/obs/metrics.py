@@ -54,6 +54,93 @@ LATENCY_BUCKETS = 36
 FRAME_LO = 64.0            # 64 B .. ~4.3 GB in 27 log2 buckets
 FRAME_BUCKETS = 27
 
+# ----------------------------------------------------------------------
+# THE metric catalogue (mp4j-lint R17 doc-drift guard): every metric
+# family — registry-internal flat names AND Prometheus series the
+# /metrics endpoint renders — must have a one-line entry here. A
+# ``<segment>`` marks a dynamic label segment (R17 prefix-matches it).
+# Registering or rendering a family absent from this table is a lint
+# error: an undocumented series is invisible to the operators the
+# metrics plane exists for.
+# ----------------------------------------------------------------------
+METRICS_DOC: dict[str, str] = {
+    # -- registry families (flat names inside MetricsRegistry) --------
+    "latency/<family>": "per-collective-family latency histogram "
+                        "(log2 buckets, seconds; ISSUE 6)",
+    "frame_bytes": "wire frame size histogram, untagged transports "
+                   "(log2 buckets, bytes)",
+    "frame_bytes/<transport>": "wire frame size histogram per "
+                               "transport (tcp/shm; ISSUE 7)",
+    "sink/bytes": "bytes the durable sink made safe on disk "
+                  "(ISSUE 9)",
+    "sink/records": "telemetry records the durable sink wrote",
+    "sink/dropped_records": "telemetry records the sink LOST (ring "
+                            "overflow, full disk, encode poison) — "
+                            "nonzero means an outage, never noise",
+    "sink/lag_secs": "seconds between the sink's last two drains",
+    "sink/dir_bytes": "bytes currently on disk in the rank's segment "
+                      "dir (bounded by MP4J_SINK_BYTES)",
+    "async/outstanding": "nonblocking collectives queued + in flight "
+                         "on this rank's scheduler (ISSUE 11)",
+    # -- Prometheus series (the /metrics endpoint) --------------------
+    "mp4j_ranks_reporting": "ranks whose heartbeats the master holds",
+    "mp4j_slave_num": "the job's configured rank count",
+    "mp4j_calls_total": "collective calls per rank and family",
+    "mp4j_bytes_sent_total": "payload bytes sent per rank and family",
+    "mp4j_bytes_recv_total": "payload bytes received per rank/family",
+    "mp4j_chunks_total": "pipeline chunks exchanged per rank/family",
+    "mp4j_keys_total": "map entries encoded columnar per rank/family",
+    "mp4j_retries_total": "epoch-fenced retry rounds per rank/family",
+    "mp4j_reconnects_total": "peer re-dials during recovery",
+    "mp4j_aborts_seen_total": "abort rounds this rank tore down for",
+    "mp4j_wire_bytes_tcp_total": "wire bytes moved over TCP",
+    "mp4j_wire_bytes_shm_total": "wire bytes moved over shm rings",
+    "mp4j_phase_seconds_total": "busy seconds per rank, family and "
+                                "phase (wire/reduce/serialize)",
+    "mp4j_rank_seq": "per-rank outermost collective sequence number",
+    "mp4j_heartbeat_age_seconds": "seconds since each rank's last "
+                                  "heartbeat arrived",
+    "mp4j_rank_<rate>": "per-rank sliding-window rates "
+                        "(bytes/collectives/keys per second)",
+    "mp4j_cluster_<rate>": "cluster sliding-window rates",
+    "mp4j_audit_divergences_total": "cross-rank digest divergences "
+                                    "flagged (ISSUE 8)",
+    "mp4j_audit_verified_seqs": "collective ordinals verified "
+                                "bit-identical across ranks",
+    "mp4j_audit_verified_seq_watermark": "highest cross-rank-verified "
+                                         "ordinal (the known-good "
+                                         "watermark)",
+    "mp4j_replacements_total": "dead ranks replaced from warm spares "
+                               "(ISSUE 10)",
+    "mp4j_shrinks_total": "shrink rounds survived",
+    "mp4j_spares_available": "idle warm spares registered now",
+    "mp4j_sink_bytes_total": "durable-sink bytes per rank + cluster",
+    "mp4j_sink_records_total": "durable-sink records per rank",
+    "mp4j_sink_dropped_records_total": "durable-sink records LOST per "
+                                       "rank — alert on growth",
+    "mp4j_sink_lag_seconds": "per-rank sink drain lag",
+    "mp4j_outstanding_collectives": "nonblocking collectives in "
+                                    "flight per rank + cluster",
+    "mp4j_collective_latency_seconds": "cluster latency histogram per "
+                                       "collective family",
+    "mp4j_frame_bytes": "cluster wire frame size histogram "
+                        "(transport-labelled)",
+    # -- health plane (ISSUE 12) --------------------------------------
+    "mp4j_rank_health_state": "per-rank health verdict (0 HEALTHY, "
+                              "1 DEGRADED, 2 SUSPECT, "
+                              "3 EVICT_RECOMMENDED, 4 DEAD)",
+    "mp4j_alerts_total": "health alerts emitted per rank and "
+                         "detector — any growth is a story",
+    "mp4j_evict_recommended": "ranks the health plane currently "
+                              "recommends evicting (it never acts)",
+    "mp4j_straggler_onsets_total": "straggler onsets the online "
+                                   "dominator detected (ISSUE 9's "
+                                   "offline onset events, live)",
+    "mp4j_critpath_dominator": "per-rank share of recently attributed "
+                               "ordinals this rank gated (sliding "
+                               "window)",
+}
+
 
 def bucket_edges(lo: float, n: int) -> list[float]:
     """The ``n`` finite upper edges ``[lo, 2*lo, ..., lo * 2**(n-1)]``
@@ -452,6 +539,45 @@ def to_prometheus(doc: dict) -> str:
             f"{_fmt(total_out)}")
         out.append("# TYPE mp4j_outstanding_collectives gauge")
         out.extend(out_block)
+
+    # health plane (ISSUE 12): per-rank verdict gauge, per-(rank,
+    # detector) alert counter, the evict recommendation count, and the
+    # online dominator's onset counter + window-share gauge — present
+    # whenever the master runs the health engine (MP4J_HEALTH=1, the
+    # default), absent entirely when disabled (no zero-noise)
+    hl = doc.get("cluster", {}).get("health")
+    if hl is not None:
+        out.append("# TYPE mp4j_rank_health_state gauge")
+        for r, e in sorted((hl.get("ranks") or {}).items(),
+                           key=lambda kv: int(kv[0])):
+            out.append(f'mp4j_rank_health_state{{rank="{_esc(r)}"}} '
+                       f"{int(e.get('state_code', 0))}")
+        alert_block = []
+        for r, e in sorted((hl.get("ranks") or {}).items(),
+                           key=lambda kv: int(kv[0])):
+            for det, n in sorted((e.get("alerts") or {}).items()):
+                if n:
+                    alert_block.append(
+                        f'mp4j_alerts_total{{rank="{_esc(r)}",'
+                        f'detector="{_esc(det)}"}} {int(n)}')
+        if alert_block:
+            out.append("# TYPE mp4j_alerts_total counter")
+            out.extend(alert_block)
+        out.append("# TYPE mp4j_evict_recommended gauge")
+        out.append(f"mp4j_evict_recommended "
+                   f"{len(hl.get('evict_recommended') or ())}")
+        dom = hl.get("dominator") or {}
+        out.append("# TYPE mp4j_straggler_onsets_total counter")
+        out.append(f"mp4j_straggler_onsets_total "
+                   f"{int(dom.get('onsets', 0))}")
+        shares = dom.get("shares") or {}
+        if shares:
+            out.append("# TYPE mp4j_critpath_dominator gauge")
+            for r, s in sorted(shares.items(),
+                               key=lambda kv: int(kv[0])):
+                out.append(
+                    f'mp4j_critpath_dominator{{rank="{_esc(r)}"}} '
+                    f"{_fmt(float(s))}")
 
     out.append("# TYPE mp4j_collective_latency_seconds histogram")
     hists = doc.get("cluster", {}).get("histograms", {})

@@ -35,6 +35,7 @@ import os
 import time
 
 from ytk_mp4j_tpu.obs import spans, telemetry
+from ytk_mp4j_tpu.obs.critpath import fmt_wall as _fmt_wall
 
 _BUNDLE_FILES = ("trace.json", "stats.json", "metrics.json",
                  "recovery.json", "audit.json", "sink.json")
@@ -97,7 +98,8 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
                           diagnosis: list[str],
                           audit: dict | None = None,
                           sink_dir: str | None = None,
-                          membership: dict | None = None) -> str:
+                          membership: dict | None = None,
+                          health: dict | None = None) -> str:
     """The master's cluster-level half of the recorder: who the job
     thought was alive, why it died, and the final heartbeat table
     (fresh — the slaves' fatal-path telemetry flush lands before the
@@ -108,7 +110,10 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
     can join full-job segment history; ``membership`` (ISSUE 10)
     records the elastic mode, spare availability and full
     replacement/shrink history so the report covers every roster the
-    job ever ran under."""
+    job ever ran under; ``health`` (ISSUE 12) freezes the health
+    plane's final verdicts — per-rank state, the first-degradation
+    event and the recent alert tail — so the report can answer *what
+    degraded first, when, and which detector saw it*."""
     os.makedirs(root, exist_ok=True)
     path = os.path.join(root, "manifest.json")
     _dump(root, "manifest.json", {
@@ -119,6 +124,7 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
         "audit": audit,
         "sink_dir": sink_dir or None,
         "membership": membership,
+        "health": health,
         "table": {str(r): t for r, t in table.items()},
         # mp4j-lint: disable=R11 (artifact timestamp, not a duration)
         "wall_time": time.time(),
@@ -129,6 +135,7 @@ def write_master_manifest(root: str, *, slave_num: int, reason: str,
 # ----------------------------------------------------------------------
 # merged report (the ``mp4j-scope postmortem`` command)
 # ----------------------------------------------------------------------
+
 def load_bundles(root: str) -> dict[int, dict]:
     """Read every COMPLETE bundle under ``root``; returns
     ``{rank: {"stats": ..., "recovery": ..., "metrics": ...,
@@ -216,6 +223,40 @@ def merge_report(root: str) -> str:
                     f"membership event: SHRUNK, dropped "
                     f"{ev.get('dead')} @ epoch {ev.get('epoch')} "
                     f"({ev.get('why')})")
+
+    # health timeline (ISSUE 12): what degraded first, when, and which
+    # detector saw it — the manifest froze the engine's final verdicts
+    # at abort time (the durable sink join below carries the FULL
+    # alert history when the job ran with a sink)
+    health = (manifest or {}).get("health") or {}
+    if health.get("ranks"):
+        verdicts = ", ".join(
+            f"rank {r}: {e.get('state')}"
+            for r, e in sorted(health["ranks"].items(), key=lambda kv:
+                               int(kv[0]))
+            if e.get("state") != "HEALTHY")
+        lines.append("health verdicts at abort time: "
+                     + (verdicts or "all reporting ranks HEALTHY"))
+        fd = health.get("first_degraded")
+        if fd:
+            lines.append(
+                f"health: first degradation was rank {fd.get('rank')} "
+                f"-> {fd.get('to')} via {fd.get('detector')} at "
+                f"{_fmt_wall(fd.get('wall'))}"
+                + (f" (collective #{fd['seq']})" if fd.get("seq")
+                   else "") + f": {fd.get('msg', '')}")
+        for ev in health.get("last_alerts") or []:
+            lines.append(
+                f"health alert: rank {ev.get('rank')} "
+                f"{ev.get('from')} -> {ev.get('to')} "
+                f"({ev.get('detector')}) at "
+                f"{_fmt_wall(ev.get('wall'))}: "
+                f"{ev.get('msg', '')}")
+        evict = health.get("evict_recommended") or []
+        if evict:
+            lines.append(
+                f"health: EVICT was recommended for rank(s) "
+                f"{', '.join(map(str, evict))} before the fatal")
 
     # known-good watermark (ISSUE 8): the last collective ordinal the
     # master cross-rank-verified before the fatal — everything up to

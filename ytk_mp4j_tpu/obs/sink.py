@@ -48,7 +48,11 @@ Record payloads (``{"t": kind, ...}``):
 - ``metrics`` — a metrics-registry delta (``metrics.diff_snapshot``);
 - ``audit``   — a batch of audit digest records (capture payloads
   excluded — the sink is telemetry, the bundle carries bytes);
-- ``recovery``— a batch of recovery events, plus this rank's epoch.
+- ``recovery``— a batch of recovery events, plus this rank's epoch;
+- ``alerts``  — a batch of health-plane alert events (ISSUE 12):
+  per-rank verdict transitions and straggler onsets the master pushed
+  to this rank, each with an id/wall/detector/from/to — the durable
+  half of the ``mp4j-scope health`` timeline.
 
 The offline half — :func:`iter_segment`, :func:`read_rank`,
 :func:`load_job` — feeds :mod:`ytk_mp4j_tpu.obs.critpath` (the
@@ -132,6 +136,8 @@ def _record_count(rec: dict) -> int:
         return len(rec.get("records") or ()) or 1
     if kind == "recovery":
         return len(rec.get("events") or ()) or 1
+    if kind == "alerts":
+        return len(rec.get("alerts") or ()) or 1
     return 1
 
 
@@ -268,7 +274,7 @@ class SinkWriter:
 
     def __init__(self, root: str, rank: int, *, slave_num: int = 0,
                  stats=None, audit=None, recovery=None, metrics=None,
-                 budget_bytes: int | None = None,
+                 alerts=None, budget_bytes: int | None = None,
                  flush_secs: float | None = None):
         self.root = str(root)
         self.rank = int(rank)
@@ -277,6 +283,9 @@ class SinkWriter:
         self._stats = stats
         self._audit = audit
         self._recovery = recovery
+        # health-alert log (ISSUE 12): same cursor-delta contract as
+        # the audit ring and recovery event log
+        self._alerts = alerts
         self._metrics = metrics if metrics is not None else (
             stats.metrics if stats is not None else None)
         self.budget = (tuning.sink_bytes() if budget_bytes is None
@@ -299,6 +308,7 @@ class SinkWriter:
         self._span_cur = spans.oldest_cursor()
         self._audit_cur = 0
         self._rec_cur = 0
+        self._alert_cur = 0
         self._last_stats: dict = {}
         self._last_metrics: dict = {}
         self._last_drain = time.monotonic()
@@ -432,6 +442,12 @@ class SinkWriter:
                              "epoch": self._recovery.epoch,
                              "events": [[round(ts, 6), kind, detail]
                                         for ts, kind, detail in events]})
+        if self._alerts is not None:
+            self._alert_cur, evs, d = self._alerts.events_since(
+                self._alert_cur)
+            dropped += d
+            if evs:
+                recs.append({"t": "alerts", "alerts": evs})
         if recs:
             try:
                 dropped += self._write_records(recs)
@@ -513,7 +529,7 @@ class SinkWriter:
     # span batches, and an unsplit oversized batch would defeat the
     # budget bound for small MP4J_SINK_BYTES just the same
     _SPLIT_KEYS = {"spans": "spans", "audit": "records",
-                   "recovery": "events"}
+                   "recovery": "events", "alerts": "alerts"}
 
     def _encode_bounded(self, rec: dict, cap: int,
                         out: list[tuple[bytes, int]]) -> int:

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import statistics
 
+from ytk_mp4j_tpu.obs.health import SHORT_BY_NAME as _STATE_SHORT
+
 _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
 
 
@@ -100,10 +102,14 @@ def format_live(doc: dict) -> str:
     """The ``mp4j-scope live`` frame: one view of a master metrics
     document (``Master.metrics_doc`` / the ``/metrics.json``
     endpoint) — cluster rates, then one row per rank with throughput,
-    current collective, sequence lag, retry count and heartbeat age.
-    Stragglers (the busy-max ranks of any collective family, same rule
-    as :func:`cluster_skew`) are marked ``*``; ranks behind the max
-    sequence number show their lag."""
+    current collective, sequence lag, retry count, health verdict and
+    heartbeat age. Stragglers (the busy-max ranks of any collective
+    family, same rule as :func:`cluster_skew`) are marked ``*``; ranks
+    behind the max sequence number show their lag. The whole table
+    stays within 120 columns. A rank whose heartbeat is older than 2x
+    the heartbeat period renders ``stale`` in its derived rate column
+    — the master's rate window freezes at the last fold, and a wedged
+    rank must not display a healthy-looking throughput (ISSUE 12)."""
     ranks = doc.get("ranks", {})
     cl = doc.get("cluster", {})
     rates = cl.get("rates", {})
@@ -144,6 +150,29 @@ def format_live(doc: dict) -> str:
             else:
                 head += (f"\n  last: SHRUNK, dropped {ev.get('dead')} "
                          f"@ epoch {ev.get('epoch')}")
+    # health head-line (ISSUE 12): only when the plane has something
+    # to say — any alert ever, or any rank off HEALTHY right now
+    hl = cl.get("health") or {}
+    hl_states = {r: e.get("state", "HEALTHY")
+                 for r, e in (hl.get("ranks") or {}).items()}
+    if hl.get("alerts_total") or any(s != "HEALTHY"
+                                     for s in hl_states.values()):
+        bad = ", ".join(f"rank {r} {s}" for r, s in
+                        sorted(hl_states.items(), key=lambda kv:
+                               int(kv[0])) if s != "HEALTHY")
+        head += (f"\nhealth: {hl.get('alerts_total', 0)} alert(s)"
+                 + (f" | {bad}" if bad else " | all HEALTHY again"))
+        evict = hl.get("evict_recommended") or []
+        if evict:
+            head += (" | EVICT recommended: "
+                     + ",".join(map(str, evict)))
+        last = hl.get("last_alerts") or []
+        if last:
+            ev = last[-1]
+            head += (f"\n  last: rank {ev.get('rank')} "
+                     f"{ev.get('from')}->{ev.get('to')} "
+                     f"({ev.get('detector')}) "
+                     f"{str(ev.get('msg', ''))[:60]}")
     if not ranks:
         return head + "\n(no rank telemetry yet)"
     skew = cluster_skew({int(r): info.get("stats", {})
@@ -152,16 +181,18 @@ def format_live(doc: dict) -> str:
     stragglers = {r for s in skew.values() for r in s["stragglers"]}
     max_seq = max(info.get("progress", {}).get("seq", 0)
                   for info in ranks.values())
+    hb_secs = float(doc.get("hb_secs") or 0.0)
     lines = [head,
-             f"{'rank':>4}  {'seq':>5}  {'lag':>4}  {'ep':>3}  "
-             f"{'state':<34}  {'MB/s':>8}  {'shm%':>5}  {'ovl%':>5}  "
-             f"{'aud':>5}  {'sink':>7}  {'retries':>7}  "
-             f"{'roster':<14}  hb age"]
+             f"{'rank':>4} {'seq':>5} {'lag':>3} {'ep':>2}  "
+             f"{'state':<32} {'MB/s':>8} {'shm%':>4} {'ovl%':>4} "
+             f"{'aud':>5} {'sink':>6} {'rtry':>4} {'health':>6}  "
+             f"{'roster':<8}  hb age"]
     for r in sorted(ranks, key=int):
         info = ranks[r]
         prog = info.get("progress", {})
         seq = prog.get("seq", 0)
         lag = max_seq - seq
+        age = float(info.get("age", 0.0))
         if prog.get("current"):
             state = (f"in {prog['current']} "
                      f"({prog.get('current_secs', 0.0):.1f}s"
@@ -208,17 +239,28 @@ def format_live(doc: dict) -> str:
         # or SHRUNK into a new number this job
         epoch = prog.get("epoch") or 0
         badge = badges.get(str(r), "-")
+        # health column (ISSUE 12): the rank's current verdict, "-"
+        # when the master runs without the health plane
+        health_col = _STATE_SHORT.get(hl_states.get(str(r)), "-")
+        # stale-heartbeat annotation (ISSUE 12 satellite): the rate
+        # column is DERIVED from the rank's last fold — render the
+        # fact that it is history, not throughput, once the beat is
+        # 2x the heartbeat period late
+        stale = hb_secs > 0 and age > 2.0 * hb_secs
+        mbs = ("stale" if stale else
+               f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:.2f}")
         lines.append(
-            f"{mark}{r:>3}  {seq:>5}  {lag if lag else '-':>4}  "
-            f"{epoch if epoch else '-':>3}  "
-            f"{state:<34.34}  "
-            f"{info.get('rates', {}).get('bytes_per_sec', 0.0) / 1e6:>8.2f}  "
-            f"{shm_pct:>5}  "
-            f"{ovl_pct:>5}  "
-            f"{aud if aud else '-':>5}  "
-            f"{sink_col:>7}  "
-            f"{retries:>7}  "
-            f"{badge:<14.14}  {info.get('age', 0.0):.1f}s")
+            f"{mark}{r:>3} {seq:>5} {lag if lag else '-':>3} "
+            f"{epoch if epoch else '-':>2}  "
+            f"{state:<32.32} "
+            f"{mbs:>8} "
+            f"{shm_pct:>4} "
+            f"{ovl_pct:>4} "
+            f"{aud if aud else '-':>5} "
+            f"{sink_col:>6} "
+            f"{retries:>4} "
+            f"{health_col:>6}  "
+            f"{badge:<8.8}  {age:.1f}s")
     return "\n".join(lines)
 
 

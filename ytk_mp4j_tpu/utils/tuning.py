@@ -142,6 +142,24 @@ Knobs (all validated where they are consumed; garbage raises
   queued + in flight per slave before ``i*`` submission blocks
   (backpressure); also caps the engine batch and the coalescing
   fuse depth.
+- ``MP4J_HEALTH`` — the streaming health plane (ISSUE 12;
+  ``obs/health.py``): ``1``/``on`` (default) has every slave fold its
+  span-ring delta into per-ordinal cells on the heartbeat and the
+  master run the detector set (online critpath dominance, latency
+  drift, storms, sink outages, backlog growth, heartbeat flapping,
+  audit escalation) driving per-rank HEALTHY -> DEGRADED -> SUSPECT ->
+  EVICT_RECOMMENDED verdicts; ``0``/``off`` disables both sides — the
+  bench A/B knob and the frozen-leg pin (the shm/audit/sink
+  precedent).
+- ``MP4J_HEALTH_WINDOW`` — sliding window (attributed collective
+  ordinals) the online dominator computes dominance shares over.
+- ``MP4J_HEALTH_DOMINATOR_ORDINALS`` — consecutive slow ordinals one
+  rank must gate before the engine recommends eviction (the ROADMAP
+  autoscaler contract: "dominator for 500 consecutive ordinals should
+  be evictable"); SUSPECT is forced at half this streak.
+- ``MP4J_HEALTH_DRIFT_PCT`` — how far (percent) a rank's per-family
+  latency must rise above its OWN rolling baseline — with the log2-
+  histogram bucket shift confirming — before the drift detector fires.
 """
 
 from __future__ import annotations
@@ -607,6 +625,62 @@ def max_outstanding() -> int:
     ``MP4J_ASYNC=0``, not a zero window."""
     return env_int("MP4J_MAX_OUTSTANDING", DEFAULT_MAX_OUTSTANDING,
                    minimum=1)
+
+
+# Health-plane defaults (ISSUE 12): default-on like the metrics plane
+# (the slave side is one span-ring delta fold per heartbeat, the
+# master side a handful of dict updates per beat). The dominator
+# eviction threshold is the ROADMAP's verbatim contract; the drift
+# threshold is one full log2 histogram bucket (2x) so scheduler noise
+# on microsecond collectives never reads as degradation.
+DEFAULT_HEALTH_WINDOW = 64
+DEFAULT_HEALTH_DOMINATOR_ORDINALS = 500
+DEFAULT_HEALTH_DRIFT_PCT = 100.0
+
+
+def health_enabled(override=None) -> bool:
+    """Whether the streaming health plane runs (``MP4J_HEALTH``).
+    ``override`` is the explicit constructor arg
+    (``Master(health=...)`` / ``ProcessCommSlave(health=...)``) — it
+    bypasses the env read but gets the SAME validation (one validator
+    per knob, the PR 5 discipline). JOB-wide in practice: a slave with
+    it off simply never ships health deltas, so its dominator cells
+    are missing and the master attributes nothing — run every rank
+    with the same value."""
+    if override is not None:
+        return bool(override)
+    raw = os.environ.get("MP4J_HEALTH")
+    if raw is None or raw.strip() == "":
+        return True
+    val = raw.strip().lower()
+    if val not in ("on", "off", "0", "1"):
+        raise Mp4jError(
+            f"MP4J_HEALTH={raw!r} must be one of on/off/0/1")
+    return val in ("on", "1")
+
+
+def health_window() -> int:
+    """Sliding window, in attributed collective ordinals, for the
+    online dominator's dominance shares (``MP4J_HEALTH_WINDOW``)."""
+    return env_int("MP4J_HEALTH_WINDOW", DEFAULT_HEALTH_WINDOW,
+                   minimum=4)
+
+
+def health_dominator_ordinals() -> int:
+    """Consecutive slow dominated ordinals before the engine
+    recommends eviction (``MP4J_HEALTH_DOMINATOR_ORDINALS``); SUSPECT
+    is forced at half this streak. Must be >= 2 — a single ordinal is
+    noise, not a verdict."""
+    return env_int("MP4J_HEALTH_DOMINATOR_ORDINALS",
+                   DEFAULT_HEALTH_DOMINATOR_ORDINALS, minimum=2)
+
+
+def health_drift_pct() -> float:
+    """Percent above a rank's own latency baseline before the drift
+    detector fires (``MP4J_HEALTH_DRIFT_PCT``); must be positive —
+    disabling the plane is ``MP4J_HEALTH=0``, not a zero threshold."""
+    return env_float("MP4J_HEALTH_DRIFT_PCT", DEFAULT_HEALTH_DRIFT_PCT,
+                     minimum=1.0)
 
 
 def fault_plan_spec() -> str:
