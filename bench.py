@@ -40,6 +40,7 @@ import json
 import os
 import queue as pyqueue
 import sys
+import threading
 import time
 
 import numpy as np
@@ -160,8 +161,10 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
     # frozen legs pin MP4J_ELASTIC=off, the nonblocking scheduler off
     # and the health plane off (the shm/audit/sink precedent):
     # historical figures stay comparable whatever the caller's env
-    # says; the async/health legs opt back in explicitly
-    mk = {"elastic": "off", "health": False}
+    # says; the async/health legs opt back in explicitly. autoscale
+    # joins the pin list (ISSUE 13): a frozen figure must not move
+    # because an operator exported MP4J_AUTOSCALE=act
+    mk = {"elastic": "off", "health": False, "autoscale": "off"}
     mk.update(master_kwargs or {})
     master = Master(procs, timeout=60.0, **mk).serve_in_thread()
     q = ctx.Queue()
@@ -597,25 +600,36 @@ def bench_socket_recovery_latency(procs=4, reps=9, size=262_144):
 
 
 def _run_elastic_job(procs, body, fault_plan, elastic, spare_body=None,
-                     join_timeout=120.0, **slave_kwargs):
+                     join_timeout=120.0, master_kwargs=None,
+                     trigger=None, **slave_kwargs):
     """Master + ``procs`` worker PROCESSES under an elastic mode, plus
     one warm-spare process when ``spare_body`` is given (ISSUE 10).
     Workers that die to an injected kill report ``("killed", rank)``;
-    the spare reports under its adopted rank. Returns ``(results,
-    killed_ranks)`` with results keyed by FINAL rank."""
+    workers released by a planned eviction (ISSUE 13) report
+    ``("evicted", rank)``; the spare reports under its adopted rank.
+    ``trigger(master)`` (ISSUE 13) runs on a daemon thread after the
+    master starts — the planned-evict leg drives the actuation from
+    it. Returns ``(results, killed_ranks)`` with results keyed by
+    FINAL rank (evicted ranks count in ``killed`` — they left the
+    roster either way)."""
     import multiprocessing as mp
 
     from ytk_mp4j_tpu.comm.master import Master
     from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+    from ytk_mp4j_tpu.exceptions import Mp4jEvicted
     from ytk_mp4j_tpu.resilience.faults import FaultKill
 
     ctx = mp.get_context("fork")
     # frozen-leg pin (the shm/audit/sink/async precedent): the
     # replacement/shrink latency figures predate the health plane and
-    # must not drift with MP4J_HEALTH
+    # the autoscaler, and must not drift with MP4J_HEALTH or
+    # MP4J_AUTOSCALE; the evict/grow legs opt back in via
+    # master_kwargs
+    mk = {"health": False, "autoscale": "off"}
+    mk.update(master_kwargs or {})
     master = Master(procs, timeout=60.0, elastic=elastic,
                     spares=1 if spare_body is not None else 0,
-                    adopt_secs=15.0, health=False).serve_in_thread()
+                    adopt_secs=15.0, **mk).serve_in_thread()
     q = ctx.Queue()
     slave_kwargs.setdefault("health", False)
 
@@ -630,6 +644,10 @@ def _run_elastic_job(procs, body, fault_plan, elastic, spare_body=None,
                 res = body(slave, slave.rank)
             except FaultKill:
                 q.put(("killed", start_rank, None))
+                return
+            except Mp4jEvicted:
+                slave.close(0)
+                q.put(("evicted", start_rank, None))
                 return
             q.put(("ok", slave.rank, res))
             slave.close(0)
@@ -653,6 +671,9 @@ def _run_elastic_job(procs, body, fault_plan, elastic, spare_body=None,
         ps.append(ctx.Process(target=spare_worker, daemon=True))
     for p in ps:
         p.start()
+    if trigger is not None:
+        threading.Thread(target=trigger, args=(master,),
+                         daemon=True).start()
     expected = len(ps)
     results: dict[int, object] = {}
     killed: list[int] = []
@@ -675,7 +696,7 @@ def _run_elastic_job(procs, body, fault_plan, elastic, spare_body=None,
             for p in ps:
                 p.terminate()
             raise RuntimeError(f"elastic benchmark worker: {payload}")
-        if kind == "killed":
+        if kind in ("killed", "evicted"):
             killed.append(rank)
         else:
             results[rank] = payload
@@ -750,6 +771,102 @@ def bench_socket_replacement_latency(procs=4, reps=9):
             (per_iter[fault_at - 1] - median) * 1e3, 3),
         "healthy_iter_ms": round(median * 1e3, 3),
         "spare_iters": len(results[1]),
+    }
+
+
+def bench_socket_planned_evict_ms(procs=4, reps=11):
+    """ISSUE 13 actuation workload: mid-loop, the master is asked to
+    PLANNED-EVICT live rank 1 (the autoscaler's actuation API,
+    detection excluded — detection latency is a pure function of
+    MP4J_HEALTH_DOMINATOR_ORDINALS x iteration time, a config choice,
+    not a protocol cost). Measured: the boundary fence + abort round
+    + manifest + spare adoption + first post-adoption collective, as
+    the worst faulted iteration's wall time over the healthy median
+    on the survivors. Asserts the eviction actually landed."""
+    body, spare_body = _timed_elastic_loop(reps)
+
+    def trigger(master):
+        # fire as soon as the request is accepted (rendezvous seated,
+        # spare pooled): the boundary fence quiesces at whichever
+        # iteration comes next — the figure is the actuation cost,
+        # independent of WHICH iteration pays it (argmax below)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if master.request_planned_evict(1, "bench actuation"):
+                return
+            time.sleep(0.002)
+
+    results, killed = _run_elastic_job(
+        procs, body, None, "replace", spare_body=spare_body,
+        trigger=trigger, shm=False, audit="off", sink_dir="")
+    if killed != [1] or len(results) != procs:
+        raise RuntimeError(
+            f"planned-evict bench: expected rank 1 evicted + {procs} "
+            f"finishers, got evicted={killed} results={sorted(results)}")
+    survivors = [r for r in range(procs) if r != 1]
+    per_iter = [max(results[r][k] for r in survivors)
+                for k in range(reps)]
+    ordered = sorted(per_iter)
+    median = ordered[len(ordered) // 2]
+    worst = max(per_iter)
+    return {
+        "planned_evict_ms": round((worst - median) * 1e3, 3),
+        "healthy_iter_ms": round(median * 1e3, 3),
+        "spare_iters": len(results[1]),
+    }
+
+
+def bench_socket_grow_latency_ms(procs=2, reps=9):
+    """ISSUE 13 grow workload: mid-loop every rank hits
+    ``resize_point()`` with one registered spare and
+    MP4J_ELASTIC=grow + MP4J_AUTOSCALE=act — the roster EXPANDS to
+    procs+1 and the loop continues at the new n. Measured: the
+    resize_point wall time itself (fence-free quiesce + adoption +
+    roster release), max over the pre-existing ranks."""
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    size = 262_144
+    grow_at = reps // 2 + 1
+
+    def body(slave, r):
+        buf = np.ones(size, np.float32)
+        out = {"iters": [], "resize_ms": None, "grown_n": None}
+        for k in range(reps):
+            if k == grow_at:
+                t0 = time.perf_counter()
+                roster = slave.resize_point()
+                out["resize_ms"] = (time.perf_counter() - t0) * 1e3
+                out["grown_n"] = len(roster)
+            slave.barrier()
+            t0 = time.perf_counter()
+            slave.allreduce_array(buf, Operands.FLOAT, Operators.SUM)
+            out["iters"].append(time.perf_counter() - t0)
+        return out
+
+    def spare_body(sp):
+        buf = np.ones(size, np.float32)
+        for k in range(sp.resume_seq, reps):
+            sp.barrier()
+            sp.allreduce_array(buf, Operands.FLOAT, Operators.SUM)
+        return {"iters": [], "resize_ms": None,
+                "grown_n": sp.slave_num}
+
+    results, killed = _run_elastic_job(
+        procs, body, None, "grow", spare_body=spare_body,
+        master_kwargs={"autoscale": "act", "autoscale_cooldown": 0.0},
+        shm=False, audit="off", sink_dir="")
+    if killed or len(results) != procs + 1:
+        raise RuntimeError(
+            f"grow bench: expected {procs + 1} finishers, got "
+            f"killed={killed} results={sorted(results)}")
+    grown = [results[r]["grown_n"] for r in range(procs)]
+    if any(g != procs + 1 for g in grown):
+        raise RuntimeError(f"grow bench: roster did not grow: {grown}")
+    return {
+        "grow_latency_ms": round(
+            max(results[r]["resize_ms"] for r in range(procs)), 3),
+        "grown_n": procs + 1,
     }
 
 
@@ -1215,6 +1332,8 @@ def main():
     recovery, recovery_stats = bench_socket_recovery_latency()
     replacement = bench_socket_replacement_latency()
     shrinkage = bench_socket_shrink_latency()
+    planned_evict = bench_socket_planned_evict_ms()
+    grow = bench_socket_grow_latency_ms()
     (tpu_gbs, trees_per_sec, n_chips, gbdt_fps,
      gbdt_hist_fps) = bench_tpu(n=n_tpu)
     ffm_steps, ffm_fps = bench_ffm_tpu()
@@ -1314,8 +1433,17 @@ def main():
                 "replacement_latency_ms"],
             "socket_shrink_latency_ms": shrinkage[
                 "shrink_latency_ms"],
+            # ISSUE 13: actuation latencies — planned evict (fence ->
+            # round -> adoption -> first post-adoption collective,
+            # detection excluded by design) and grow (resize_point
+            # wall time). Frozen legs elsewhere pin MP4J_AUTOSCALE=off
+            "socket_planned_evict_ms": planned_evict[
+                "planned_evict_ms"],
+            "socket_grow_latency_ms": grow["grow_latency_ms"],
             "socket_elastic": {"replace": replacement,
-                               "shrink": shrinkage},
+                               "shrink": shrinkage,
+                               "planned_evict": planned_evict,
+                               "grow": grow},
             # merged cross-rank comm.stats() snapshot per socket
             # workload: where the wire/reduce/serialize budget actually
             # went (schema: ytk_mp4j_tpu/utils/stats.py)

@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 18)]
+    assert ids == [f"R{i}" for i in range(1, 19)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1322,6 +1322,89 @@ def test_r17_inline_suppression():
         def book(self):
             # mp4j-lint: disable=R17 (experimental series)
             self._metrics.inc("lab/experiment", 1)
+    """)
+    assert not r.findings and len(r.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# R18 — bare time.sleep() inside a while loop (control code)
+# ----------------------------------------------------------------------
+def test_r18_fires_on_sleep_in_while_loop():
+    r = run_rule("R18", """
+        import time
+        def loop(self):
+            while not self._stop_flag:
+                self._tick()
+                time.sleep(0.5)
+    """)
+    [f] = r.findings
+    assert f.rule == "R18" and "Event.wait" in f.message
+
+
+def test_r18_fires_in_nested_while_and_for():
+    r = run_rule("R18", """
+        import time
+        def loop(items):
+            while True:
+                for it in items:
+                    time.sleep(0.1)
+    """)
+    assert [f.rule for f in r.findings] == ["R18"]
+
+
+def test_r18_quiet_on_event_wait():
+    r = run_rule("R18", """
+        def loop(self):
+            while not self._stop.wait(0.5):
+                self._tick()
+    """)
+    assert not r.findings
+
+
+def test_r18_quiet_on_sleep_outside_loops():
+    # a one-shot settle delay is pacing a single step, not a loop
+    r = run_rule("R18", """
+        import time
+        def settle(self):
+            time.sleep(0.1)
+            for _ in range(3):
+                time.sleep(0.1)
+    """)
+    assert not r.findings
+
+
+def test_r18_quiet_outside_covered_dirs():
+    r = run_rule("R18", """
+        import time
+        def loop():
+            while True:
+                time.sleep(1.0)
+    """, path="ytk_mp4j_tpu/models/snippet.py")
+    assert not r.findings
+
+
+def test_r18_nested_def_resets_loop_tracking():
+    # the closure's sleep runs on ITS schedule, not per-iteration of
+    # the enclosing while
+    r = run_rule("R18", """
+        import time
+        def outer(self):
+            while True:
+                def cb():
+                    time.sleep(0.1)
+                self._submit(cb)
+                break
+    """)
+    assert not r.findings
+
+
+def test_r18_inline_suppression():
+    r = run_rule("R18", """
+        import time
+        def backoff(self):
+            while self._retrying():
+                # mp4j-lint: disable=R18 (bounded data-plane backoff)
+                time.sleep(self._backoff)
     """)
     assert not r.findings and len(r.suppressed) == 1
 

@@ -1,0 +1,920 @@
+"""mp4j-autopilot (ISSUE 13): the closed-loop elastic autoscaler.
+
+Three layers, mirroring the module's design:
+
+- **policy units** — the pure core (``decide`` / ``gate`` /
+  ``resolve_pending`` / ``audit_green``) driven on synthetic
+  health/membership/audit documents, no sockets;
+- **round machinery** — planned eviction driven directly through
+  ``Master.request_planned_evict`` (quiesce at a collective boundary,
+  spare adoption via the manifest path, the victim's clean
+  ``Mp4jEvicted``, bit-exact continuation), plus grow via
+  ``resize_point()``;
+- **chaos acceptance** — the closed loop end-to-end: a
+  persistently-slow injected rank is detected (health), decided on
+  (autoscaler) and replaced (planned evict + spare adoption) with NO
+  test intervention between fault and recovery; the spare pool drains
+  to zero and the provision hook refills it; two injected adoption
+  failures trip the circuit breaker and the job still completes clean
+  in recommend-only; ``off``/``observe`` grids prove no action ever
+  fires.
+
+Every value in the collective bodies is an exact small integer in
+float64, so bit-exactness is ANALYTIC: round ``k`` of an N-rank
+allreduce of ``full(_, k % 7 + 1)`` must equal ``N * (k % 7 + 1)``
+exactly on every rank, whatever prefix of the loop the rank ran.
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ytk_mp4j_tpu.comm.master import Master, REGISTER
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.exceptions import (
+    Mp4jError, Mp4jEvicted, Mp4jFatalError, Mp4jSpareReleased)
+from ytk_mp4j_tpu.obs import critpath, sink, spans
+from ytk_mp4j_tpu.obs.cli import main as scope_main
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+from ytk_mp4j_tpu.resilience import autoscaler
+from ytk_mp4j_tpu.transport.tcp import connect
+from ytk_mp4j_tpu.utils import tuning
+
+N = 4
+JOIN = 90.0
+
+
+@pytest.fixture
+def fresh_spans():
+    spans.clear()
+    yield
+    spans.clear()
+
+
+@pytest.fixture
+def fast_detection(monkeypatch):
+    """The proven ISSUE-12 chaos parameters: 0.1 s heartbeats, a
+    12-ordinal eviction streak over a 24-ordinal window."""
+    monkeypatch.setenv("MP4J_HEARTBEAT_SECS", "0.1")
+    monkeypatch.setenv("MP4J_HEALTH_DOMINATOR_ORDINALS", "12")
+    monkeypatch.setenv("MP4J_HEALTH_WINDOW", "24")
+
+
+# ----------------------------------------------------------------------
+# knob validation
+# ----------------------------------------------------------------------
+def test_autoscale_knob_validation(monkeypatch):
+    monkeypatch.setenv("MP4J_AUTOSCALE", "aggressive")
+    with pytest.raises(Mp4jError):
+        tuning.autoscale_mode()
+    for v in ("off", "observe", "act"):
+        monkeypatch.setenv("MP4J_AUTOSCALE", v)
+        assert tuning.autoscale_mode() == v
+    assert tuning.autoscale_mode("observe") == "observe"
+    monkeypatch.setenv("MP4J_AUTOSCALE_COOLDOWN_SECS", "-1")
+    with pytest.raises(Mp4jError):
+        tuning.autoscale_cooldown_secs()
+    monkeypatch.setenv("MP4J_AUTOSCALE_COOLDOWN_SECS", "2.5")
+    assert tuning.autoscale_cooldown_secs() == 2.5
+    with pytest.raises(Mp4jError):
+        tuning.autoscale_budget(0)
+    monkeypatch.setenv("MP4J_AUTOSCALE_BUDGET", "3")
+    assert tuning.autoscale_budget() == 3
+    monkeypatch.setenv("MP4J_PROVISION_CMD", " spawn-spare.sh ")
+    assert tuning.provision_cmd() == "spawn-spare.sh"
+    # a typo'd knob fails MASTER construction, not the first action
+    monkeypatch.setenv("MP4J_AUTOSCALE", "act")
+    monkeypatch.setenv("MP4J_AUTOSCALE_BUDGET", "zero")
+    with pytest.raises(Mp4jError):
+        Master(2, autoscale="act")
+
+
+def test_elastic_grow_mode_validated(monkeypatch):
+    assert tuning.elastic_mode("grow", max_retries=2) == "grow"
+    # grow needs the fenced retry like every elastic mode
+    with pytest.raises(Mp4jError):
+        tuning.elastic_mode("grow", max_retries=0)
+
+
+# ----------------------------------------------------------------------
+# policy units (pure functions, no sockets)
+# ----------------------------------------------------------------------
+def _health_doc(evict=(), why="dominator streak"):
+    return {"evict_recommended": list(evict),
+            "ranks": {str(r): {"state": "EVICT_RECOMMENDED",
+                               "why": why} for r in evict}}
+
+
+def _ms_doc(mode="replace", spares=1, events=()):
+    return {"mode": mode, "spares_available": spares,
+            "events": list(events)}
+
+
+def test_decide_proposes_evict_then_provision():
+    props = autoscaler.decide(_health_doc([2, 3]), _ms_doc(spares=1),
+                              provisionable=True)
+    assert [p["action"] for p in props] == ["evict_replace"]
+    assert props[0]["rank"] == 2          # lowest recommended first
+    assert "dominator streak" in props[0]["why"]
+    props = autoscaler.decide(_health_doc([2]), _ms_doc(spares=0),
+                              provisionable=True)
+    assert [p["action"] for p in props] == ["provision"]
+    # an empty pool with nothing to provision WITH proposes nothing
+    props = autoscaler.decide(_health_doc([2]), _ms_doc(spares=0),
+                              provisionable=False)
+    assert props == []
+
+
+def test_decide_quiet_without_mode_or_verdicts():
+    assert autoscaler.decide(_health_doc([2]), _ms_doc(mode="off"),
+                             provisionable=True) == []
+    assert autoscaler.decide(_health_doc([2]), _ms_doc(mode="shrink"),
+                             provisionable=True) == []
+    assert autoscaler.decide(_health_doc([]), _ms_doc(spares=1),
+                             provisionable=False) == []
+    assert autoscaler.decide(None, None, provisionable=True) == []
+
+
+def test_gate_rails():
+    st = autoscaler.ControllerState()
+    kw = dict(cooldown_secs=10.0, budget=2, audit=None)
+    ok, _ = autoscaler.gate(st, 100.0, "evict_replace", **kw)
+    assert ok
+    # one action in flight at a time
+    st.pending = {"action": "provision"}
+    ok, why = autoscaler.gate(st, 100.0, "evict_replace", **kw)
+    assert not ok and "in flight" in why
+    st.pending = None
+    # per-action cooldown (another action's stamp does not block)
+    st.last_action["evict_replace"] = 95.0
+    ok, why = autoscaler.gate(st, 100.0, "evict_replace", **kw)
+    assert not ok and "cooldown" in why
+    ok, _ = autoscaler.gate(st, 100.0, "provision", **kw)
+    assert ok
+    ok, _ = autoscaler.gate(st, 106.0, "evict_replace", **kw)
+    assert ok
+    # job-lifetime budget
+    st.budget_used = 2
+    ok, why = autoscaler.gate(st, 106.0, "evict_replace", **kw)
+    assert not ok and "budget" in why
+    st.budget_used = 0
+    # audit-green precondition
+    ok, why = autoscaler.gate(st, 106.0, "evict_replace",
+                              cooldown_secs=10.0, budget=2,
+                              audit={"divergences": 1})
+    assert not ok and "audit divergence" in why
+    assert autoscaler.audit_green({"divergences": 0})
+    assert not autoscaler.audit_green({"divergences": 3})
+    # the breaker outranks everything
+    st.tripped = True
+    st.tripped_why = "2 consecutive failed action(s)"
+    ok, why = autoscaler.gate(st, 106.0, "provision", **kw)
+    assert not ok and "breaker" in why
+
+
+def test_resolve_pending_success_failure_deadline():
+    pend = {"action": "evict_replace", "rank": 2, "since": 50.0,
+            "deadline": 80.0}
+    ok_ev = {"kind": "planned_evict", "rank": 2, "spare": 0,
+             "epoch": 1, "mono": 51.0}
+    v, d = autoscaler.resolve_pending(
+        pend, _ms_doc(events=[ok_ev]), 52.0)
+    assert v == "ok" and "rank 2" in d
+    # an event from BEFORE dispatch never confirms this action
+    v, _ = autoscaler.resolve_pending(
+        pend, _ms_doc(events=[{**ok_ev, "mono": 49.0}]), 52.0)
+    assert v == "pending"
+    v, d = autoscaler.resolve_pending(
+        pend, _ms_doc(events=[{"kind": "evict_abort", "ranks": [2],
+                               "why": "pool exhausted",
+                               "mono": 51.0}]), 52.0)
+    assert v == "failed" and "pool exhausted" in d
+    v, d = autoscaler.resolve_pending(pend, _ms_doc(), 81.0)
+    assert v == "failed" and "not confirmed" in d
+    # provision resolves on pool refill
+    v, _ = autoscaler.resolve_pending(
+        {"action": "provision", "since": 50.0, "deadline": 80.0},
+        _ms_doc(spares=1), 52.0)
+    assert v == "ok"
+
+
+def test_evicted_is_a_clean_fatal_subclass():
+    # every wait a terminal abort breaks must break for an eviction,
+    # and nothing may retry it — subclassing is the contract
+    assert issubclass(Mp4jEvicted, Mp4jFatalError)
+
+
+# ----------------------------------------------------------------------
+# shared cluster harness
+# ----------------------------------------------------------------------
+def _analytic_body(rounds, size=100_000):
+    """``rounds`` allreduces whose round-k result is exactly
+    ``N * (k % 7 + 1)`` — resumable from any ordinal (the app-level
+    half of the elastic contract: state is a pure function of the
+    resume position)."""
+    def body(slave, start):
+        out = []
+        for k in range(start, rounds):
+            a = np.full(size, float(k % 7 + 1))
+            slave.allreduce_array(a, Operands.DOUBLE,
+                                  Operators.SUM)
+            out.append(float(a[0]))
+        return out
+    return body
+
+
+def _check_analytic(vals, rounds, n=N):
+    start = rounds - len(vals)
+    for j, v in enumerate(vals):
+        assert v == n * ((start + j) % 7 + 1), (start, j, v)
+
+
+def _run_autopilot(rounds, *, master_kwargs, slave_kwargs=None,
+                   spare_count=0, body=None, join=JOIN):
+    """Master + N workers + ``spare_count`` real spares; workers that
+    get evicted record it and close(0). Returns (results-by-final-
+    rank, errors, evicted, spares, master, log)."""
+    log = io.StringIO()
+    mk = dict(master_kwargs)
+    mk.setdefault("spares", spare_count)
+    master = Master(N, timeout=join, log_stream=log,
+                    **mk).serve_in_thread()
+    body = body or _analytic_body(rounds)
+    results: dict[int, list] = {}
+    errors: list = [None] * N
+    evicted: dict = {}
+    spares: list[dict] = [{} for _ in range(spare_count)]
+
+    def worker(i):
+        s = None
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=join, dead_rank_secs=30.0,
+                                 **(slave_kwargs or {}))
+            results[s.rank] = body(s, 0)
+            s.close(0)
+        except Mp4jEvicted as e:
+            evicted[s.rank] = str(e)
+            s.close(0)
+        except Exception as e:
+            errors[s.rank if s is not None else i] = e
+            if s is not None:
+                try:
+                    s.close(1)
+                except Exception:
+                    pass
+
+    def spare_worker(k):
+        s = None
+        try:
+            kw = dict(slave_kwargs or {})
+            kw.pop("fault_plan", None)   # spares are healthy
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=join * 2, spare=True,
+                                 dead_rank_secs=30.0, **kw)
+            spares[k]["adopted_rank"] = s.rank
+            spares[k]["resume_seq"] = s.resume_seq
+            results[s.rank] = body(s, s.resume_seq)
+            s.close(0)
+        except Mp4jSpareReleased as e:
+            spares[k]["released"] = str(e)
+        except Exception as e:
+            spares[k]["error"] = e
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(N)]
+    threads += [threading.Thread(target=spare_worker, args=(k,),
+                                 daemon=True)
+                for k in range(spare_count)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + join
+    for t in threads:
+        t.join(max(0.1, deadline - time.monotonic()))
+    hung = [i for i, t in enumerate(threads) if t.is_alive()]
+    assert not hung, f"threads {hung} hung:\n{log.getvalue()[-6000:]}"
+    master.join(15.0)
+    return results, errors, evicted, spares, master, log.getvalue()
+
+
+SLOW3 = "slow:rank=3:secs=0.02:nth=20"
+
+
+# ----------------------------------------------------------------------
+# chaos acceptance: the closed loop, autonomously
+# ----------------------------------------------------------------------
+def test_autopilot_evicts_slow_rank_autonomously(fast_detection,
+                                                 fresh_spans,
+                                                 tmp_path):
+    """THE acceptance proof: with MP4J_AUTOSCALE=act, a slow-injected
+    rank is replaced with NO intervention between fault and recovery —
+    detection (health dominator streak), decision (autoscaler),
+    action (planned evict + spare adoption) all autonomous; every
+    rank's results are bit-exact, survivors see zero errors, the
+    victim exits with a clean Mp4jEvicted, and the action history
+    lands in the durable sink interleaved with the verdicts."""
+    d = str(tmp_path / "trail")
+    rounds = 240
+    results, errors, evicted, spares, master, log = _run_autopilot(
+        rounds,
+        master_kwargs={"elastic": "replace", "adopt_secs": 10.0,
+                       "autoscale": "act", "autoscale_cooldown": 2.0,
+                       "autoscale_tick": 0.2},
+        slave_kwargs={"elastic": "replace", "fault_plan": SLOW3,
+                      "sink_dir": d},
+        spare_count=1)
+    assert all(e is None for e in errors), f"{errors}\n{log[-4000:]}"
+    assert list(evicted) == [3], (evicted, log[-4000:])
+    assert "evicted by the autoscaler" in evicted[3]
+    assert spares[0].get("adopted_rank") == 3, (spares, log[-4000:])
+    assert master.final_code == 0, log[-4000:]
+    # bit-exact: every rank's analytic values, over whatever suffix/
+    # prefix of the loop it ran — fault to recovery fully covered
+    assert set(results) == set(range(N))
+    for r in range(N):
+        _check_analytic(results[r], rounds)
+    # the spare resumed mid-job (not from 0): the loop really was
+    # closed mid-flight, not restarted
+    assert 0 < spares[0]["resume_seq"] < rounds
+    # the controller's ledger: exactly one action, no failures
+    asc = master.autoscale_status()
+    assert asc["actions"]["evict_replace"] == 1
+    assert not asc["tripped"]
+    assert master.membership_status()["planned_evictions"] == 1
+    assert "planned eviction" in log
+    # timeline satellite: the action events interleave with verdict
+    # transitions in the durable sink's alert history
+    analysis = critpath.analyze(sink.load_job(d))
+    kinds = {ev.get("kind") for ev in analysis["health_alerts"]}
+    assert "autoscale" in kinds and "state" in kinds, kinds
+    acts = [ev for ev in analysis["health_alerts"]
+            if ev.get("kind") == "autoscale"
+            and ev.get("event") == "action"]
+    assert acts and acts[0]["action"] == "evict_replace"
+    assert scope_main(["health", d]) == 0
+
+
+def test_autopilot_provisions_spare_when_pool_drains(fast_detection,
+                                                     fresh_spans):
+    """Pool drains to 0 -> the provision hook fires (once — the
+    cooldown holds) -> the provisioned spare registers and is adopted
+    by the subsequent planned eviction."""
+    rounds = 240
+    hook_calls = []
+    provisioned: dict = {}
+    body = _analytic_body(rounds)
+
+    def run_provisioned_spare(master):
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=60.0, spare=True,
+                                 dead_rank_secs=30.0,
+                                 elastic="replace")
+        except Mp4jSpareReleased:
+            # a LATER provisioned spare (the controller refills the
+            # pool again after the eviction consumed the first one)
+            # idles to release at job end — the success case
+            return
+        provisioned["rank"] = s.rank
+        provisioned["resume_seq"] = s.resume_seq
+        provisioned["result"] = body(s, s.resume_seq)
+        s.close(0)
+
+    def hook(master):
+        hook_calls.append(time.monotonic())
+        threading.Thread(target=run_provisioned_spare, args=(master,),
+                         daemon=True).start()
+
+    results, errors, evicted, _, master, log = _run_autopilot(
+        rounds,
+        master_kwargs={"elastic": "replace", "adopt_secs": 10.0,
+                       "autoscale": "act", "autoscale_cooldown": 2.0,
+                       "autoscale_tick": 0.2, "provision_hook": hook},
+        slave_kwargs={"elastic": "replace", "fault_plan": SLOW3},
+        spare_count=0)
+    assert all(e is None for e in errors), f"{errors}\n{log[-4000:]}"
+    # the hook fired; a SECOND firing is legitimate (the eviction
+    # consumed the provisioned spare, so the pool hit 0 again and
+    # the controller refilled it after the cooldown) — the cooldown
+    # is what bounds the rate, not a one-shot rule
+    assert len(hook_calls) >= 1, hook_calls
+    if len(hook_calls) >= 2:
+        assert hook_calls[1] - hook_calls[0] >= 2.0, hook_calls
+    assert list(evicted) == [3], (evicted, log[-4000:])
+    assert provisioned.get("rank") == 3, (provisioned, log[-4000:])
+    assert master.final_code == 0, log[-4000:]
+    for r in range(N):
+        vals = results[r] if r != 3 else provisioned["result"]
+        _check_analytic(vals, rounds)
+    asc = master.autoscale_status()
+    assert asc["actions"]["provision"] >= 1
+    assert asc["actions"]["evict_replace"] == 1
+    assert not asc["tripped"]
+
+
+def _fake_spare(master, died=None):
+    """A spare that registers, pings, reads its adopt message and
+    drops dead without acking — the injected adoption failure."""
+    ch = connect("127.0.0.1", master.port, timeout=JOIN)
+    ch.send_obj((REGISTER, {"listen_port": 1, "host": "127.0.0.1",
+                            "fp": "", "spare": True}))
+    ch.recv()                       # registration ack
+    try:
+        ch.set_timeout(JOIN)
+        ch.recv()                   # the adopt message
+    except Exception:
+        pass
+    ch.close()                      # die without acking
+    if died is not None:
+        died.append(1)
+
+
+def test_circuit_breaker_trips_after_two_failed_evictions(
+        fast_detection, fresh_spans):
+    """Safety proof: two consecutive planned evictions whose spares
+    all die mid-adoption (the rounds abort back to plain releases)
+    trip the breaker to recommend-only — and the job STILL completes
+    clean, slow rank and all, with a structured trip alert and the
+    Prometheus gauge set. A real spare registered after the trip is
+    never consumed."""
+    rounds = 320
+    # compute-paced body: the 40 ms gap dwarfs the 20 ms injected
+    # slowness, so at any quiesce instant the victim is either inside
+    # the SAME collective as its peers or idle one behind — exactly
+    # the coherent shapes an abandoned eviction may safely release
+    # (the abandon-soundness rule in _try_advance_round); detection
+    # still sees every ordinal gated by rank 3's in-collective delay
+    def body(slave, start):
+        out = []
+        for k in range(start, rounds):
+            a = np.full(20_000, float(k % 7 + 1))
+            slave.allreduce_array(a, Operands.DOUBLE,
+                                  Operators.SUM)
+            out.append(float(a[0]))
+            time.sleep(0.04)
+        return out
+
+    log = io.StringIO()
+    master = Master(N, timeout=JOIN, log_stream=log,
+                    elastic="replace", spares=0, adopt_secs=8.0,
+                    autoscale="act", autoscale_cooldown=1.0,
+                    autoscale_tick=0.2).serve_in_thread()
+    results: dict[int, list] = {}
+    errors: list = [None] * N
+
+    def worker(i):
+        s = None
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=JOIN, dead_rank_secs=30.0,
+                                 elastic="replace", fault_plan=SLOW3)
+            results[s.rank] = body(s, 0)
+            s.close(0)
+        except Exception as e:
+            errors[s.rank if s is not None else i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(N)]
+    for t in threads:
+        t.start()
+
+    # two waves of two fake spares: wave 1 fails action 1, wave 2
+    # fails action 2 -> trip; then one REAL spare that must idle
+    real_released: dict = {}
+
+    def real_spare():
+        try:
+            ProcessCommSlave("127.0.0.1", master.port, timeout=JOIN,
+                             spare=True, dead_rank_secs=30.0,
+                             elastic="replace")
+        except Mp4jSpareReleased as e:
+            real_released["why"] = str(e)
+        except Exception as e:
+            real_released["error"] = e
+
+    def orchestrate():
+        for _ in range(2):
+            threading.Thread(target=_fake_spare, args=(master,),
+                             daemon=True).start()
+        deadline = time.monotonic() + 60.0
+        fails_seen = 0
+        while time.monotonic() < deadline:
+            asc = master.autoscale_status() or {}
+            if asc.get("tripped"):
+                break
+            fails = asc.get("consecutive_failures", 0)
+            if fails == 1 and fails_seen == 0:
+                fails_seen = 1
+                for _ in range(2):
+                    threading.Thread(target=_fake_spare,
+                                     args=(master,),
+                                     daemon=True).start()
+            time.sleep(0.2)
+        threading.Thread(target=real_spare, daemon=True).start()
+
+    orch = threading.Thread(target=orchestrate, daemon=True)
+    orch.start()
+    deadline = time.monotonic() + JOIN
+    for t in threads:
+        t.join(max(0.1, deadline - time.monotonic()))
+    assert not any(t.is_alive() for t in threads), \
+        f"ranks hung:\n{log.getvalue()[-6000:]}"
+    orch.join(10.0)
+    master.join(15.0)
+
+    txt = log.getvalue()
+    assert all(e is None for e in errors), f"{errors}\n{txt[-5000:]}"
+    assert master.final_code == 0, txt[-5000:]
+    for r in range(N):
+        _check_analytic(results[r], rounds)
+    asc = master.autoscale_status()
+    assert asc["tripped"], (asc, txt[-5000:])
+    assert asc["consecutive_failures"] >= 2
+    assert "circuit breaker tripped" in txt
+    ms = master.membership_status()
+    assert ms["planned_evictions"] == 0           # nothing ever landed
+    aborts = [e for e in ms["events"] if e["kind"] == "evict_abort"]
+    assert len(aborts) >= 2, ms["events"]
+    # tripped -> recommend-only: the real spare was never consumed
+    assert "why" in real_released, (real_released, txt[-3000:])
+
+
+# ----------------------------------------------------------------------
+# off / observe grids: no action ever fires
+# ----------------------------------------------------------------------
+def test_autoscale_off_is_todays_behavior(fast_detection, fresh_spans):
+    """MP4J_AUTOSCALE=off: no controller exists at all — the slow
+    rank keeps its verdict, the spare idles to release, zero
+    membership changes. Today's behavior bit-for-bit."""
+    rounds = 160
+    results, errors, evicted, spares, master, log = _run_autopilot(
+        rounds,
+        master_kwargs={"elastic": "replace", "adopt_secs": 10.0,
+                       "autoscale": "off"},
+        slave_kwargs={"elastic": "replace", "fault_plan": SLOW3},
+        spare_count=1)
+    assert all(e is None for e in errors), f"{errors}\n{log[-3000:]}"
+    assert evicted == {}, evicted
+    assert master.final_code == 0
+    assert master.autoscale_status() is None
+    assert master.metrics_doc()["cluster"]["autoscale"] is None
+    ms = master.membership_status()
+    assert ms["planned_evictions"] == 0 and ms["replacements"] == 0
+    assert not any(e["kind"].startswith(("planned_evict", "grow"))
+                   for e in ms["events"])
+    assert "released" in spares[0], spares
+    assert "autoscale:" not in log
+    for r in range(N):
+        _check_analytic(results[r], rounds)
+
+
+def test_autoscale_observe_logs_but_never_acts(fast_detection,
+                                               fresh_spans):
+    """MP4J_AUTOSCALE=observe: the controller runs the full decision
+    path and LOGS the would-be eviction, but the roster never
+    changes and the spare idles to release."""
+    rounds = 240
+    results, errors, evicted, spares, master, log = _run_autopilot(
+        rounds,
+        master_kwargs={"elastic": "replace", "adopt_secs": 10.0,
+                       "autoscale": "observe",
+                       "autoscale_cooldown": 1.0,
+                       "autoscale_tick": 0.2},
+        slave_kwargs={"elastic": "replace", "fault_plan": SLOW3},
+        spare_count=1)
+    assert all(e is None for e in errors), f"{errors}\n{log[-3000:]}"
+    assert evicted == {}, evicted
+    assert master.final_code == 0
+    asc = master.autoscale_status()
+    assert asc["mode"] == "observe"
+    assert sum(asc["actions"].values()) == 0
+    assert asc["observed"]["evict_replace"] >= 1, (asc, log[-3000:])
+    assert "would evict_replace" in log
+    ms = master.membership_status()
+    assert ms["planned_evictions"] == 0 and ms["replacements"] == 0
+    assert "released" in spares[0], spares
+    for r in range(N):
+        _check_analytic(results[r], rounds)
+
+
+# ----------------------------------------------------------------------
+# grow mode: resize_point() expands n between epochs
+# ----------------------------------------------------------------------
+def _grow_cluster(autoscale_mode, n0=2, spare_count=2, join=JOIN):
+    """n0 ranks run pre-resize collectives, hit resize_point(), run
+    post-resize collectives at whatever n came back; spares run the
+    post half when adopted."""
+    log = io.StringIO()
+    master = Master(n0, timeout=join, log_stream=log, elastic="grow",
+                    spares=spare_count, adopt_secs=10.0,
+                    autoscale=autoscale_mode, autoscale_cooldown=0.0,
+                    autoscale_tick=0.2).serve_in_thread()
+    out: dict = {}
+    errs: dict = {}
+
+    def post(s):
+        a = np.ones(4096)
+        s.allreduce_array(a, Operands.DOUBLE, Operators.SUM)
+        d = {f"k{s.rank}": np.float64(1.0), "shared": np.float64(2.0)}
+        s.allreduce_map(d)
+        return float(a[0]), {k: float(v) for k, v in d.items()}
+
+    def worker(i):
+        s = None
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=join, dead_rank_secs=30.0,
+                                 elastic="grow")
+            a = np.ones(4096)
+            s.allreduce_array(a, Operands.DOUBLE,
+                              Operators.SUM)   # at n0
+            out[("pre", s.rank)] = float(a[0])
+            roster = s.resize_point()
+            out[("roster", s.rank)] = len(roster)
+            out[("n", s.rank)] = s.slave_num
+            out[("post", s.rank)] = post(s)
+            s.close(0)
+        except Exception as e:
+            errs[i] = e
+
+    def spare_worker(k):
+        s = None
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=join * 2, spare=True,
+                                 dead_rank_secs=30.0, elastic="grow")
+            out[("adopt", k)] = (s.rank, s.resume_seq, s.slave_num)
+            out[("post", s.rank)] = post(s)
+            s.close(0)
+        except Mp4jSpareReleased as e:
+            out[("released", k)] = str(e)
+        except Exception as e:
+            errs[("sp", k)] = e
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n0)]
+    threads += [threading.Thread(target=spare_worker, args=(k,),
+                                 daemon=True)
+                for k in range(spare_count)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + join
+    for t in threads:
+        t.join(max(0.1, deadline - time.monotonic()))
+    assert not any(t.is_alive() for t in threads), \
+        f"hung:\n{log.getvalue()[-5000:]}"
+    master.join(15.0)
+    return out, errs, master, log.getvalue()
+
+
+def test_grow_expands_n_at_resize_point(fresh_spans):
+    """MP4J_ELASTIC=grow + MP4J_AUTOSCALE=act: resize_point() adopts
+    both registered spares into NEW rank ids, every rank returns the
+    grown roster, and the post-resize collectives (dense + columnar
+    map — the vocabulary seeded from rank 0's donation) run at n=4."""
+    out, errs, master, log = _grow_cluster("act")
+    assert not errs, (errs, log[-4000:])
+    assert master.final_code == 0, log[-4000:]
+    n = 4
+    assert out[("roster", 0)] == n and out[("n", 1)] == n
+    assert {out[("adopt", k)][0] for k in range(2)} == {2, 3}
+    for r in range(n):
+        a0, d = out[("post", r)]
+        assert a0 == float(n)                     # bit-exact at n=4
+        assert d["shared"] == 2.0 * n
+        assert all(d[f"k{j}"] == 1.0 for j in range(n))
+    ms = master.membership_status()
+    assert ms["grows"] == 1
+    assert any(e["kind"] == "grow" and e["ranks"] == [2, 3]
+               for e in ms["events"])
+    asc = master.autoscale_status()
+    assert asc["actions"]["grow"] == 1
+    assert "grow round complete" in log
+
+
+def test_grow_observe_mode_keeps_roster(fresh_spans):
+    """observe: resize_point() is a no-op rendezvous — the would-be
+    growth is logged, the spares idle to release, n stays n0."""
+    out, errs, master, log = _grow_cluster("observe")
+    assert not errs, (errs, log[-4000:])
+    assert master.final_code == 0, log[-4000:]
+    assert out[("roster", 0)] == 2 and out[("n", 1)] == 2
+    assert ("released", 0) in out and ("released", 1) in out, out
+    for r in range(2):
+        a0, d = out[("post", r)]
+        assert a0 == 2.0 and d["shared"] == 4.0
+    asc = master.autoscale_status()
+    assert asc["actions"]["grow"] == 0
+    assert asc["observed"]["grow"] >= 1
+    assert master.membership_status()["grows"] == 0
+    assert "would grow" in log or "adopt 2 spare(s)" in log
+
+
+def test_grow_joiner_immediate_second_resize(fresh_spans):
+    """Freshly adopted grow joiners' apps may hit their NEXT
+    resize_point immediately — the completeness scan their arrivals
+    trigger must neither release the still-finalizing generation
+    unchanged (the orphaned-grow regression) nor complete the NEXT
+    generation early against the old slave_num (with TWO joiners,
+    gen+1 collects 2 arrivals == the pre-grow n while the survivors
+    are still inside gen's grow — out-of-order completion would
+    strand the survivors' eventual arrivals forever)."""
+    log = io.StringIO()
+    master = Master(2, timeout=JOIN, log_stream=log, elastic="grow",
+                    spares=2, adopt_secs=10.0, autoscale="act",
+                    autoscale_cooldown=0.0,
+                    autoscale_tick=0.2).serve_in_thread()
+    out: dict = {}
+    errs: dict = {}
+
+    def finish(s, tag):
+        r2 = s.resize_point()           # gen 1: no spares -> no-op
+        a = np.ones(1024)
+        s.allreduce_array(a, Operands.DOUBLE, Operators.SUM)
+        out[tag] = (len(r2), s.slave_num, float(a[0]))
+        s.close(0)
+
+    def worker(i):
+        s = None
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=JOIN, dead_rank_secs=30.0,
+                                 elastic="grow")
+            s.resize_point()            # gen 0: grows 2 -> 4
+            finish(s, ("w", s.rank))
+        except Exception as e:
+            errs[i] = e
+
+    def spare_worker():
+        s = None
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=JOIN * 2, spare=True,
+                                 dead_rank_secs=30.0, elastic="grow")
+            # adopted at gen 0 with resize_gen seeded to 1: the very
+            # first thing the continuation does is resize again —
+            # the racing arrival this regression pins
+            finish(s, ("j", s.rank))
+        except Exception as e:
+            errs["sp"] = e
+
+    threads = [threading.Thread(target=worker, args=(i,),
+                                daemon=True) for i in range(2)]
+    threads += [threading.Thread(target=spare_worker, daemon=True)
+                for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(JOIN)
+    assert not any(t.is_alive() for t in threads), \
+        f"hung:\n{log.getvalue()[-5000:]}"
+    master.join(15.0)
+    assert not errs, (errs, log.getvalue()[-4000:])
+    assert master.final_code == 0, log.getvalue()[-4000:]
+    assert set(out) == {("w", 0), ("w", 1), ("j", 2), ("j", 3)}, out
+    for tag, (roster_n, n, a0) in out.items():
+        assert roster_n == 4 and n == 4 and a0 == 4.0, (tag, out)
+    assert master.membership_status()["grows"] == 1
+
+
+def test_resize_point_noop_when_elastic_off(fresh_spans):
+    """resize_point() exists on every job: without grow mode it is a
+    cheap rendezvous returning the unchanged roster."""
+    log = io.StringIO()
+    master = Master(2, timeout=30.0,
+                    log_stream=log).serve_in_thread()
+    out = {}
+
+    def worker(i):
+        s = ProcessCommSlave("127.0.0.1", master.port, timeout=30.0)
+        out[s.rank] = len(s.resize_point())
+        a = np.ones(128)
+        s.allreduce_array(a, Operands.DOUBLE, Operators.SUM)
+        out[("post", s.rank)] = float(a[0])
+        s.close(0)
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30.0)
+    assert not any(t.is_alive() for t in ts), log.getvalue()
+    master.join(10.0)
+    assert master.final_code == 0
+    assert out[0] == 2 and out[1] == 2
+    assert out[("post", 0)] == 2.0
+
+
+# ----------------------------------------------------------------------
+# observability surfaces
+# ----------------------------------------------------------------------
+def test_autoscale_prometheus_and_live_surfaces(fast_detection,
+                                                fresh_spans):
+    """The ledger lands on /metrics (R17-documented families), in the
+    metrics document, and on the mp4j-scope live head-line."""
+    from ytk_mp4j_tpu.obs import telemetry
+    rounds = 240
+    hold = threading.Event()
+    log = io.StringIO()
+    master = Master(N, timeout=JOIN, log_stream=log,
+                    elastic="replace", spares=1, adopt_secs=10.0,
+                    autoscale="act", autoscale_cooldown=2.0,
+                    autoscale_tick=0.2,
+                    metrics_port=0).serve_in_thread()
+    body = _analytic_body(rounds, size=60_000)
+    results: dict = {}
+    errors: list = [None] * N
+    evicted: dict = {}
+
+    def worker(i):
+        s = None
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=JOIN, dead_rank_secs=30.0,
+                                 elastic="replace", fault_plan=SLOW3)
+            results[s.rank] = body(s, 0)
+            hold.wait(30.0)
+            s.close(0)
+        except Mp4jEvicted:
+            evicted[s.rank] = True
+            s.close(0)
+        except Exception as e:
+            errors[s.rank if s is not None else i] = e
+
+    def spare_worker():
+        try:
+            s = ProcessCommSlave("127.0.0.1", master.port,
+                                 timeout=JOIN * 2, spare=True,
+                                 dead_rank_secs=30.0,
+                                 elastic="replace")
+            results[s.rank] = body(s, s.resume_seq)
+            hold.wait(30.0)
+            s.close(0)
+        except Mp4jSpareReleased:
+            pass
+        except Exception as e:
+            errors[0] = errors[0] or e
+
+    threads = [threading.Thread(target=worker, args=(i,),
+                                daemon=True) for i in range(N)]
+    threads.append(threading.Thread(target=spare_worker, daemon=True))
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            asc = master.autoscale_status()
+            if asc and asc["actions"]["evict_replace"] >= 1 \
+                    and evicted:
+                break
+            time.sleep(0.2)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{master.metrics_port}/metrics",
+                timeout=5.0) as resp:
+            text = resp.read().decode()
+        assert ('mp4j_autoscale_actions_total{action="evict_replace"}'
+                in text), text[-2000:]
+        assert "mp4j_autoscale_tripped 0" in text
+        doc = master.metrics_doc()
+        frame = telemetry.format_live(doc)
+        assert "autoscale: mode=act" in frame, frame
+        assert all(len(ln) <= 120 for ln in frame.splitlines()), frame
+    finally:
+        hold.set()
+    for t in threads:
+        t.join(60.0)
+    assert not any(t.is_alive() for t in threads), \
+        log.getvalue()[-5000:]
+    master.join(15.0)
+    assert all(e is None for e in errors), errors
+    assert master.final_code == 0
+
+
+def test_postmortem_reports_autoscaler_section(tmp_path):
+    """The manifest freezes the controller ledger and the merged
+    report renders the actions-taken section."""
+    from ytk_mp4j_tpu.obs import postmortem
+    asc = {"mode": "act", "tripped": True,
+           "tripped_why": "2 consecutive failed action(s); last: "
+                          "adoption timeout",
+           "actions": {"evict_replace": 2, "provision": 1, "grow": 0},
+           "observed": {"evict_replace": 0, "provision": 0, "grow": 0},
+           "budget": {"limit": 16, "used": 3},
+           "events": [{"id": -1, "wall": 1000.0, "kind": "autoscale",
+                       "event": "action", "action": "evict_replace",
+                       "rank": 2, "mode": "act",
+                       "msg": "health verdict EVICT_RECOMMENDED"}]}
+    postmortem.write_master_manifest(
+        str(tmp_path), slave_num=N, reason="test fatal", table={},
+        departed={}, diagnosis=["d"], autoscale=asc)
+    report = postmortem.merge_report(str(tmp_path))
+    assert "autoscaler: mode=act TRIPPED" in report
+    assert "breaker tripped" in report
+    assert "autoscaler event: action evict_replace rank 2" in report

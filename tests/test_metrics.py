@@ -430,6 +430,23 @@ def test_metrics_endpoint_live_4rank_workload(monkeypatch, capsys):
         assert "mp4j_collective_latency_seconds_bucket" in text
         assert f"mp4j_ranks_reporting {n}" in text
 
+        # /health.json (ISSUE 13 satellite): the verdict document over
+        # HTTP — external orchestrators read evict recommendations
+        # without being in-process; same schema as health_status()
+        with urllib.request.urlopen(base + "/health.json",
+                                    timeout=5.0) as r:
+            assert r.headers["Content-Type"].startswith(
+                "application/json")
+            hdoc = json.load(r)
+        assert {"enabled", "ranks", "evict_recommended", "dominator",
+                "alerts_total", "window"} <= set(hdoc)
+        assert hdoc["enabled"] is True
+        for r in map(str, range(n)):
+            assert {"state", "state_code", "pressure",
+                    "alerts"} <= set(hdoc["ranks"][r])
+            assert hdoc["ranks"][r]["state"] == "HEALTHY"
+        assert hdoc["evict_recommended"] == []
+
         # the live CLI view renders one frame from the same endpoint
         assert scope_main(["live", f"127.0.0.1:{master.metrics_port}",
                            "--once"]) == 0

@@ -19,6 +19,7 @@
 | R15 | error   | roster-derived topology cached in an attribute |
 | R16 | error   | un-awaited CollectiveFuture crosses a boundary |
 | R17 | error   | metric family missing from METRICS_DOC |
+| R18 | error   | bare time.sleep() inside a while loop (control code) |
 """
 
 from __future__ import annotations
@@ -54,6 +55,7 @@ from ytk_mp4j_tpu.analysis.rules.r15_topology_cache import (
 from ytk_mp4j_tpu.analysis.rules.r16_unawaited_future import (
     R16UnawaitedFuture)
 from ytk_mp4j_tpu.analysis.rules.r17_metric_doc import R17MetricDoc
+from ytk_mp4j_tpu.analysis.rules.r18_sleep_loop import R18SleepLoop
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -73,6 +75,7 @@ ALL_RULES = [
     R15TopologyCache,
     R16UnawaitedFuture,
     R17MetricDoc,
+    R18SleepLoop,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

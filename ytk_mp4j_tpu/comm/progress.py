@@ -387,7 +387,7 @@ class ProgressScheduler:
         # the blocking path absorbs (it parks in _join_pending_round
         # instead); the scheduler's own rec.run waits the round out
         if s._recovery.fatal is not None:
-            raise Mp4jFatalError(s._recovery.fatal)
+            raise s._recovery.fatal_exc()
         fut = CollectiveFuture(name, epoch=s._recovery.epoch)
         item = _Item(fut, name, args, kwargs, kind)
         with self._cv:
@@ -399,7 +399,7 @@ class ProgressScheduler:
                 self._cv.wait(0.2)
                 self._raise_terminal()
                 if s._recovery.fatal is not None:
-                    raise Mp4jFatalError(s._recovery.fatal)
+                    raise s._recovery.fatal_exc()
             self._pending.append(item)
             self._outstanding += 1
             self._account_locked(+1)
@@ -585,7 +585,7 @@ class ProgressScheduler:
             out = getattr(self._s, item.name)(*item.args,
                                               **item.kwargs)
         except Mp4jFatalError:
-            self._finish(item, exc=Mp4jFatalError(
+            self._finish(item, exc=self._s._recovery.fatal_exc(
                 str(self._s._recovery.fatal or "fatal abort")))
             raise
         except Exception as e:
@@ -627,7 +627,7 @@ class ProgressScheduler:
             m = s.allreduce_map_multi(dicts, operand, operator)
         except Mp4jFatalError:
             for it in batch:
-                self._finish(it, exc=Mp4jFatalError(
+                self._finish(it, exc=s._recovery.fatal_exc(
                     str(s._recovery.fatal or "fatal abort")))
             raise
         except Exception as e:

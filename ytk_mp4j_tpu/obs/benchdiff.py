@@ -81,6 +81,10 @@ THRESHOLDS: dict[str, float] = {
     "socket_recovery_latency_ms": 1.0,
     "socket_replacement_latency_ms": 1.0,
     "socket_shrink_latency_ms": 1.0,
+    # ISSUE 13: autoscaler actuation latencies, same single-event
+    # wall-clock caveat and wide budget as the membership rows above
+    "socket_planned_evict_ms": 1.0,
+    "socket_grow_latency_ms": 1.0,
 }
 
 # metrics where SMALLER is the good direction (latencies): the budget
@@ -89,6 +93,8 @@ LOWER_IS_BETTER = frozenset({
     "socket_recovery_latency_ms",
     "socket_replacement_latency_ms",
     "socket_shrink_latency_ms",
+    "socket_planned_evict_ms",
+    "socket_grow_latency_ms",
 })
 
 

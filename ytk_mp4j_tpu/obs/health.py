@@ -588,6 +588,16 @@ class HealthEngine:
                         self._ranks[int(rank)])
         return [ev]
 
+    def note_grow(self, slave_num: int) -> None:
+        """The roster GREW (ISSUE 13): widen the expected rank count.
+        Ordinals completed before the growth can never collect the
+        joiners' cells — drop them (counted, never silent) so they
+        don't jam the pending table until the cap prunes them; the
+        joiners' verdicts start HEALTHY lazily on their first fold."""
+        self.slave_num = int(slave_num)
+        self._cells_dropped += sum(len(c) for c in self._cells.values())
+        self._cells.clear()
+
     def note_shrink(self, slave_num: int,
                     mapping: dict[int, int]) -> None:
         """The roster renumbered: remap verdicts, drop the dead, and
@@ -890,6 +900,14 @@ _fmt_wall = critpath.fmt_wall
 
 
 def format_alert(ev: dict) -> str:
+    if ev.get("kind") == "autoscale":
+        # an autoscaler action event (ISSUE 13) — rides the same
+        # alert pipe so timelines interleave actions with verdicts
+        return (f"{_fmt_wall(ev.get('wall'))}  autoscaler "
+                f"{ev.get('event')} {ev.get('action')}"
+                + (f" rank {ev['rank']}"
+                   if ev.get("rank") is not None else "")
+                + f": {ev.get('msg', '')}")
     if ev.get("kind") == "onset":
         return (f"{_fmt_wall(ev.get('wall'))}  rank {ev.get('rank')} "
                 f"ONSET ({ev.get('detector')}): {ev.get('msg', '')}")

@@ -173,6 +173,24 @@ def format_live(doc: dict) -> str:
                      f"{ev.get('from')}->{ev.get('to')} "
                      f"({ev.get('detector')}) "
                      f"{str(ev.get('msg', ''))[:60]}")
+    # autoscaler head-line (ISSUE 13): mode, trip state, action tally;
+    # absent entirely when MP4J_AUTOSCALE=off (no controller exists)
+    asc = cl.get("autoscale") or {}
+    if asc:
+        acted = sum((asc.get("actions") or {}).values())
+        would = sum((asc.get("observed") or {}).values())
+        head += (f"\nautoscale: mode={asc.get('mode')}"
+                 + (" TRIPPED" if asc.get("tripped") else "")
+                 + f" | {acted} action(s)"
+                 + (f", {would} observed" if would else "")
+                 + f" | budget {asc.get('budget', {}).get('used', 0)}"
+                 f"/{asc.get('budget', {}).get('limit', 0)}")
+        events = asc.get("events") or []
+        if events:
+            ev = events[-1]
+            head += (f"\n  last: {ev.get('event')} "
+                     f"{ev.get('action')} "
+                     f"{str(ev.get('msg', ''))[:60]}")
     if not ranks:
         return head + "\n(no rank telemetry yet)"
     skew = cluster_skew({int(r): info.get("stats", {})

@@ -47,7 +47,8 @@ import threading
 import time
 
 from ytk_mp4j_tpu.exceptions import (
-    Mp4jAbortError, Mp4jError, Mp4jFatalError, Mp4jTransportError)
+    Mp4jAbortError, Mp4jError, Mp4jEvicted, Mp4jFatalError,
+    Mp4jTransportError)
 from ytk_mp4j_tpu.obs import spans
 
 # the recoverable class: wire-level Mp4jTransportError (which includes
@@ -112,6 +113,20 @@ class RecoveryManager:
         self.epoch = 0          # last epoch the master released (go)
         self._target = 0        # highest abort epoch announced
         self._fatal: str | None = None
+        # planned eviction (ISSUE 13): the terminal message is a clean
+        # release, not a failure — waiters raise Mp4jEvicted instead
+        # of Mp4jFatalError and the postmortem recorder stays quiet
+        self._evicted = False
+        # the soft boundary fence (ISSUE 13): while set, the
+        # collective thread PARKS at its next outermost entry (acking
+        # its position) instead of starting the collective — the
+        # master's planned-eviction quiesce, with the wire untouched.
+        # ``_fence_goal`` is the ordinal the master wants COMPLETED
+        # before parking (fence_advance): a rank parked early would
+        # starve a peer's in-flight batch that still needs it, so the
+        # master advances laggards to the global max ordinal first
+        self._fence_token: int | None = None
+        self._fence_goal = 0
         self._requested = 0     # highest abort epoch we asked for
         self._tl = threading.local()
 
@@ -155,6 +170,84 @@ class RecoveryManager:
             return spans.ring_delta(self._events, self._event_count,
                                     cursor)
 
+    def on_fence(self, token: int) -> None:
+        """The master wants every rank parked at a collective
+        boundary (ISSUE 13 planned eviction): arm the fence. The
+        collective thread acks and parks at its NEXT outermost entry
+        — nothing is torn down, so a canceled fence costs nothing."""
+        with self._cond:
+            self._fence_token = int(token)
+            self._fence_goal = 0
+            self._cond.notify_all()
+        self._note("fence", f"token={token}")
+        self._wake()
+
+    def on_fence_advance(self, token: int, goal: int) -> None:
+        """The master moved the fence's park ordinal: this rank
+        parked (or would park) BEHIND a peer's in-flight ordinal, and
+        a rank parked early starves every peer whose admitted batch
+        still needs it — run through ordinal ``goal`` first, then
+        park and re-ack. Parking after COMPLETING an ordinal is
+        starvation-free: completion implies this rank's sends for it
+        (and everything before it) are already on the wire."""
+        with self._cond:
+            if self._fence_token == int(token):
+                self._fence_goal = max(self._fence_goal, int(goal))
+            self._cond.notify_all()
+        self._note("fence_advance", f"token={token} goal={goal}")
+        self._wake()
+
+    def on_fence_release(self, token: int) -> None:
+        """The master canceled the fence (a rank could not reach a
+        boundary in time, or the eviction became moot): parked ranks
+        resume exactly where they were — zero disruption."""
+        with self._cond:
+            if self._fence_token == int(token):
+                self._fence_token = None
+            self._cond.notify_all()
+        self._note("fence_release", f"token={token}")
+        self._wake()
+
+    def _join_fence(self) -> None:
+        """Collective-thread side of the fence: at an OUTERMOST
+        collective entry with the fence armed — and this rank's
+        position at or past the fence goal — ack the position and
+        park until the fence resolves: into an abort round (the
+        eviction proceeds; ``_join_pending_round`` below takes over),
+        a release (canceled; resume free), an ADVANCE (a peer's
+        in-flight batch still needs this rank — resume through the
+        new goal, re-park at the next boundary), or a terminal
+        message. Bounded: a masterless fence must not hang the job
+        past the recovery deadline."""
+        deadline = time.monotonic() + self.dead_rank_secs
+        while True:
+            with self._cond:
+                tok = self._fence_token
+                goal = self._fence_goal
+            if tok is None:
+                return
+            seq, _ = self._progress()
+            if seq < goal:
+                return      # run on; re-park once the goal completes
+            try:
+                self._send_ctl("fence_ack",
+                               {"token": tok, "seq": seq})
+            except (Mp4jError, OSError):
+                pass    # master gone; its watchdog owns the outcome
+            self._note("fence_park", f"token={tok} seq={seq}")
+            with self._cond:
+                while (self._fence_token == tok
+                       and self._fence_goal <= seq
+                       and self._fatal is None
+                       and self._target <= self.epoch):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._cond.wait(min(remaining, 0.5))
+                if (self._fence_token != tok or self._fatal is not None
+                        or self._target > self.epoch):
+                    return
+
     def on_abort(self, target: int) -> None:
         """Master announced an abort round targeting ``target``: tear
         down the old epoch's data plane and ack. Runs on the control
@@ -164,6 +257,10 @@ class RecoveryManager:
             if target <= self._target:
                 return          # duplicate/stale announcement
             self._target = target
+            # an abort round supersedes any armed fence: the round IS
+            # the quiesce now, and the parked ranks fall through into
+            # _join_pending_round to wait for the go
+            self._fence_token = None
             self._cond.notify_all()
         self._note("abort", f"epoch->{target}")
         self._teardown()
@@ -216,9 +313,45 @@ class RecoveryManager:
         spans.mark("abort_fatal", self.rank)
         self._wake()
 
+    def on_evicted(self, msg: str) -> None:
+        """Planned eviction (ISSUE 13): the master's autoscaler
+        replaced this LIVE rank from a warm spare at a collective
+        boundary, and this message is the release. Terminal like a
+        fatal (the data plane belongs to the replacement now; every
+        blocked wait must break), but CLEAN: waiters raise
+        :class:`Mp4jEvicted`, the terminal hook stays unfired (a
+        planned eviction leaves no postmortem — nothing failed), and
+        ``close()`` skips the master handshake the master already
+        wrote off."""
+        with self._cond:
+            self._terminal_fired = True   # no flight-recorder dump
+            if self._fatal is None:
+                self._fatal = msg
+                self._evicted = True
+            self._cond.notify_all()
+        self._note("evicted", msg[:120])
+        self._teardown()
+        spans.mark("evicted", self.rank)
+        self._wake()
+
     @property
     def fatal(self) -> str | None:
         return self._fatal
+
+    @property
+    def evicted(self) -> bool:
+        """Whether the terminal message is a planned eviction."""
+        return self._evicted
+
+    def fatal_exc(self, msg: str | None = None) -> Mp4jError:
+        """THE terminal-exception constructor: every site that raises
+        the job-wide terminal message must come through here so a
+        planned eviction surfaces as :class:`Mp4jEvicted` (clean
+        release) and everything else as :class:`Mp4jFatalError` —
+        two sites deciding independently would disagree."""
+        text = self._fatal if msg is None else msg
+        return (Mp4jEvicted(text) if self._evicted
+                else Mp4jFatalError(text))
 
     # ------------------------------------------------------------------
     # collective-thread side
@@ -232,7 +365,7 @@ class RecoveryManager:
         without the attempt-epoch pin it would acquire fresh channels
         and consume (or corrupt) frames that belong to the retry."""
         if self._fatal is not None:
-            raise Mp4jFatalError(self._fatal)
+            raise self.fatal_exc()
         if self._target > self.epoch:
             raise Mp4jAbortError(
                 f"epoch fence: abort round -> {self._target} in flight "
@@ -288,6 +421,7 @@ class RecoveryManager:
 
     def _run_rounds(self, name, attempt, restore, saved, tries):
         while True:
+            self._join_fence()
             self._join_pending_round()
             # release fds of channels the last round tore down — only
             # the collective thread may do this (native-poll fd-reuse
@@ -308,7 +442,7 @@ class RecoveryManager:
                     raise Mp4jTransportError(
                         f"collective '{name}' failed: {e!r}") from e
                 if self._fatal is not None:
-                    raise Mp4jFatalError(self._fatal) from e
+                    raise self.fatal_exc() from e
                 if tries >= self.max_retries:
                     self._go_terminal(
                         f"collective '{name}' on rank {self.rank} "
@@ -337,7 +471,7 @@ class RecoveryManager:
                     break
                 self._cond.wait(min(remaining, 0.5))
         if self._fatal is not None:
-            raise Mp4jFatalError(self._fatal)
+            raise self.fatal_exc()
         if self._target > self.epoch:
             self._go_terminal(
                 f"rank {self.rank}: abort round -> {self._target} "
@@ -367,7 +501,7 @@ class RecoveryManager:
                     break
                 self._cond.wait(min(remaining, 0.5))
         if self._fatal is not None:
-            raise Mp4jFatalError(self._fatal)
+            raise self.fatal_exc()
         if self.epoch <= epoch0:
             self._go_terminal(
                 f"rank {self.rank}: recovery of '{name}' stalled for "
@@ -390,4 +524,4 @@ class RecoveryManager:
                 if remaining <= 0:
                     break
                 self._cond.wait(min(remaining, 0.25))
-        raise Mp4jFatalError(self._fatal or msg) from cause
+        raise self.fatal_exc(self._fatal or msg) from cause

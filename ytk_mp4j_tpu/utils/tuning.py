@@ -107,13 +107,16 @@ Knobs (all validated where they are consumed; garbage raises
   rank is a job-wide ``Mp4jFatalError``, exactly the pre-elastic
   contract), ``replace`` (the master adopts a warm spare into the dead
   rank's id at the next epoch and the fenced retry continues
-  bit-exactly), or ``shrink`` (survivors renumber contiguously and
-  continue at n-1 — reduction-only workloads). JOB-wide like
-  ``native_transport``. CONFLICTS with ``MP4J_MAX_RETRIES=0``: the
-  fenced retry IS the mechanism that re-runs the interrupted
-  collective after a membership change, so fail-stop mode hard-rejects
-  both elastic modes at setup (a validated-knob error, never a silent
-  precedence).
+  bit-exactly), ``shrink`` (survivors renumber contiguously and
+  continue at n-1 — reduction-only workloads), or ``grow`` (ISSUE 13:
+  replacement-on-death PLUS roster EXPANSION — registered spares are
+  adopted into NEW rank ids at an explicit app epoch boundary,
+  ``ProcessCommSlave.resize_point()``, gated by ``MP4J_AUTOSCALE=act``).
+  JOB-wide like ``native_transport``. CONFLICTS with
+  ``MP4J_MAX_RETRIES=0``: the fenced retry IS the mechanism that
+  re-runs the interrupted collective after a membership change, so
+  fail-stop mode hard-rejects every elastic mode at setup (a
+  validated-knob error, never a silent precedence).
 - ``MP4J_SPARES`` — how many warm-spare registrations the master's
   rendezvous waits for before starting the job (spares registered
   later, mid-job, are accepted too); 0 (default) starts without any.
@@ -160,6 +163,26 @@ Knobs (all validated where they are consumed; garbage raises
 - ``MP4J_HEALTH_DRIFT_PCT`` — how far (percent) a rank's per-family
   latency must rise above its OWN rolling baseline — with the log2-
   histogram bucket shift confirming — before the drift detector fires.
+- ``MP4J_AUTOSCALE`` — the closed-loop elastic autoscaler (ISSUE 13;
+  ``resilience/autoscaler.py``): ``off`` (default — the master runs no
+  controller, today's behavior bit-for-bit), ``observe`` (the
+  controller runs, evaluates the health verdicts and LOGS every action
+  it would take, but never acts), ``act`` (planned eviction of
+  ``EVICT_RECOMMENDED`` ranks, spare auto-provisioning at pool
+  exhaustion, and grow adoption at ``resize_point()`` boundaries all
+  fire autonomously, behind the safety rails). Master-side only.
+- ``MP4J_AUTOSCALE_COOLDOWN_SECS`` — minimum seconds between two
+  autoscaler actions of the same kind; the anti-flap rail (a verdict
+  that persists through the cooldown is a trend, not a blip).
+- ``MP4J_AUTOSCALE_BUDGET`` — job-lifetime cap on autoscaler actions;
+  a controller that wants action N+1 is oscillating, and a bounded
+  actuator is strictly safer than an unbounded one.
+- ``MP4J_PROVISION_CMD`` — operator hook command: when the warm-spare
+  pool drains to zero under ``MP4J_AUTOSCALE=act``, the master runs
+  this shell command (env ``MP4J_MASTER_HOST``/``MP4J_MASTER_PORT``
+  point at the rendezvous listener) to spawn a fresh ``spare=True``
+  process; empty disables the subprocess path (the
+  ``Master(provision_hook=)`` constructor seam still works).
 """
 
 from __future__ import annotations
@@ -218,7 +241,7 @@ DEFAULT_SINK_FLUSH_SECS = 1.0
 # still far below MP4J_DEAD_RANK_SECS so a dead spare costs one
 # deadline, not the whole recovery budget.
 DEFAULT_ELASTIC_MODE = "off"
-ELASTIC_MODES = ("off", "replace", "shrink")
+ELASTIC_MODES = ("off", "replace", "shrink", "grow")
 DEFAULT_SPARES = 0
 DEFAULT_ADOPT_SECS = 10.0
 # Metrics-plane default (ISSUE 6): the window the master's rate ring
@@ -681,6 +704,74 @@ def health_drift_pct() -> float:
     disabling the plane is ``MP4J_HEALTH=0``, not a zero threshold."""
     return env_float("MP4J_HEALTH_DRIFT_PCT", DEFAULT_HEALTH_DRIFT_PCT,
                      minimum=1.0)
+
+
+# Autoscaler defaults (ISSUE 13): OFF by default — acting on health
+# verdicts is an operator opt-in on top of the elastic machinery. The
+# cooldown is deliberately long relative to the health plane's
+# detection latency (one action per verdict trend, never per fold);
+# the budget bounds a flapping controller's lifetime damage.
+AUTOSCALE_MODES = ("off", "observe", "act")
+DEFAULT_AUTOSCALE_MODE = "off"
+DEFAULT_AUTOSCALE_COOLDOWN_SECS = 30.0
+DEFAULT_AUTOSCALE_BUDGET = 16
+
+
+def autoscale_mode(override=None) -> str:
+    """The autoscaler's mode (``MP4J_AUTOSCALE``): one of
+    :data:`AUTOSCALE_MODES`. ``override`` is the explicit
+    ``Master(autoscale=...)`` constructor value — same validation as
+    the env path (one validator per knob, the PR 5 discipline).
+    Master-side only: slaves never read it."""
+    if override is not None:
+        raw = str(override)
+    else:
+        raw = os.environ.get("MP4J_AUTOSCALE")
+        if raw is None or raw.strip() == "":
+            return DEFAULT_AUTOSCALE_MODE
+    name = raw.strip().lower()
+    if name not in AUTOSCALE_MODES:
+        raise Mp4jError(
+            f"MP4J_AUTOSCALE={raw!r} is not one of "
+            f"{list(AUTOSCALE_MODES)}")
+    return name
+
+
+def autoscale_cooldown_secs(override=None) -> float:
+    """Minimum seconds between two autoscaler actions of one kind
+    (``MP4J_AUTOSCALE_COOLDOWN_SECS``); >= 0 (0 is legal for tests —
+    the budget and the one-action-in-flight rule still bound the
+    controller)."""
+    if override is None:
+        return env_float("MP4J_AUTOSCALE_COOLDOWN_SECS",
+                         DEFAULT_AUTOSCALE_COOLDOWN_SECS, minimum=0.0)
+    val = float(override)
+    if val < 0:
+        raise Mp4jError(
+            f"autoscale_cooldown={override} must be >= 0")
+    return val
+
+
+def autoscale_budget(override=None) -> int:
+    """Job-lifetime autoscaler action cap (``MP4J_AUTOSCALE_BUDGET``);
+    must be >= 1 — disabling the controller is ``MP4J_AUTOSCALE=off``,
+    not a zero budget."""
+    if override is None:
+        return env_int("MP4J_AUTOSCALE_BUDGET",
+                       DEFAULT_AUTOSCALE_BUDGET, minimum=1)
+    val = int(override)
+    if val < 1:
+        raise Mp4jError(f"autoscale_budget={override} must be >= 1")
+    return val
+
+
+def provision_cmd() -> str:
+    """The operator's spare-provisioning shell command
+    (``MP4J_PROVISION_CMD``; '' disables the subprocess hook). Run by
+    the master with ``MP4J_MASTER_HOST``/``MP4J_MASTER_PORT`` in the
+    environment when the warm-spare pool drains to zero under
+    ``MP4J_AUTOSCALE=act``."""
+    return os.environ.get("MP4J_PROVISION_CMD", "").strip()
 
 
 def fault_plan_spec() -> str:
