@@ -936,6 +936,37 @@ def bench_audit_overhead(rounds=2):
     }
 
 
+def bench_lint_runtime(reps=3):
+    """ISSUE 14: mp4j-lint's own runtime over this repo, per-file pass
+    vs the full two-pass run (per-file rules + the whole-program
+    R19-R21 index/lock-model pass). The whole-program mode rides the
+    tier-1 gate on every CI run, so its cost is tracked like any other
+    figure; budget: the full run stays <= 2x the per-file pass."""
+    import time as _time
+
+    from ytk_mp4j_tpu.analysis.engine import Engine, ProgramRule
+    from ytk_mp4j_tpu.analysis.rules import get_rules
+
+    pkg = os.path.dirname(os.path.abspath(
+        __import__("ytk_mp4j_tpu").__file__))
+    per_file = inf = float("inf")
+    full = inf
+    for _ in range(reps):
+        rules = [r for r in get_rules()
+                 if not isinstance(r, ProgramRule)]
+        t0 = _time.perf_counter()
+        Engine(rules=rules).lint_paths([pkg])
+        per_file = min(per_file, _time.perf_counter() - t0)
+        t0 = _time.perf_counter()
+        Engine().lint_paths([pkg])
+        full = min(full, _time.perf_counter() - t0)
+    return {
+        "lint_runtime_secs": round(full, 3),
+        "lint_perfile_secs": round(per_file, 3),
+        "lint_wholeprogram_ratio": round(full / per_file, 3),
+    }
+
+
 def bench_sink_overhead(rounds=2):
     """ISSUE 9 acceptance workload: interleaved A/B of the durable
     telemetry sink on the isolated headline collective leg — sink off
@@ -1292,6 +1323,7 @@ def main():
     # sink_dir="" the way they pin shm=False / audit="off")
     sink_overhead = bench_sink_overhead()
     health_overhead = bench_health_overhead()
+    lint_runtime = bench_lint_runtime()
     # metrics-plane overhead A/B (ISSUE 6 acceptance: <= 3% on the
     # headline leg): the same isolated collective leg with
     # MP4J_METRICS=0 — histogram observes become flag checks, the
@@ -1505,6 +1537,12 @@ def main():
             "health_overhead": health_overhead,
             "socket_collective_gbs_health_on":
                 health_overhead["socket_collective_gbs_health_on"],
+            # mp4j-lint runtime (ISSUE 14): the whole-program R19-R21
+            # pass rides the tier-1 gate, so its cost is a tracked
+            # figure — full two-pass run vs the per-file pass alone
+            # (budget: <= 2x)
+            "lint_runtime": lint_runtime,
+            "lint_runtime_secs": lint_runtime["lint_runtime_secs"],
             "metrics_overhead": {
                 # False means the caller exported MP4J_METRICS=0 and
                 # the "on" leg really ran off — overhead_pct is then

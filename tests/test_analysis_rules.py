@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 19)]
+    assert ids == [f"R{i}" for i in range(1, 22)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 

@@ -342,9 +342,18 @@ def test_autopilot_evicts_slow_rank_autonomously(fast_detection,
     # the spare resumed mid-job (not from 0): the loop really was
     # closed mid-flight, not restarted
     assert 0 < spares[0]["resume_seq"] < rounds
-    # the controller's ledger: exactly one action, no failures
+    # the controller's ledger: ONE net eviction, no failures. Under a
+    # scheduler tail the boundary fence can cancel benignly and
+    # re-dispatch after the cooldown — by design the dispatch counter
+    # stays monotone (Prometheus rate()) and the refund lands in
+    # `retried`, so net = dispatched - retried (seen 1-in-5 on the
+    # loaded 1-core CI host; the single-eviction outcome assertions
+    # above are unchanged)
     asc = master.autoscale_status()
-    assert asc["actions"]["evict_replace"] == 1
+    dispatched = asc["actions"]["evict_replace"]
+    retried = asc["retried"].get("evict_replace", 0)
+    assert dispatched - retried == 1, asc
+    assert not any(asc["failures"].values()), asc
     assert not asc["tripped"]
     assert master.membership_status()["planned_evictions"] == 1
     assert "planned eviction" in log
@@ -414,7 +423,11 @@ def test_autopilot_provisions_spare_when_pool_drains(fast_detection,
         _check_analytic(vals, rounds)
     asc = master.autoscale_status()
     assert asc["actions"]["provision"] >= 1
-    assert asc["actions"]["evict_replace"] == 1
+    # net evictions (dispatched minus benign fence-cancel retries; the
+    # dispatch counter is monotone by design — see the autonomous-evict
+    # test's ledger note)
+    assert asc["actions"]["evict_replace"] \
+        - asc["retried"].get("evict_replace", 0) == 1, asc
     assert not asc["tripped"]
 
 

@@ -1,9 +1,13 @@
 """Tier-1 gate: the comm stack must lint clean forever.
 
-Runs mp4j-lint (all rules, committed baseline) over the installed
-``ytk_mp4j_tpu`` package and fails on any unsuppressed finding — the
-static analogue of the differential tests: every future PR to comm/,
-ops/, models/ inherits the protocol checks by construction.
+Runs mp4j-lint (all rules, committed baseline, STRICT baseline mode
+since ISSUE 14) over the installed ``ytk_mp4j_tpu`` package and fails
+on any unsuppressed finding — the static analogue of the differential
+tests: every future PR to comm/, ops/, models/ inherits the protocol
+checks by construction. The whole-program rules R19-R21 run here too
+(the package is the program), and the discovered lock-order graph
+must stay cycle-free: the concurrency disciplines the PR texts state
+in prose are a checked invariant from this gate on.
 
 Also proves the gate has teeth: a scratch file seeded with a deliberate
 rank-conditional collective must be reported by R1 at the right
@@ -31,6 +35,33 @@ def test_cli_exits_zero_on_repo():
     assert main([PKG_DIR]) == 0
 
 
+def test_cli_exits_zero_on_repo_strict():
+    # strict mode: a baseline entry matching no finding is a B001
+    # error — the accepted surface shrinks with the code
+    assert main([PKG_DIR, "--strict"]) == 0
+
+
+def test_package_lock_order_graph_is_cycle_free():
+    """The job-wide lock-order graph over the real package has no
+    cycle — the "master -> controller only" / outbox disciplines are
+    machine-checked from this PR on (ISSUE 14 acceptance)."""
+    from ytk_mp4j_tpu.analysis.engine import Engine, Program
+    contexts, errors = Engine(rules=[]).load_contexts([PKG_DIR])
+    assert not errors, errors
+    model = Program(contexts).locks
+    # sanity: the model actually sees the package's lock landscape
+    # (a refactor that silently blinds discovery must fail loudly)
+    displays = {d.display for d in model.locks.values()}
+    assert {"Master._lock", "_Slot.lock", "Autoscaler._lock",
+            "ProcessCommSlave._tel_lock",
+            "ProcessCommSlave._master_lock"} <= displays
+    assert len(model.edges) >= 2, "order edges vanished — model blind?"
+    assert model.cycles() == [], (
+        "lock-order cycle introduced:\n" + "\n".join(
+            "  " + " <-> ".join(model.locks[k].display for k in scc)
+            for scc in model.cycles()))
+
+
 def test_committed_baseline_exists_and_is_fully_used():
     assert os.path.exists(DEFAULT_BASELINE)
     from ytk_mp4j_tpu.analysis import baseline as baseline_mod
@@ -39,10 +70,12 @@ def test_committed_baseline_exists_and_is_fully_used():
     assert all(e.reason for e in bl.entries), \
         "every baseline entry needs a recorded reason"
     # every committed suppression must still match a real finding —
-    # stale entries would silently widen the accepted surface
+    # stale entries are B001 findings in strict mode, so the gate
+    # enforces it structurally; this asserts the engine-level view
     from ytk_mp4j_tpu.analysis.engine import Engine
-    result = Engine(baseline=bl).lint_paths([PKG_DIR])
-    assert result.ok
+    result = Engine(baseline=bl, strict_baseline=True,
+                    baseline_path=DEFAULT_BASELINE).lint_paths([PKG_DIR])
+    assert result.ok, "\n".join(f.format() for f in result.findings)
     assert not bl.unused(), \
         f"stale baseline entries: {bl.unused()}"
 

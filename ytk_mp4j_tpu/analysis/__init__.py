@@ -9,12 +9,20 @@ of the protocol checkers production MPI/NCCL stacks ship.
 
 Pieces:
 
-- :mod:`~ytk_mp4j_tpu.analysis.engine` — visitor framework and driver;
-- :mod:`~ytk_mp4j_tpu.analysis.rules` — one module per rule (R1..R7);
+- :mod:`~ytk_mp4j_tpu.analysis.engine` — visitor framework and the
+  two-pass driver (per-file rules + whole-program
+  :class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule` instances);
+- :mod:`~ytk_mp4j_tpu.analysis.callgraph` — package index +
+  conservative call graph (ISSUE 14);
+- :mod:`~ytk_mp4j_tpu.analysis.locks` — lock discovery, held-set
+  propagation and the job-wide lock-order graph the R19-R21
+  concurrency rules (and ``mp4j-lint graph``) ride;
+- :mod:`~ytk_mp4j_tpu.analysis.rules` — one module per rule (R1..R21);
 - :mod:`~ytk_mp4j_tpu.analysis.report` — findings with file:line and
   severity;
 - :mod:`~ytk_mp4j_tpu.analysis.baseline` — the committed suppression
-  file ``baseline.toml``;
+  file ``baseline.toml`` (stale entries are ``B001`` findings in the
+  tier-1 gate's ``--strict`` mode);
 - :mod:`~ytk_mp4j_tpu.analysis.cli` — the ``mp4j-lint`` entry point
   (also ``python -m ytk_mp4j_tpu.analysis``).
 """

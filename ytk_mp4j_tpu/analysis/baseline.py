@@ -40,6 +40,7 @@ class Entry:
     context: str = ""       # "" matches any scope
     contains: str = ""      # "" matches any message
     reason: str = ""
+    line: int = 0           # the entry's own [[suppression]] line
 
     def match(self, f: Finding) -> bool:
         if f.rule != self.rule:
@@ -70,6 +71,7 @@ class Baseline:
 def parse(text: str) -> Baseline:
     entries: list[Entry] = []
     current: dict[str, str] | None = None
+    current_line = 0
 
     def flush():
         nonlocal current
@@ -81,7 +83,8 @@ def parse(text: str) -> Baseline:
                 rule=current["rule"], file=current["file"],
                 context=current.get("context", ""),
                 contains=current.get("contains", ""),
-                reason=current.get("reason", "")))
+                reason=current.get("reason", ""),
+                line=current_line))
             current = None
 
     for lineno, raw in enumerate(text.splitlines(), start=1):
@@ -91,6 +94,7 @@ def parse(text: str) -> Baseline:
         if _TABLE_RE.match(line):
             flush()
             current = {}
+            current_line = lineno
             continue
         m = _KV_RE.match(line)
         if m and current is not None:
@@ -118,6 +122,31 @@ def _portable_path(path: str) -> str:
     if posixpath.isabs(p) and "/ytk_mp4j_tpu/" in p:
         p = "ytk_mp4j_tpu/" + p.rsplit("/ytk_mp4j_tpu/", 1)[1]
     return p
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace('"', '\\"') + '"'
+
+
+def render_entries(entries: list[Entry],
+                   header: str | None = None) -> str:
+    """Baseline text re-serializing ``entries`` verbatim (reasons and
+    ``contains`` keys preserved) — ``--prune-baseline`` rewrites the
+    committed file through this so dropping stale entries never
+    degrades the kept ones."""
+    lines = [header if header is not None else
+             "# mp4j-lint baseline — accepted findings with reasons.",
+             ""]
+    for e in entries:
+        lines += ["[[suppression]]",
+                  f"rule = {_quote(e.rule)}",
+                  f"file = {_quote(e.file)}"]
+        if e.context:
+            lines.append(f"context = {_quote(e.context)}")
+        if e.contains:
+            lines.append(f"contains = {_quote(e.contains)}")
+        lines += [f"reason = {_quote(e.reason)}", ""]
+    return "\n".join(lines)
 
 
 def render(findings, reason: str = "accepted by baseline") -> str:

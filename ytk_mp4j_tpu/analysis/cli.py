@@ -3,6 +3,11 @@
 Usage::
 
     mp4j-lint [paths...]              # default: ytk_mp4j_tpu
+    mp4j-lint --json                  # machine-readable findings
+    mp4j-lint --explain R20           # catalogue entry + firing example
+    mp4j-lint --strict                # stale baseline entries are findings
+    mp4j-lint --prune-baseline        # rewrite the baseline minus stale rows
+    mp4j-lint graph --dot             # the discovered lock-order graph
     python -m ytk_mp4j_tpu.analysis ytk_mp4j_tpu/
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 bad invocation or
@@ -16,11 +21,12 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import textwrap
 
 from ytk_mp4j_tpu.analysis import baseline as baseline_mod
-from ytk_mp4j_tpu.analysis.engine import Engine
+from ytk_mp4j_tpu.analysis.engine import Engine, Program, ProgramRule
 from ytk_mp4j_tpu.analysis.report import render_json, render_text
-from ytk_mp4j_tpu.analysis.rules import ALL_RULES, get_rules
+from ytk_mp4j_tpu.analysis.rules import ALL_RULES, RULES_BY_ID, get_rules
 from ytk_mp4j_tpu.exceptions import Mp4jError
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
@@ -42,22 +48,140 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--select", default=None, metavar="R1,R2,...",
                     help="run only these rule ids")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json (editors/CI)")
+    ap.add_argument("--strict", action="store_true",
+                    help="stale baseline entries (matching no finding) "
+                         "are B001 findings — the tier-1 gate's mode")
     ap.add_argument("--write-baseline", metavar="PATH", default=None,
                     help="write a baseline accepting the current "
                          "unsuppressed findings, then exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline file keeping only the "
+                         "entries that still match a finding")
+    ap.add_argument("--explain", metavar="RN", default=None,
+                    help="print one rule's catalogue entry and a "
+                         "firing example, then exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
     return ap
 
 
+def _build_graph_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mp4j-lint graph",
+        description=("dump the whole-program lock-order graph "
+                     "discovered by the R19-R21 analysis: nodes are "
+                     "lock attributes with their defining class, edges "
+                     "are observed acquisition orders with one witness "
+                     "call chain each"))
+    ap.add_argument("paths", nargs="*", default=["ytk_mp4j_tpu"])
+    ap.add_argument("--dot", action="store_true",
+                    help="GraphViz DOT output (default: text edges)")
+    ap.add_argument("-o", "--output", default=None, metavar="FILE",
+                    help="write to FILE instead of stdout")
+    return ap
+
+
+def _explain(rule_id: str) -> int:
+    cls = RULES_BY_ID.get(rule_id)
+    if cls is None:
+        print(f"mp4j-lint: unknown rule id {rule_id!r} "
+              f"(see --list-rules)", file=sys.stderr)
+        return 2
+    print(f"{cls.rule_id} ({cls.severity!s}) — {cls.title}")
+    print()
+    print(textwrap.fill(cls.description, width=72))
+    example = getattr(cls, "example", "")
+    if example:
+        print("\nfiring example:\n")
+        for line in example.rstrip().splitlines():
+            print("    " + line)
+        # show the rule actually firing on its own example — the
+        # catalogue stays honest by construction (tested in tier-1)
+        rule = cls()
+        eng = Engine(rules=[rule])
+        path = getattr(cls, "example_path",
+                       "ytk_mp4j_tpu/comm/example.py")
+        result = eng.lint_source(example, path)
+        hits = [f for f in result.findings if f.rule == cls.rule_id]
+        print("\nfires:")
+        for f in hits:
+            print(f"    line {f.line}: {f.message[:100]}"
+                  + ("..." if len(f.message) > 100 else ""))
+        if not hits:
+            print("    (example did not fire — catalogue bug)")
+            return 2
+    return 0
+
+
+def _graph_main(argv) -> int:
+    args = _build_graph_parser().parse_args(argv)
+    contexts, errors = Engine(rules=[]).load_contexts(args.paths)
+    for f in errors:
+        print(f"mp4j-lint graph: skipped {f.path}: {f.message}",
+              file=sys.stderr)
+    if not contexts:
+        print("mp4j-lint graph: no parsable files", file=sys.stderr)
+        return 2
+    model = Program(contexts).locks
+    if args.dot:
+        out = model.to_dot()
+    else:
+        lines = [f"{len(model.locks)} locks, {len(model.edges)} "
+                 f"order edges, {len(model.cycles())} cycle(s)"]
+        for (_s, _d), e in sorted(model.edges.items()):
+            lines.append("  " + model.format_witness(e))
+        for scc in model.cycles():
+            lines.append("  CYCLE: " + " <-> ".join(
+                model.locks[k].display for k in scc))
+        out = "\n".join(lines)
+    if args.output:
+        tmp = args.output + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(out + "\n")
+        os.replace(tmp, args.output)
+        print(f"mp4j-lint: wrote {args.output}")
+    else:
+        print(out)
+    return 0
+
+
+def _baseline_header(path: str) -> str | None:
+    """The leading comment block of the committed baseline, preserved
+    across --prune-baseline rewrites."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    head: list[str] = []
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            head.append(line)
+        else:
+            break
+    while head and not head[-1].strip():
+        head.pop()
+    return "\n".join(head) if head else None
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "graph":
+        return _graph_main(argv[1:])
     args = _build_parser().parse_args(argv)
 
     if args.list_rules:
         for cls in ALL_RULES:
-            print(f"{cls.rule_id}  {cls.severity!s:7s} {cls.title}: "
-                  f"{cls.description}")
+            scope = ("whole-program"
+                     if issubclass(cls, ProgramRule) else "per-file")
+            print(f"{cls.rule_id}  {cls.severity!s:7s} [{scope}] "
+                  f"{cls.title}: {cls.description}")
         return 0
+    if args.explain:
+        return _explain(args.explain.strip())
 
     select = None
     if args.select:
@@ -80,8 +204,15 @@ def main(argv=None) -> int:
             print(f"mp4j-lint: bad baseline {args.baseline}: {e}",
                   file=sys.stderr)
             return 2
+    if args.prune_baseline and bl is None:
+        print("mp4j-lint: --prune-baseline needs a readable baseline",
+              file=sys.stderr)
+        return 2
 
-    result = Engine(rules=rules, baseline=bl).lint_paths(args.paths)
+    eng = Engine(rules=rules, baseline=bl,
+                 strict_baseline=args.strict,
+                 baseline_path=args.baseline)
+    result = eng.lint_paths(args.paths)
 
     if args.write_baseline:
         text = baseline_mod.render(result.findings,
@@ -92,11 +223,31 @@ def main(argv=None) -> int:
               f"to {args.write_baseline}")
         return 0
 
-    if args.format == "json":
+    if args.prune_baseline:
+        # only entries PROVABLY stale for this run are dropped: their
+        # rule ran and their file was in scope — `--select R18
+        # --prune-baseline` or a single-file path keeps every entry it
+        # could not judge (code-review finding)
+        stale_ids = {id(e) for e in eng.stale_entries(
+            eng.last_linted_paths)}
+        kept = [e for e in bl.entries if id(e) not in stale_ids]
+        stale = len(bl.entries) - len(kept)
+        text = baseline_mod.render_entries(
+            kept, header=_baseline_header(args.baseline))
+        tmp = args.baseline + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        os.replace(tmp, args.baseline)
+        print(f"mp4j-lint: pruned {stale} stale entr"
+              f"{'y' if stale == 1 else 'ies'}, kept {len(kept)} "
+              f"in {args.baseline}")
+        return 0
+
+    if args.format == "json" or args.json:
         print(render_json(result.findings, len(result.suppressed)))
     else:
         print(render_text(result.findings, len(result.suppressed)))
-        if bl is not None:
+        if bl is not None and not args.strict:
             for e in bl.unused():
                 print(f"note: unused baseline suppression "
                       f"({e.rule} {e.file} {e.context})", file=sys.stderr)

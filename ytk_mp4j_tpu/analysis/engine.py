@@ -112,6 +112,11 @@ class Rule(ast.NodeVisitor):
     severity: Severity = Severity.WARNING
     title: str = ""
     description: str = ""
+    # a minimal self-contained snippet the rule FIRES on, shown by
+    # `mp4j-lint --explain RN` (and executed there, so the catalogue
+    # stays honest); example_path places it for dir-scoped rules
+    example: str = ""
+    example_path: str = "ytk_mp4j_tpu/comm/example.py"
 
     def run(self, ctx: LintContext) -> list[Finding]:
         self.ctx = ctx
@@ -153,6 +158,59 @@ class Rule(ast.NodeVisitor):
         ))
 
 
+class ProgramRule:
+    """Base class for WHOLE-PROGRAM rules (ISSUE 14).
+
+    Per-file rules are blind to cross-function interleavings — a lock
+    acquired here and a blocking call three frames deeper in another
+    module. A ProgramRule runs ONCE over the whole indexed path set:
+    ``run_program(program)`` receives a :class:`Program` exposing the
+    package index (``program.index``) and the lock model
+    (``program.locks``) and returns findings pinned to real
+    file:line sites, so inline and baseline suppression apply
+    unchanged."""
+
+    rule_id: str = "R?"
+    severity: Severity = Severity.ERROR
+    title: str = ""
+    description: str = ""
+    example: str = ""
+    example_path: str = "ytk_mp4j_tpu/comm/example.py"
+
+    def run_program(self, program: "Program") -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, message: str,
+                context: str = "<module>", col: int = 1) -> Finding:
+        return Finding(rule=self.rule_id, severity=self.severity,
+                       path=path, line=line, col=col, message=message,
+                       context=context)
+
+
+class Program:
+    """The parsed path set seen whole; index and lock model are built
+    lazily and shared by every ProgramRule of one engine run."""
+
+    def __init__(self, contexts: list[LintContext]):
+        self.contexts = contexts
+        self._index = None
+        self._locks = None
+
+    @property
+    def index(self):
+        if self._index is None:
+            from ytk_mp4j_tpu.analysis.callgraph import ProgramIndex
+            self._index = ProgramIndex(self.contexts)
+        return self._index
+
+    @property
+    def locks(self):
+        if self._locks is None:
+            from ytk_mp4j_tpu.analysis.locks import LockModel
+            self._locks = LockModel(self.index)
+        return self._locks
+
+
 @dataclasses.dataclass
 class LintResult:
     findings: list[Finding]          # unsuppressed
@@ -164,14 +222,35 @@ class LintResult:
 
 
 class Engine:
-    """Run a set of rules over files, applying suppressions."""
+    """Run a set of rules over files, applying suppressions.
 
-    def __init__(self, rules=None, baseline=None):
+    Two-pass since ISSUE 14: per-file rules run file by file as
+    always; :class:`ProgramRule` instances run once over a
+    :class:`Program` built from every parsed file of the invocation —
+    so ``mp4j-lint path.py`` still works (the program is that one
+    file) while the tier-1 gate over the package runs the
+    interprocedural rules whole-program.
+
+    ``strict_baseline=True`` additionally reports every baseline entry
+    that matched NO finding as a ``B001`` error pinned at the entry's
+    own line — the baseline must stay honest as code moves. Strict
+    mode only makes sense when linting the full path set the baseline
+    was written against (the tier-1 gate); single-file invocations
+    leave it off."""
+
+    def __init__(self, rules=None, baseline=None,
+                 strict_baseline: bool = False,
+                 baseline_path: str | None = None):
         if rules is None:
             from ytk_mp4j_tpu.analysis.rules import get_rules
             rules = get_rules()
-        self.rules = list(rules)
+        self.rules = [r for r in rules if not isinstance(r, ProgramRule)]
+        self.program_rules = [r for r in rules
+                              if isinstance(r, ProgramRule)]
         self.baseline = baseline     # analysis.baseline.Baseline or None
+        self.strict_baseline = strict_baseline
+        self.baseline_path = baseline_path
+        self.last_linted_paths: list[str] = []
 
     # -- file discovery -------------------------------------------------
     @staticmethod
@@ -190,13 +269,36 @@ class Engine:
         return out
 
     # -- entry points ---------------------------------------------------
-    def lint_paths(self, paths) -> LintResult:
-        findings: list[Finding] = []
-        suppressed: list[Finding] = []
+    def load_contexts(self, paths):
+        """Collect and parse ``paths`` into lint contexts. Returns
+        ``(contexts, error_findings)`` — unreadable/unparsable files
+        become E001 findings instead of vanishing. The shared loader
+        for :meth:`lint_paths`, the ``graph`` subcommand and the
+        tier-1 cycle-free gate."""
+        contexts: list[LintContext] = []
+        errors: list[Finding] = []
         for path in self.collect_files(paths):
-            r = self.lint_file(path)
+            ctx, errs = self._load(path)
+            if ctx is None:
+                errors.extend(errs)
+            else:
+                contexts.append(ctx)
+        return contexts, errors
+
+    def lint_paths(self, paths) -> LintResult:
+        contexts, findings = self.load_contexts(paths)
+        # stashed for callers needing post-run staleness (CLI prune)
+        self.last_linted_paths = [ctx.path for ctx in contexts]
+        suppressed: list[Finding] = []
+        for ctx in contexts:
+            r = self._run_file_rules(ctx)
             findings.extend(r.findings)
             suppressed.extend(r.suppressed)
+        r = self._run_program_rules(contexts)
+        findings.extend(r.findings)
+        suppressed.extend(r.suppressed)
+        findings.extend(self._stale_baseline_findings(
+            self.last_linted_paths))
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
         return LintResult(findings, suppressed)
 
@@ -211,28 +313,116 @@ class Engine:
         return self.lint_source(source, path)
 
     def lint_source(self, source: str, path: str = "<string>") -> LintResult:
+        ctx, errs = self._parse(source, path)
+        if ctx is None:
+            return LintResult(errs, [])
+        r = self._run_file_rules(ctx)
+        rp = self._run_program_rules([ctx])
+        return LintResult(r.findings + rp.findings,
+                          r.suppressed + rp.suppressed)
+
+    # -- internals ------------------------------------------------------
+    def _load(self, path: str):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            return None, [Finding(
+                "E001", Severity.ERROR, path.replace(os.sep, "/"),
+                0, 1, f"cannot read file: {e}")]
+        return self._parse(source, path)
+
+    def _parse(self, source: str, path: str):
         display = path.replace(os.sep, "/")
         try:
             tree = ast.parse(source, filename=path)
         except SyntaxError as e:
-            return LintResult([Finding(
+            return None, [Finding(
                 "E001", Severity.ERROR, display,
                 e.lineno or 0, (e.offset or 0) or 1,
-                f"syntax error: {e.msg}")], [])
-        ctx = LintContext(
+                f"syntax error: {e.msg}")]
+        return LintContext(
             path=display,
             tree=tree,
             source=source,
             suppressions=parse_inline_suppressions(source),
-        )
+        ), []
+
+    def _apply_suppressions(self, raw, ctx_by_path) -> LintResult:
         keep: list[Finding] = []
         dropped: list[Finding] = []
-        for rule in self.rules:
-            for f in rule.run(ctx):
-                if ctx.is_inline_suppressed(f.rule, f.line):
-                    dropped.append(f)
-                elif self.baseline is not None and self.baseline.match(f):
-                    dropped.append(f)
-                else:
-                    keep.append(f)
+        for f in raw:
+            ctx = ctx_by_path.get(f.path)
+            if ctx is not None \
+                    and ctx.is_inline_suppressed(f.rule, f.line):
+                dropped.append(f)
+            elif self.baseline is not None and self.baseline.match(f):
+                dropped.append(f)
+            else:
+                keep.append(f)
         return LintResult(keep, dropped)
+
+    def _run_file_rules(self, ctx: LintContext) -> LintResult:
+        raw: list[Finding] = []
+        for rule in self.rules:
+            raw.extend(rule.run(ctx))
+        return self._apply_suppressions(raw, {ctx.path: ctx})
+
+    def _run_program_rules(self, contexts) -> LintResult:
+        if not self.program_rules or not contexts:
+            return LintResult([], [])
+        program = Program(contexts)
+        raw: list[Finding] = []
+        for rule in self.program_rules:
+            raw.extend(rule.run_program(program))
+        return self._apply_suppressions(
+            raw, {ctx.path: ctx for ctx in contexts})
+
+    def stale_entries(self, linted_paths) -> list:
+        """Baseline entries provably stale for THIS run: unused, AND
+        their rule actually ran, AND the linted path set plausibly
+        covered their file — a ``--select R18`` or single-file
+        invocation cannot prove anything stale about entries it never
+        looked at (code-review finding: prune/strict on a partial run
+        used to condemn every live entry outside the run's scope).
+
+        Coverage is per entry: its exact file was linted, or some
+        linted path lives under the entry's top-level package segment
+        (so whole-package and tmp-tree runs see deleted-file entries
+        as stale, while ``mp4j-lint one_file.py`` only judges that
+        file's entries). A SUBTREE run (`mp4j-lint ytk_mp4j_tpu/obs`)
+        still treats package-mate entries as in scope — run
+        strict/prune from the package root."""
+        if self.baseline is None:
+            return []
+        rule_ids = {r.rule_id for r in self.rules} \
+            | {r.rule_id for r in self.program_rules}
+        out = []
+        for e in self.baseline.unused():
+            if e.rule not in rule_ids:
+                continue
+            top = e.file.split("/")[0]
+            covered = any(
+                p == e.file or p.endswith("/" + e.file)
+                or p.startswith(top + "/") or ("/" + top + "/") in p
+                for p in linted_paths)
+            if covered:
+                out.append(e)
+        return out
+
+    def _stale_baseline_findings(self, linted_paths) -> list[Finding]:
+        """Strict mode: an unused baseline entry is itself a finding —
+        the accepted surface must shrink with the code, or a revived
+        hazard at a moved site sails through on a stale excuse."""
+        if not self.strict_baseline or self.baseline is None:
+            return []
+        path = (self.baseline_path or "baseline.toml").replace(
+            os.sep, "/")
+        return [Finding(
+            "B001", Severity.ERROR, path, e.line, 1,
+            f"stale baseline entry ({e.rule} {e.file}"
+            + (f" {e.context}" if e.context else "")
+            + ") no longer matches any finding — remove it (mp4j-lint "
+            "--prune-baseline) or re-justify it against a live site",
+            context="<baseline>")
+            for e in self.stale_entries(linted_paths)]

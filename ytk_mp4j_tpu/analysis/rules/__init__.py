@@ -20,6 +20,13 @@
 | R16 | error   | un-awaited CollectiveFuture crosses a boundary |
 | R17 | error   | metric family missing from METRICS_DOC |
 | R18 | error   | bare time.sleep() inside a while loop (control code) |
+| R19 | error   | lock-order cycle (whole-program) |
+| R20 | error   | blocking call under a held lock (whole-program) |
+| R21 | error   | callback/dispatch under the minting lock (whole-program) |
+
+R19-R21 are :class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule`
+instances: they run once over the whole indexed path set (call graph
++ lock model) instead of file by file.
 """
 
 from __future__ import annotations
@@ -56,6 +63,11 @@ from ytk_mp4j_tpu.analysis.rules.r16_unawaited_future import (
     R16UnawaitedFuture)
 from ytk_mp4j_tpu.analysis.rules.r17_metric_doc import R17MetricDoc
 from ytk_mp4j_tpu.analysis.rules.r18_sleep_loop import R18SleepLoop
+from ytk_mp4j_tpu.analysis.rules.r19_lock_order import R19LockOrderCycle
+from ytk_mp4j_tpu.analysis.rules.r20_blocking_under_lock import (
+    R20BlockingUnderLock)
+from ytk_mp4j_tpu.analysis.rules.r21_callback_under_lock import (
+    R21CallbackUnderLock)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -76,6 +88,9 @@ ALL_RULES = [
     R16UnawaitedFuture,
     R17MetricDoc,
     R18SleepLoop,
+    R19LockOrderCycle,
+    R20BlockingUnderLock,
+    R21CallbackUnderLock,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

@@ -75,6 +75,12 @@ class R10EpochFenceBypass(Rule):
                    "recovery round it can write into (or steal frames "
                    "from) the retry's stream — acquire peer channels "
                    "via _fenced(peer) on every data path")
+    example = """\
+class ProcessCommSlave:
+    def _send(self, peer, data):
+        ch = self._channel(peer)        # not _fenced(peer)
+        ch.send_array(data)
+"""
 
     def visit_ClassDef(self, node):             # noqa: N802
         if self.ctx.in_dirs("comm") and "CommSlave" in node.name:

@@ -62,6 +62,14 @@ class R11WallClockDuration(Rule):
                    "time.perf_counter and deadlines time.monotonic; "
                    "wall clock only at the sanctioned trace-anchor / "
                    "artifact-timestamp sites")
+    example = """\
+import time
+
+def rendezvous(self):
+    deadline = time.time() + self.timeout   # NTP step breaks this
+    while time.time() < deadline:
+        self.accept_one()
+"""
 
     _MSG = ("wall-clock time.time() feeds duration/deadline "
             "arithmetic; use time.perf_counter (phases) or "

@@ -52,6 +52,13 @@ class R12TransportSpiBypass(Rule):
                    "epoch pinning, fault hooks and transport-tagged "
                    "stats; acquire channels through the slave's "
                    "fenced accessors (or transport.connect)")
+    example = """\
+import socket
+
+def open_side_channel(self):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    return s                    # bypasses the Channel SPI
+"""
 
     def visit_Call(self, node: ast.Call):       # noqa: N802
         if self.ctx.in_dirs("transport", "analysis"):

@@ -46,6 +46,10 @@ class R13DigestContiguity(Rule):
                    "and wire bytes a function of memory LAYOUT, not "
                    "values — a false-divergence hazard; pin with "
                    "np.ascontiguousarray (+ dtype/byte order) first")
+    example = """\
+def digest(arr):
+    return crc32(arr.tobytes())     # strided/BE layout changes bytes
+"""
 
     _MSG = ("{what} on {name!r} without a contiguity/dtype pin: a "
             "strided or non-native-endian array serializes different "

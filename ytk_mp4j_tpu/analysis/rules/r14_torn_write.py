@@ -39,6 +39,14 @@ class R14TornWrite(Rule):
                    "truncated artifact at the real path; write to a "
                    ".tmp sibling and os.replace it (append-only "
                    "crc-framed streams are baselined exceptions)")
+    example = """\
+import json
+
+def dump(path, obj):
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh)      # a crash mid-write leaves a torn file
+"""
+    example_path = "ytk_mp4j_tpu/obs/example.py"
 
     _MSG = ("open(..., {mode!r}) without os.replace in scope: a crash "
             "mid-write leaves a torn file at the visible path that "

@@ -92,6 +92,12 @@ class R15TopologyCache(Rule):
                    "cache silently answers with the OLD topology — "
                    "read through the roster-versioned accessor "
                    "(_set_roster's attributes) at use time instead")
+    example = """\
+class Slave:
+    def __init__(self, roster):
+        self._n = len(roster)
+        self._right = (self._rank + 1) % self._n    # stale after shrink
+"""
 
     def _in_scope(self) -> bool:
         # class bodies only: a module-level constant cannot cache a

@@ -51,6 +51,11 @@ class R16UnawaitedFuture(Rule):
     description = ("a future from an i* nonblocking collective is "
                    "never awaited before a blocking collective, "
                    "barrier, or close on the same comm")
+    example = """\
+def step(comm, x):
+    f = comm.iallreduce(x)
+    comm.barrier()              # f never awaited before the boundary
+"""
 
     def visit_FunctionDef(self, node):          # noqa: N802
         self._check_function(node)

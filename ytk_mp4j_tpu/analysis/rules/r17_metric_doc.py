@@ -66,6 +66,10 @@ class R17MetricDoc(Rule):
     description = ("a metric family is registered or rendered without "
                    "a matching obs.metrics.METRICS_DOC entry — an "
                    "undocumented series is invisible observability")
+    example = """\
+def book(self):
+    self._metrics.inc("nope/undocumented_family", 1)
+"""
 
     def visit_Call(self, node: ast.Call):         # noqa: N802
         name = call_name(node)

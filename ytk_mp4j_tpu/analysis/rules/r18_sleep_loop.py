@@ -45,6 +45,14 @@ class R18SleepLoop(Rule):
                    "with Event.wait(timeout), not time.sleep — a "
                    "sleeping controller can neither stop promptly "
                    "nor notice a trip")
+    example = """\
+import time
+
+def watchdog(self):
+    while not self._stop_flag:
+        self._tick()
+        time.sleep(0.5)         # deaf to the stop flag for 500 ms
+"""
 
     def run(self, ctx):
         self._while_depth = 0

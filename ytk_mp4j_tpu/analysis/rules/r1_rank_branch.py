@@ -33,6 +33,12 @@ class R1RankConditionalCollective(Rule):
     title = "rank-conditional collective"
     description = ("collective/barrier call inside a branch conditioned "
                    "on rank, without a matching call on the other arm")
+    example = """\
+def step(comm, grads):
+    comm.allreduce_array(grads)
+    if comm.rank == 0:
+        comm.barrier()          # ranks != 0 never arrive
+"""
 
     def visit_If(self, node: ast.If):           # noqa: N802
         if expr_mentions_rank(node.test):

@@ -51,6 +51,11 @@ class R2UnboundedSocketOp(Rule):
     title = "unbounded socket operation"
     description = ("socket/Channel recv/accept/sendall without a timeout "
                    "or enclosing transport-failure handling")
+    example = """\
+class Puller:
+    def pull(self):
+        return self.sock.recv(1024)     # no timeout, no handler
+"""
 
     def run(self, ctx):
         self._try_stack: list[ast.Try] = []

@@ -44,6 +44,11 @@ class R3SharedStateOutsideLock(Rule):
     description = ("_ThreadGroup shared state (slots/result/max_code/...) "
                    "mutated outside the group lock or a documented "
                    "barrier region")
+    example = """\
+class ThreadAllreduce:
+    def publish(self, value):
+        self._g.result = value          # outside `with self._g.lock`
+"""
 
     def run(self, ctx):
         self._with_lock_depth = 0

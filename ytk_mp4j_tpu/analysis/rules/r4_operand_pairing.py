@@ -45,6 +45,14 @@ class R4OperandPairing(Rule):
     title = "segment operand mismatch"
     description = ("paired _send_segment/_recv_segment call sites in one "
                    "collective pass different operands")
+    example = """\
+class C:
+    def bcast(self, arr, operand):
+        if self.rank == 0:
+            self._send_segment(1, arr, operand)
+        else:
+            self._recv_segment_into(0, arr, 0, 8, Operands.DOUBLE)
+"""
 
     def visit_FunctionDef(self, node):           # noqa: N802
         # own body only; nested defs are visited as their own functions

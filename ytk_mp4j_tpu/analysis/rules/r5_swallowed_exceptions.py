@@ -40,6 +40,13 @@ class R5SwallowedException(Rule):
     title = "swallowed exception in comm path"
     description = ("bare except (anywhere) or broad except with a no-op "
                    "body in comm/transport/ops hot paths")
+    example = """\
+def relay():
+    try:
+        forward()
+    except:                     # bare: catches KeyboardInterrupt too
+        raise RuntimeError("relay failed")
+"""
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler):  # noqa: N802
         if node.type is None:

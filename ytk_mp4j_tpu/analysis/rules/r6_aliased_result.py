@@ -45,6 +45,13 @@ class R6AliasedLeaderResult(Rule):
     title = "aliased slot returned from leader"
     description = ("fan-out leader returns slots[i] without _detach/copy "
                    "— result aliases one thread's input buffer")
+    example = """\
+class ThreadComm:
+    def allreduce(self):
+        def leader(slots):
+            acc = slots[0]      # alias into another thread's slot
+            return acc
+"""
 
     def visit_FunctionDef(self, node):           # noqa: N802
         if node.name == "leader" and node.args.args:

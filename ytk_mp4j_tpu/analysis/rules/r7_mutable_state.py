@@ -40,6 +40,10 @@ class R7MutableState(Rule):
     title = "shared mutable state"
     description = ("mutable default argument, or module-level mutable "
                    "container mutated at runtime in comm/ops/transport")
+    example = """\
+def accumulate(x, acc=[], *, opts={}):
+    acc.append(x)               # shared across EVERY call
+"""
 
     # -- mutable defaults ----------------------------------------------
     def visit_FunctionDef(self, node):           # noqa: N802

@@ -51,6 +51,11 @@ class R8RankLocalChunkSchedule(Rule):
     description = ("chunk-loop trip count depends on rank-local state; "
                    "peers would disagree on the number of transfers "
                    "and deadlock")
+    example = """\
+def exchange(self, arr):
+    for lo, hi in chunk_ranges(arr.size - self.rank, 8, CHUNK):
+        self._exchange_raw(1, 1, arr[lo:hi], None)
+"""
 
     def _check_header(self, node: ast.AST, header: ast.AST) -> None:
         if not self.ctx.in_dirs(*_SCHEDULE_DIRS):

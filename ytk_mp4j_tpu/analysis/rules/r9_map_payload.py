@@ -76,6 +76,11 @@ class R9PickledMapPayload(Rule):
                    "encoder; outside the negotiated fallback this "
                    "re-pays the per-call serialization the key codec "
                    "amortizes and can desync the wire plane")
+    example = """\
+def reduce_map(self, d, operand, operator, root):
+    acc = dict(d)
+    self._send(0, acc, compress=operand.compress)   # pickled dict
+"""
 
     def visit_FunctionDef(self, node):          # noqa: N802
         if self.ctx.in_dirs("comm") and "map" in node.name.lower():

@@ -343,6 +343,12 @@ class ProgressScheduler:
 
     def __init__(self, slave):
         self._s = slave
+        # force the one-time native load/build attempt HERE, on the
+        # constructing thread with no scheduler lock in existence yet:
+        # _full_ok consults the cached verdict from under _cv, and a
+        # lazy first load there would run g++ (subprocess, seconds)
+        # inside the lock every submit()/wait() needs (R20)
+        native.ensure_loaded()
         self._cv = threading.Condition()
         self._pending: collections.deque[_Item] = collections.deque()
         self._outstanding = 0

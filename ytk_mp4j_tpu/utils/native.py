@@ -201,6 +201,17 @@ def sendrecv_raw(send_fd: int, recv_fd: int, sarr: np.ndarray | None,
     return True
 
 
+def ensure_loaded() -> bool:
+    """Force the one-time load/build attempt NOW, on the caller's
+    thread, outside any lock the caller should be holding. The lazy
+    ``_load()`` path may shell out to g++ (seconds) the first time —
+    long-lived components that later consult the cached verdict from
+    under their own locks (the progression scheduler's ``_full_ok``
+    runs under its condition variable; mp4j-lint R20) call this at
+    construction so the build can never run inside a held region."""
+    return _load() is not None
+
+
 def have_progress_multi() -> bool:
     """Whether the native multi-leg progress driver is available (the
     nonblocking scheduler falls back to its pure-Python pumps when
@@ -278,8 +289,18 @@ def reduce_opcode(operator, dtype) -> int | None:
     """The (dtype, operator) native codes for a batch merge spec, or
     None when this combination has no native kernel (the engine then
     keeps the per-leg path whose merges run through reduce_into's
-    fallback)."""
-    if _load() is None or operator.native_code is None:
+    fallback).
+
+    Reads the CACHED load verdict only — never triggers the build.
+    The callers sit under the progression scheduler's condition
+    variable, and the first ``_load()`` may compile the extension
+    (``subprocess.run`` of g++, seconds): a build under that lock
+    stalls every submit()/wait() on the scheduler for its duration
+    (mp4j-lint R20, found by the whole-program pass). The scheduler
+    forces the one-time attempt via :func:`ensure_loaded` at
+    construction, so an unattempted verdict here means "no native
+    kernels", exactly like a missing toolchain."""
+    if not HAVE_NATIVE or _lib is None or operator.native_code is None:
         return None
     dt = np.dtype(dtype)
     if dt not in _DTYPE_CODES:
