@@ -164,13 +164,17 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
     # says; the async/health legs opt back in explicitly. autoscale
     # joins the pin list (ISSUE 13): a frozen figure must not move
     # because an operator exported MP4J_AUTOSCALE=act
-    mk = {"elastic": "off", "health": False, "autoscale": "off"}
+    mk = {"elastic": "off", "health": False, "autoscale": "off",
+          "tuner": "off"}
     mk.update(master_kwargs or {})
     master = Master(procs, timeout=60.0, **mk).serve_in_thread()
     q = ctx.Queue()
     slave_kwargs.setdefault("elastic", "off")
     slave_kwargs.setdefault("async_collectives", False)
     slave_kwargs.setdefault("health", False)
+    # frozen figures must not move because an operator exported
+    # MP4J_TUNER=act (ISSUE 15): the tuner's own A/B leg opts back in
+    slave_kwargs.setdefault("tuner", "off")
 
     def worker():
         try:
@@ -516,6 +520,85 @@ def bench_socket_coalesce(procs=4, maps=400, keys=16, window_us=500):
         else:
             os.environ["MP4J_COALESCE_USECS"] = prior
     return {"on": min(on), "off": min(off), "stats": stats}
+
+
+def bench_socket_tuner_act(procs=4, size=400_000, reps=6,
+                           warmup_secs=3.0):
+    """mp4j-tuner acceptance A/B (ISSUE 15): a compressed-operand
+    allreduce stream, ``MP4J_TUNER=off`` vs ``act`` on the same host.
+
+    The static policy zlib-compresses every frame (the operand says
+    so); the tuner's probe/measure cycle observes that the link's
+    plain payload rate beats the zlib-bound compressed rate by an
+    order of magnitude on this loopback host and commits
+    ``compress=False`` per link at a collective boundary. The act
+    figure must be the measured net win bench-diff gates
+    (``socket_tuner_act_gbs``); the ``tuner`` extra records the
+    decisions the act leg actually converged to, so the win is
+    attributable, not anecdotal. Both legs pay the same warmup wall
+    time (the act leg needs ~SUSTAIN_WINDOWS decision windows to
+    converge; the off leg idles the same period for thermal parity).
+    All-TCP (``shm=False``): loopback TCP is this host's
+    wire-vs-zlib contrast; the shm rings would only widen it."""
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    comp = Operands.compressed(Operands.DOUBLE)
+
+    def body(slave, r):
+        rng = np.random.default_rng(5)
+        arr = rng.integers(0, 3, size).astype(np.float64)
+        # convergence warmup: decision windows fold on the heartbeat
+        # cadence, so the act leg needs WALL time and boundaries (the
+        # off leg runs the same loop — parity, and a stronger static
+        # baseline via warm channels). The exit is AGREED through a
+        # MIN allreduce: a wall-clock-local break would leave ranks a
+        # collective apart and deadlock the schedule (R1's lesson)
+        deadline = time.monotonic() + warmup_secs
+        flag = np.zeros(1)
+        while True:
+            a = arr.copy()
+            slave.allreduce_array(a, comp, Operators.SUM)
+            flag[0] = 1.0 if time.monotonic() >= deadline else 0.0
+            slave.allreduce_array(flag, Operands.DOUBLE,
+                                  Operators.MIN)
+            if flag[0] == 1.0:
+                break
+        slave.barrier()
+        t0 = time.perf_counter()
+        nbytes = 0
+        for _ in range(reps):
+            a = arr.copy()
+            slave.allreduce_array(a, comp, Operators.SUM)
+            nbytes += arr.nbytes
+        rate = nbytes / (time.perf_counter() - t0)
+        st = slave.tuner_status()
+        return rate, st
+
+    out = {}
+    decisions = None
+    prior = {k: os.environ.get(k)
+             for k in ("MP4J_TUNER_WINDOW_SECS", "MP4J_HEARTBEAT_SECS")}
+    os.environ["MP4J_TUNER_WINDOW_SECS"] = "0.3"
+    os.environ["MP4J_HEARTBEAT_SECS"] = "0.1"
+    try:
+        for mode in ("off", "act"):
+            rates_status, _ = _run_socket_job(
+                procs, body, True, join_timeout=180.0, shm=False,
+                audit="off", sink_dir="", tuner=mode,
+                master_kwargs={"tuner": mode})
+            out[mode] = min(rate for rate, _ in rates_status) / 1e9
+            if mode == "act":
+                decisions = {i: st for i, (_, st)
+                             in enumerate(rates_status)}
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out["decisions"] = decisions
+    return out
 
 
 def bench_socket_recovery_latency(procs=4, reps=9, size=262_144):
@@ -1206,7 +1289,7 @@ def bench_device_map_chained(keys=50_000, chain=8):
 
 
 def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False,
-                     columnar=None, join_timeout=120.0):
+                     columnar=None, join_timeout=120.0, shm=False):
     """Map<String,Double> sparse-grad allreduce over loopback TCP
     (BASELINE.md configs[2]). Returns merged keys/sec on the job's
     DEFAULT map plane — since ISSUE 4, the columnar (codes, values)
@@ -1243,12 +1326,13 @@ def bench_socket_map(procs=4, keys=20_000, reps=3, int_keys=False,
             nkeys += len(d)   # post-merge union size = keys merged
         return nkeys / (time.perf_counter() - t0)
 
-    # all-TCP for figure continuity: the map keys/sec rows are
-    # bench-diff-gated against pre-shm rounds; the shm win is
-    # carried by the dedicated socket_shm/twolevel figures
+    # all-TCP by default for figure continuity: the map keys/sec rows
+    # are bench-diff-gated against pre-shm rounds; ``shm=True`` is the
+    # ISSUE 15 leg (socket_map_shm_keys_s) — co-located pairs ride the
+    # rings, and the frame-level routing carries the column frames
     rates, stats = _run_socket_job(procs, body, native_transport=False,
                                    join_timeout=join_timeout,
-                                   map_columnar=columnar, shm=False,
+                                   map_columnar=columnar, shm=shm,
                                    audit="off", sink_dir="")
     return min(rates), stats
 
@@ -1361,6 +1445,14 @@ def main():
     # coalescing A/B (window on vs off)
     async_overlap = bench_socket_async_overlap()
     coalesce = bench_socket_coalesce()
+    # ISSUE 15 (mp4j-tuner): the framed + columnar-map planes over the
+    # shm rings (frame-level routing — these bytes were carrier-bound
+    # before), and the tuner act-vs-off A/B on a compressed-operand
+    # stream (frozen legs everywhere else pin MP4J_TUNER=off)
+    framed_shm_gbs, framed_shm_stats = bench_socket_collective(
+        native_transport=False, shm=True)
+    map_shm_keys, map_shm_stats = bench_socket_map(shm=True)
+    tuner_ab = bench_socket_tuner_act()
     recovery, recovery_stats = bench_socket_recovery_latency()
     replacement = bench_socket_replacement_latency()
     shrinkage = bench_socket_shrink_latency()
@@ -1442,6 +1534,20 @@ def main():
                 coalesce["off"], 0),
             "socket_coalesce_ratio": round(
                 coalesce["on"] / coalesce["off"], 3),
+            # ISSUE 15 (mp4j-tuner): the framed/columnar-map planes
+            # over the shm rings (frame-level routing — previously
+            # carrier-bound even intra-host), and the tuner A/B: act
+            # must be a net win over off on this compressed-operand
+            # leg (the probe discovers the loopback link outruns the
+            # zlib bound and disables per-link compression); the
+            # `tuner` extra records the converged decisions
+            "socket_framed_shm_gbs": round(framed_shm_gbs, 4),
+            "socket_map_shm_keys_s": round(map_shm_keys, 0),
+            "socket_tuner_act_gbs": round(tuner_ab["act"], 4),
+            "socket_tuner_off_gbs": round(tuner_ab["off"], 4),
+            "socket_tuner_ratio": round(
+                tuner_ab["act"] / tuner_ab["off"], 3),
+            "tuner": tuner_ab["decisions"],
             # mp4j-resilience (ISSUE 5): one injected connection reset
             # in a 4-rank allreduce loop; recovery_latency_ms is the
             # full epoch-fenced abort/retry round end to end.
@@ -1485,6 +1591,8 @@ def main():
                 "collective_shm": sock_shm_coll_stats,
                 "collective_twolevel": sock_twolevel_stats,
                 "collective_framed": sock_framed_coll_stats,
+                "collective_framed_shm": framed_shm_stats,
+                "map_shm": map_shm_stats,
                 "allreduce_sweep": sweep_stats,
                 "map_allreduce": map_stats,
                 "map_int_allreduce": map_int_stats,

@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 22)]
+    assert ids == [f"R{i}" for i in range(1, 23)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1423,3 +1423,73 @@ def test_r17_repo_catalogue_is_complete():
                     baseline=_bl.load(DEFAULT_BASELINE))
     result = engine.lint_paths([pkg])
     assert not result.findings, result.findings
+
+
+# ----------------------------------------------------------------------
+# R22 — transport-decision size literal outside tuning/tuner
+# ----------------------------------------------------------------------
+def test_r22_fires_on_comparison_literal():
+    r = run_rule("R22", """
+        def send_raw(self, view):
+            if len(view) >= 262144:
+                self._ring_send(view)
+    """, path="ytk_mp4j_tpu/transport/snippet.py")
+    [f] = r.findings
+    assert f.rule == "R22" and f.line == 3
+    assert "tuning.py" in f.message
+
+
+def test_r22_fires_on_clamp_literal():
+    r = run_rule("R22", """
+        def __init__(self, ring_bytes):
+            self._piece = max(ring_bytes // 2, 8192)
+    """)
+    [f] = r.findings
+    assert f.rule == "R22" and "8192" in f.message
+
+
+def test_r22_quiet_on_referenced_knob():
+    r = run_rule("R22", """
+        from ytk_mp4j_tpu.utils import tuning
+
+        def send_raw(self, view):
+            if len(view) >= tuning.SHM_RING_MIN_BYTES:
+                self._ring_send(view)
+            self._piece = max(self._cap // 2, tuning.SHM_RING_FLOOR)
+    """, path="ytk_mp4j_tpu/transport/snippet.py")
+    assert not r.findings
+
+
+def test_r22_quiet_on_small_protocol_constants_and_data_args():
+    # small literals (header sizes, counts) and plain data arguments
+    # (recv buffer sizes, listen backlogs) are not decisions
+    r = run_rule("R22", """
+        def serve(self, sock):
+            if len(self._hdr) >= 64:
+                pass
+            sock.listen(64)
+            while sock.recv(65536):
+                pass
+    """)
+    assert not r.findings
+
+
+def test_r22_quiet_outside_comm_transport():
+    # the sanctioned literal homes: utils/tuning.py + utils/tuner.py
+    # (and anything else outside the decision surface)
+    r = run_rule("R22", """
+        CHUNK_MIN = 256 * 1024
+
+        def decide(n):
+            return n >= 262144
+    """, path="ytk_mp4j_tpu/utils/tuner.py")
+    assert not r.findings
+
+
+def test_r22_inline_suppression():
+    r = run_rule("R22", """
+        def route(self, n):
+            # mp4j-lint: disable=R22 (wire-format constant, not a knob)
+            return n >= 1048576
+    """, path="ytk_mp4j_tpu/transport/snippet.py")
+    assert not r.findings

@@ -23,6 +23,7 @@
 | R19 | error   | lock-order cycle (whole-program) |
 | R20 | error   | blocking call under a held lock (whole-program) |
 | R21 | error   | callback/dispatch under the minting lock (whole-program) |
+| R22 | error   | transport-decision size literal outside tuning/tuner |
 
 R19-R21 are :class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule`
 instances: they run once over the whole indexed path set (call graph
@@ -68,6 +69,7 @@ from ytk_mp4j_tpu.analysis.rules.r20_blocking_under_lock import (
     R20BlockingUnderLock)
 from ytk_mp4j_tpu.analysis.rules.r21_callback_under_lock import (
     R21CallbackUnderLock)
+from ytk_mp4j_tpu.analysis.rules.r22_knob_literal import R22KnobLiteral
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -91,6 +93,7 @@ ALL_RULES = [
     R19LockOrderCycle,
     R20BlockingUnderLock,
     R21CallbackUnderLock,
+    R22KnobLiteral,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

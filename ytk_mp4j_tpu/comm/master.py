@@ -56,6 +56,7 @@ from ytk_mp4j_tpu.resilience import membership as membership_mod
 from ytk_mp4j_tpu.transport.channel import Channel
 from ytk_mp4j_tpu.transport.tcp import TcpChannel
 from ytk_mp4j_tpu.utils import stats as stats_mod
+from ytk_mp4j_tpu.utils import tuner as tuner_mod
 from ytk_mp4j_tpu.utils import tuning
 
 # control-plane message kinds (slave -> master)
@@ -122,7 +123,8 @@ class Master:
                  autoscale_budget: int | None = None,
                  provision_hook=None,
                  provision_cmd: str | None = None,
-                 autoscale_tick: float = 0.25):
+                 autoscale_tick: float = 0.25,
+                 tuner: str | None = None):
         """``timeout`` bounds the whole rendezvous; ``handshake_timeout``
         bounds each accepted connection's registration message, so one
         stray dial-in stalls rendezvous briefly instead of consuming the
@@ -192,7 +194,17 @@ class Master:
         (``autoscale_budget`` / ``MP4J_AUTOSCALE_BUDGET``),
         audit-green and circuit-breaker safety rails. ``observe``
         runs the controller but only LOGS would-be actions;
-        ``autoscale_tick`` paces the loop (tests)."""
+        ``autoscale_tick`` paces the loop (tests).
+
+        ``tuner`` (ISSUE 15; None reads ``MP4J_TUNER``, default
+        ``observe``) arms the master's half of the self-tuning data
+        plane: the controller watches the health engine's cause-aware
+        dominator rows and — in ``act`` mode — demotes a persistently
+        wire-dominated host leader through a FENCED topology update
+        (every rank parked at the same collective boundary, the
+        override pushed, the fence released), and trips every rank's
+        tuner back to static defaults on any cross-rank audit
+        divergence. ``observe`` records would-be demotions only."""
         self.slave_num = slave_num
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
@@ -336,6 +348,24 @@ class Master:
                 provision_hook=provision_hook,
                 provision_cmd=provision_cmd,
                 tick_secs=autoscale_tick)
+        # self-tuning data plane, master half (ISSUE 15): the tuner
+        # controller state — leader overrides live + proposed, the
+        # audit trip latch, event history. Guarded by its own lock
+        # (ticks run on per-slave serve threads); pushes happen
+        # outside it (the outbox discipline).
+        self._tuner_mode = tuning.tuner_mode(tuner)
+        self._tuner_ctl: dict | None = None
+        if self._tuner_mode != "off":
+            self._tuner_ctl = {
+                "mode": self._tuner_mode, "overrides": {},
+                "version": 0, "demotions": 0, "tripped": None,
+                "last_action": 0.0, "event_seq": 0,
+                "events": [],
+            }
+        self._tuner_lock = threading.Lock()
+        # demotion cooldown: several decision windows, so one fence
+        # cancel (a rank deep in compute) retries calmly, not per beat
+        self._tuner_cooldown = max(5.0, tuning.tuner_window_secs() * 4)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -1494,10 +1524,12 @@ class Master:
         start = None
         cancel = None
         advance = None
+        push = None     # tuner fence completion (ISSUE 15)
         with self._lock:
             f = self._evict_fence
             if f is None:
                 return
+            kind = f.get("kind", "evict")
             live = set(range(self.slave_num)) - set(self._departed)
             victim = f["rank"]
             now = time.monotonic()
@@ -1511,12 +1543,14 @@ class Master:
                 # finalize in either order and silently resurrect
                 # stale entries
                 cancel = "a grow round is in flight"
-            elif victim not in live or len(live) < 2 \
-                    or self._slots[victim].dead \
-                    or self._slots[victim].quiet:
+            elif kind == "evict" and (
+                    victim not in live or len(live) < 2
+                    or self._slots[victim].dead
+                    or self._slots[victim].quiet):
                 cancel = f"rank {victim} is no longer an evictable " \
                          "member"
-            elif self._next_spare_locked() is None:
+            elif kind == "evict" \
+                    and self._next_spare_locked() is None:
                 cancel = "the warm-spare pool drained"
             elif now - f["since"] > self._fence_secs:
                 missing = sorted(live - set(f["acks"]))
@@ -1550,7 +1584,51 @@ class Master:
                             *f["acks"].values()], default=0)
                 laggards = [r for r, s in f["acks"].items()
                             if s < goal]
-                if laggards:
+                if kind == "tuner":
+                    # the tuner update needs every live rank PARKED at
+                    # one boundary via an explicit ack. Starvation
+                    # rule, sharpened for hot blocking jobs: a rank
+                    # BLOCKED INSIDE ordinal K reports the same
+                    # entered-seq as a rank PARKED AT K's entry (the
+                    # wrapper bumps before the park), so equal seqs
+                    # can still hide a deadlock — the parked ranks
+                    # starve the blocked ones. Whenever an unacked
+                    # rank's heartbeat position is at or past a parked
+                    # rank's, advance the parked ranks PAST that
+                    # ordinal (goal = max + 1 — strictly above their
+                    # entered seq, which is what wakes the slave-side
+                    # park); they run it, everyone converges on the
+                    # next boundary and re-acks.
+                    acked = set(f["acks"])
+                    hb_unacked = [
+                        int(self._telemetry[r]["seq"])
+                        for r in live - acked if r in self._telemetry]
+                    goal = None
+                    if live <= acked and len(seqs) <= 1:
+                        self._evict_fence = None
+                        push = (f["token"], dict(f["payload"]),
+                                sorted(live))
+                    elif live <= acked:
+                        # every rank parked, at UNEQUAL boundaries
+                        # (rooted/partial collectives let a rank
+                        # complete ordinals a peer never touched):
+                        # advance the behind ranks to the front
+                        # rank's position — max(seqs) exceeds their
+                        # entered seq, so the slave-side park wakes
+                        goal = max(max(seqs), f["goal"])
+                    elif (f["acks"] and hb_unacked
+                          and max(hb_unacked)
+                          >= min(f["acks"].values())):
+                        goal = max(max(hb_unacked) + 1, f["goal"])
+                    if goal is not None:
+                        laggards = [r for r, s in f["acks"].items()
+                                    if s < goal]
+                        if laggards:
+                            f["goal"] = goal
+                            for r in laggards:
+                                del f["acks"][r]
+                            advance = (f["token"], goal, laggards)
+                elif laggards:
                     f["goal"] = goal
                     for r in laggards:
                         del f["acks"][r]
@@ -1570,11 +1648,13 @@ class Master:
             if cancel is not None:
                 token = f["token"]
                 self._evict_fence = None
-                self._membership.note_evict_cancel(
-                    victim, token, cancel)
+                if kind == "evict":
+                    self._membership.note_evict_cancel(
+                        victim, token, cancel)
         if cancel is not None:
             self._log("M", "WARN",
-                      f"eviction fence canceled ({cancel}); releasing "
+                      f"{'tuner' if kind == 'tuner' else 'eviction'} "
+                      f"fence canceled ({cancel}); releasing "
                       "the parked ranks untouched")
             for r in sorted(self._live_ranks()):
                 self._send_to(r, ("fence_release", token))
@@ -1587,6 +1667,31 @@ class Master:
                       "still needs them)")
             for r in laggards:
                 self._send_to(r, ("fence_advance", token, goal))
+            return
+        if push is not None:
+            # tuner fence complete (ISSUE 15): every live rank is
+            # parked at the SAME collective boundary — push the
+            # leader overrides (applied on each rank's ctl thread),
+            # THEN release the fence: the master channel is ordered,
+            # so every rank applies before its collective thread
+            # resumes. Atomic topology switch, wire untouched.
+            token, overrides, targets = push
+            with self._tuner_lock:
+                ctl = self._tuner_ctl
+                if ctl is not None:
+                    ctl["overrides"] = dict(overrides)
+                    ctl["version"] += 1
+                    ctl["demotions"] += 1
+            self._log("M", "WARN",
+                      f"tuner fence complete: applying leader "
+                      f"overrides {overrides} at a job-wide "
+                      "collective boundary")
+            for r in targets:
+                self._send_to(r, ("tuner_leaders", overrides))
+                self._send_to(r, ("fence_release", token))
+            self._tuner_event(
+                "demote", f"leader overrides {overrides} applied "
+                f"(fence token {token})")
             return
         if start is None:
             return
@@ -2063,6 +2168,9 @@ class Master:
                 "stats": stats,
                 "metrics": metrics,
                 "mono": now,
+                # per-rank tuner document (ISSUE 15): decisions
+                # applied/would-apply, trip state — `mp4j-scope tuner`
+                "tuner": payload.get("tuner"),
             }
             win = self._rank_windows.get(rank)
             if win is None:
@@ -2082,6 +2190,8 @@ class Master:
         for line in audit_lines:
             self._log("M", "ERROR", line)
         self._dispatch_health_alerts(health_alerts)
+        self._tuner_tick(new_divergences, rank=rank,
+                         tuner_doc=payload.get("tuner"))
 
     def _dispatch_health_alerts(self, alerts: list[dict]) -> None:
         """Emit freshly minted health alerts: one master log line
@@ -2132,6 +2242,163 @@ class Master:
         ``MP4J_AUTOSCALE=off``."""
         return (self._autoscaler.status()
                 if self._autoscaler is not None else None)
+
+    # -- self-tuning data plane, master half (ISSUE 15) ----------------
+    def _tuner_event(self, kind: str, msg: str,
+                     rank: int | None = None,
+                     level: str = "WARN") -> dict:
+        """Mint + dispatch one structured tuner event through the
+        health-alert pipe (the autoscaler precedent): master log line
+        plus a control push to the lowest live rank, whose recovery
+        log and durable sink make the history outlive the master.
+        Ids are negative in a range disjoint from the autoscaler's
+        (-1e6 - seq) so timeline dedup can never collide. Called
+        WITHOUT the master or tuner lock held."""
+        with self._tuner_lock:
+            ctl = self._tuner_ctl
+            if ctl is None:
+                # operator-driven request_tuner_leaders with the
+                # controller off: still log + dispatch, nothing to
+                # record
+                ctl = {"event_seq": int(time.monotonic() * 1000) % 1000,
+                       "mode": "off", "events": []}
+            ctl["event_seq"] += 1
+            ev = {"id": -(1_000_000 + ctl["event_seq"]),
+                  "wall": time.time(), "kind": "tuner", "event": kind,
+                  "rank": rank, "mode": ctl["mode"], "msg": msg}
+            ctl["events"] = (ctl["events"] + [ev])[-32:]
+        self._log("M", level, "tuner: " + health_mod.format_alert(ev))
+        target = next(iter(sorted(self._live_ranks())), None)
+        if target is not None and 0 <= target < len(self._slots):
+            self._send_to(target, ("health_alert", ev))
+        return ev
+
+    def _tuner_tick(self, new_divergences: list[dict],
+                    rank: int | None = None,
+                    tuner_doc: dict | None = None) -> None:
+        """One controller evaluation, run after every telemetry fold:
+        (1) the AUDIT RAIL — any fresh cross-rank digest divergence
+        trips every rank's tuner back to static defaults, latched for
+        the job (re-pushed to any rank whose heartbeat shows an
+        untripped tuner — a replacement/grow joiner constructs fresh
+        and must inherit the latch); (2) the DOMINATOR watch — feed
+        the health engine's cause-aware rows to the pure
+        leader-demotion policy and, in act mode, actuate through a
+        fenced topology update. ``rank``/``tuner_doc`` describe the
+        heartbeat that triggered this tick."""
+        ctl = self._tuner_ctl
+        if ctl is None:
+            return
+        trip_why = None
+        proposal = None
+        relatch = None
+        revert_overrides = False
+        with self._tuner_lock:
+            if new_divergences and ctl["tripped"] is None:
+                d = new_divergences[0]
+                trip_why = (f"cross-rank audit divergence at "
+                            f"collective #{d.get('seq')}: "
+                            f"{str(d.get('err'))[:160]}")
+                ctl["tripped"] = trip_why
+                revert_overrides = bool(ctl["overrides"])
+            elif ctl["tripped"] is not None:
+                # latched: maintenance only — re-latch late joiners
+                # whose fresh tuner reports untripped, and keep
+                # retrying the fenced revert of any leader overrides
+                # still live ("back to static defaults" covers the
+                # topology too; the fence may have been busy)
+                if (tuner_doc is not None
+                        and not tuner_doc.get("tripped")
+                        and rank is not None):
+                    relatch = (rank, ctl["tripped"])
+                revert_overrides = bool(ctl["overrides"])
+            elif (self._health is not None
+                  and time.monotonic() - ctl["last_action"]
+                  >= self._tuner_cooldown):
+                rows = self._health.dominator_rows()
+                with self._lock:
+                    roster = list(self._roster)
+                groups = tuner_mod.host_groups(roster)
+                proposal = tuner_mod.decide_leaders(
+                    rows, groups, ctl["overrides"])
+                if proposal is not None:
+                    ctl["last_action"] = time.monotonic()
+        if relatch is not None:
+            self._send_to(relatch[0], ("tuner_trip", relatch[1]))
+        if trip_why is not None:
+            for r in sorted(self._live_ranks()):
+                self._send_to(r, ("tuner_trip", trip_why))
+            self._tuner_event("trip", trip_why, level="ERROR")
+        if revert_overrides:
+            # fenced topology revert; a busy fence/round returns
+            # False and the next tick retries
+            self.request_tuner_leaders({})
+            return
+        if trip_why is not None or proposal is None:
+            return
+        if ctl["mode"] != "act":
+            self._tuner_event(
+                "would_demote",
+                f"would demote leader(s) to {proposal} "
+                "(observe mode — no action)")
+            return
+        if not self.request_tuner_leaders(proposal):
+            self._tuner_event(
+                "demote_skipped",
+                f"leader demotion to {proposal} could not start "
+                "(round/fence in flight?) — retrying after cooldown")
+
+    def request_tuner_leaders(self, overrides: dict[int, int]) -> bool:
+        """Apply a tuner leader-override map job-wide through a FENCE
+        (callable by an operator too): park every live rank at the
+        same outermost-collective boundary, push ``tuner_leaders``,
+        release. Unlike the eviction fence nothing is torn down and
+        no spare is needed — a fence that cannot complete cancels
+        with zero disruption and the controller retries after its
+        cooldown. Returns False when the request cannot start (a
+        round or fence already open, rendezvous incomplete)."""
+        with self._lock:
+            ok = (self._fatal_msg is None
+                  and self._abort_since is None
+                  and self._grow_state is None
+                  and self._evict_fence is None
+                  and len(self._slots) >= self.slave_num)
+            if not ok:
+                return False
+            self._fence_seq += 1
+            token = self._fence_seq
+            live = set(range(self.slave_num)) - set(self._departed)
+            self._evict_fence = {
+                "token": token, "kind": "tuner", "rank": None,
+                "payload": {int(k): int(v)
+                            for k, v in (overrides or {}).items()},
+                "why": "tuner leader update", "acks": {}, "goal": 0,
+                "since": time.monotonic()}
+        self._log("M", "WARN",
+                  f"tuner: fencing the job at the next collective "
+                  f"boundary to apply leader overrides {overrides}")
+        for r in sorted(live):
+            self._send_to(r, ("fence", token))
+        self._check_fence()
+        return True
+
+    def tuner_status(self) -> dict | None:
+        """The self-tuning data plane's master document (ISSUE 15;
+        None with ``MP4J_TUNER=off``): mode, live leader overrides,
+        demotion count, trip state, recent controller events, and the
+        per-rank tuner summaries from the heartbeats."""
+        ctl = self._tuner_ctl
+        if ctl is None:
+            return None
+        with self._tuner_lock:
+            doc = {k: (dict(v) if isinstance(v, dict) else
+                       list(v) if isinstance(v, list) else v)
+                   for k, v in ctl.items() if k != "event_seq"}
+        with self._lock:
+            doc["ranks"] = {r: t.get("tuner")
+                            for r, t in self._telemetry.items()
+                            if t.get("tuner") is not None}
+        return doc
 
     def _handle_diagnose(self, rank: int, payload: dict) -> None:
         """A slave's bounded collective wait expired: refresh its table
@@ -2264,6 +2531,7 @@ class Master:
         # only, then master lock — can never cycle)
         autoscale_status = (self._autoscaler.status()
                             if self._autoscaler is not None else None)
+        tuner_status = self.tuner_status()
         with self._lock:
             ranks: dict[str, dict] = {}
             for r in sorted(self._telemetry):
@@ -2318,6 +2586,7 @@ class Master:
                 "membership": membership_status,
                 "health": health_status,
                 "autoscale": autoscale_status,
+                "tuner": tuner_status,
             },
         }
 

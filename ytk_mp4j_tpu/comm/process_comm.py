@@ -66,6 +66,7 @@ from ytk_mp4j_tpu.transport.channel import Channel, _raw_view
 from ytk_mp4j_tpu.transport.tcp import connect
 from ytk_mp4j_tpu.utils import native, trace, tuning
 from ytk_mp4j_tpu.utils import stats as stats_mod
+from ytk_mp4j_tpu.utils import tuner as tuner_mod
 from ytk_mp4j_tpu.utils.stats import CommStats
 
 import functools
@@ -146,7 +147,8 @@ class ProcessCommSlave(CommSlave):
                  elastic: str | None = None,
                  spare: bool = False,
                  async_collectives: bool | None = None,
-                 health: bool | None = None):
+                 health: bool | None = None,
+                 tuner: str | None = None):
         """``timeout`` bounds rendezvous/connect; ``peer_timeout`` (None =
         the reference's fail-stop hang) bounds each peer receive during
         collectives, turning a dead peer into an Mp4jError.
@@ -247,6 +249,19 @@ class ProcessCommSlave(CommSlave):
         same value — a rank with it off ships no cells, so the master
         can attribute nothing.
 
+        ``tuner`` (ISSUE 15; None reads ``MP4J_TUNER``, default
+        ``observe``) arms this rank's half of the self-tuning data
+        plane (:mod:`ytk_mp4j_tpu.utils.tuner`): the heartbeat thread
+        folds the rolling per-link wire stats into decision windows,
+        and — in ``act`` mode — committed per-link ``(chunk_bytes,
+        compress, socket-buffer)`` decisions apply at the NEXT
+        outermost-collective boundary (never mid-collective). The
+        framed wire format is receiver-auto-detected, so sender-side
+        decisions cannot desync a pair; links with shm traffic keep
+        the job-wide chunk schedule (it is part of the shm wire
+        contract). Any cross-rank audit divergence trips the tuner
+        back to static defaults for the job's lifetime.
+
         ``spare=True`` registers this slave as a WARM SPARE (ISSUE 10)
         instead of claiming a rank: construction blocks — pinging the
         master from a background thread — until the master adopts it
@@ -333,6 +348,23 @@ class ProcessCommSlave(CommSlave):
                           else bool(async_collectives))
         self._coalesce_usecs = tuning.coalesce_usecs()
         self._max_outstanding = tuning.max_outstanding()
+        # self-tuning data plane (ISSUE 15): mode + window validated
+        # up front like every other knob; the policy core runs on the
+        # heartbeat thread, decisions apply at outermost-collective
+        # boundaries only (the recovery wrapper drains the queue)
+        self._tuner_mode = tuning.tuner_mode(tuner)
+        self._tuner_window = tuning.tuner_window_secs()
+        self._so_buf_map = tuning.so_buf_map()
+        self._tuner: tuner_mod.LinkTuner | None = (
+            tuner_mod.LinkTuner(self._tuner_mode, self._chunk_bytes,
+                                self._so_buf_map)
+            if self._tuner_mode != "off" else None)
+        self._tuner_next = 0.0   # heartbeat-thread pacing (monotonic)
+        # fenced leader overrides (ISSUE 15): written only by the ctl
+        # thread inside a master tuner fence (every rank parked at the
+        # same boundary) and reset by _set_roster on any membership
+        # change — two-level schedules read the derived _leaders list
+        self._leader_overrides: dict[int, int] = {}
         self._async: progress_mod.ProgressScheduler | None = None
         self._async_lock = threading.Lock()
         self._eager_failed: list = []   # MP4J_ASYNC=0 failures for
@@ -737,6 +769,16 @@ class ProcessCommSlave(CommSlave):
                             "autoscale",
                             f"{ev.get('event')} {ev.get('action')}: "
                             f"{ev.get('msg', '')}"[:160])
+                    elif ev.get("kind") == "tuner":
+                        # tuner controller events (ISSUE 15: demote /
+                        # would_demote / trip) — same pipe, logged
+                        # under their own kind so mp4j-scope tuner
+                        # finds them (the health onset fallback would
+                        # render them as "rank None onset (None)")
+                        self._recovery.note(
+                            "tuner",
+                            f"{ev.get('event')}: "
+                            f"{ev.get('msg', '')}"[:160])
                     else:
                         self._recovery.note(
                             "health",
@@ -745,6 +787,23 @@ class ProcessCommSlave(CommSlave):
                             if ev.get("kind") == "state" else
                             f"rank {ev.get('rank')} onset "
                             f"({ev.get('detector')})")
+                elif kind == "tuner_leaders":
+                    # fenced tuner topology update (ISSUE 15): lands
+                    # while every rank is parked at the same boundary
+                    # (the master releases the fence only after this
+                    # push), so the leader switch is atomic job-wide
+                    ov = msg[1] if isinstance(msg[1], dict) else {}
+                    self._apply_leaders(ov)
+                    self._recovery.note(
+                        "tuner", f"leader overrides {ov or 'cleared'}")
+                elif kind == "tuner_trip":
+                    # audit divergence under adaptation: back to
+                    # static defaults at the next boundary, policy
+                    # frozen for the job's lifetime (ISSUE 15)
+                    why = str(msg[1])[:300]
+                    if self._tuner is not None:
+                        self._tuner.trip(why)
+                    self._recovery.note("tuner", f"TRIPPED: {why}")
                 elif kind == "fence":
                     # eviction fence (ISSUE 13): park at the next
                     # outermost collective boundary, wire untouched
@@ -985,6 +1044,24 @@ class ProcessCommSlave(CommSlave):
             ad = self._audit.take_delta()
             if ad is not None:
                 payload["audit_delta"] = ad
+        tun = self._tuner
+        if tun is not None:
+            # tuner window fold (ISSUE 15): the policy core consumes
+            # the per-link stats window here on the heartbeat thread —
+            # off the collective hot path — and the committed (or, in
+            # observe mode, would-be) decisions land in the recovery
+            # log (-> durable sink) and the shipped status document
+            now = time.monotonic()
+            if now >= self._tuner_next:
+                self._tuner_next = now + self._tuner_window
+                for peer, d in tun.observe(
+                        self._comm_stats.link_snapshot()):
+                    self._recovery.note(
+                        "tuner",
+                        f"link->{peer} decided chunk="
+                        f"{d.get('chunk_bytes')} compress="
+                        f"{d.get('compress')} ({tun.mode})")
+            payload["tuner"] = tun.status()
         return payload
 
     def _heartbeat_loop(self) -> None:
@@ -1144,27 +1221,31 @@ class ProcessCommSlave(CommSlave):
         dropped-record count, eviction count, budget."""
         return None if self._sink is None else self._sink.status()
 
+    def link_stats(self) -> dict[int, dict]:
+        """Per-peer-link rolling wire evidence (ISSUE 15): cumulative
+        bytes/seconds/frames (split per transport), compression
+        outcomes (raw vs wire bytes), and the APPLIED per-link socket
+        buffer sizes — the substrate the tuner's decisions are made
+        from, and the record of what the transport actually did."""
+        return self._comm_stats.link_snapshot()
+
+    def tuner_status(self) -> dict | None:
+        """The self-tuning data plane's document (ISSUE 15; None with
+        ``MP4J_TUNER=off``): mode, trip state, decision count, and the
+        per-link decisions currently applied (or, in observe mode,
+        that WOULD apply)."""
+        return None if self._tuner is None else self._tuner.status()
+
     # ------------------------------------------------------------------
     # peer transport
     # ------------------------------------------------------------------
     @staticmethod
     def _derive_host_groups(roster) -> list[list[int]]:
-        """Rank groups sharing a host fingerprint, ordered by first
-        appearance; each group ascending (so ``group[0]`` — the host
-        LEADER — is the smallest rank on that host). Fingerprint-less
-        entries (shm opted out, or an old-style 2-tuple roster) become
-        singleton groups. Pure function of the shared roster."""
-        groups: dict[str, list[int]] = {}
-        singles: list[list[int]] = []
-        for rank, entry in enumerate(roster):
-            fp = entry[2] if len(entry) > 2 else ""
-            if fp:
-                groups.setdefault(fp, []).append(rank)
-            else:
-                singles.append([rank])
-        out = list(groups.values()) + singles
-        out.sort(key=lambda g: g[0])
-        return out
+        """Rank groups sharing a host fingerprint (delegates to the
+        shared pure function in :mod:`ytk_mp4j_tpu.utils.tuner` —
+        ISSUE 15 moved it there so the master's tuner controller and
+        the slaves derive topology from ONE implementation)."""
+        return tuner_mod.host_groups(roster)
 
     def _set_roster(self, roster) -> None:
         """THE roster-versioned topology update (mp4j-lint R15's
@@ -1179,12 +1260,46 @@ class ProcessCommSlave(CommSlave):
         # mp4j-lint: disable=R15 (the sanctioned derivation site itself)
         self._roster = list(roster)
         self._n = len(self._roster)
-        self._host_groups = self._derive_host_groups(self._roster)
+        self._host_groups = tuner_mod.host_groups(self._roster)
+        # a membership change invalidates any tuner leader override:
+        # the demotion was evidence about the OLD topology (the master
+        # re-issues it through a fresh fence if still warranted) — and
+        # stale per-link evidence AND decisions must not inherit a
+        # renumbered (or replaced) peer id: the LinkTuner resets too,
+        # so a fresh process addressed by an old id starts from static
+        # defaults, not the old occupant's committed adaptation
+        self._leader_overrides = {}
+        if self._roster_version > 0:
+            stats = getattr(self, "_comm_stats", None)
+            if stats is not None:
+                stats.forget_links()
+            tun = getattr(self, "_tuner", None)
+            if tun is not None:
+                tun.reset()
         self._members = next(g for g in self._host_groups
                              if self._rank in g)
         self._leader = self._members[0]
         self._leaders = [g[0] for g in self._host_groups]
         self._roster_version += 1
+
+    def _apply_leaders(self, overrides: dict) -> None:
+        """Apply a fenced tuner topology update (ISSUE 15): the master
+        pushed ``tuner_leaders`` while EVERY rank is parked at the
+        same collective boundary, so switching the effective leader
+        set here — on the ctl thread, before the fence release wakes
+        the collective thread — is atomic job-wide. Derivation rides
+        the same pure functions as ``_set_roster``; an override that
+        no longer names a member of its group falls back to the
+        default leader rather than desyncing the schedule."""
+        # mp4j-lint: disable=R15 (fenced job-wide update; reset by _set_roster)
+        self._leader_overrides = {int(k): int(v)
+                                  for k, v in (overrides or {}).items()}
+        leaders = tuner_mod.leaders_for(self._host_groups,
+                                        self._leader_overrides)
+        gi = next(i for i, g in enumerate(self._host_groups)
+                  if self._rank in g)
+        self._leaders = leaders
+        self._leader = leaders[gi]
 
     def _sync_identity(self) -> None:
         """Mirror the current (rank, slave_num) into the attached
@@ -1237,9 +1352,11 @@ class ProcessCommSlave(CommSlave):
                     tok_ok = (isinstance(seg_token, tuple)
                               and len(seg_token) >= 2
                               and seg_token[0] in ("memfd", "shm"))
+                    # floor mirrors the MP4J_SHM_RING_BYTES validator
+                    # (ONE constant — mp4j-lint R22's knob-drift class)
                     if not (tok_ok and isinstance(ring_bytes, int)
                             and not isinstance(ring_bytes, bool)
-                            and ring_bytes >= 4096):
+                            and ring_bytes >= tuning.SHM_RING_FLOOR):
                         raise TypeError(
                             f"malformed shm handshake {hs!r}")
                 # strict integer types, no coercion: int('2')/int(2.7)
@@ -1315,8 +1432,20 @@ class ProcessCommSlave(CommSlave):
                 ch.peer_rank = peer_rank     # tags wire spans
                 ch.faults = self._faults     # fault-injection hook
                 ch.epoch = peer_epoch        # pinned for the fence
+                # per-link socket buffers (ISSUE 15 satellite): the
+                # accept side learns the peer only now, so the map
+                # applies post-handshake (no window-scale effect —
+                # documented; the dial side applies before connect)
+                if peer_rank in self._so_buf_map \
+                        and ch.transport == "tcp":
+                    try:
+                        tcp_mod.set_so_bufs(
+                            ch.sock, *self._so_buf_map[peer_rank])
+                    except OSError:
+                        pass
                 self._peers[peer_rank] = ch
                 self._peer_cv.notify_all()
+            self._tuner_register_channel(peer_rank, ch)
             if peer_epoch > 0:
                 self._comm_stats.add("reconnects", 1)
 
@@ -1374,6 +1503,7 @@ class ProcessCommSlave(CommSlave):
                 ch.faults = self._faults     # fault-injection hook
                 self._peers[peer] = ch
                 self._peer_cv.notify_all()
+            self._tuner_register_channel(peer, ch)
             if ch.epoch > 0:
                 self._comm_stats.add("reconnects", 1)
             return ch
@@ -1424,7 +1554,12 @@ class ProcessCommSlave(CommSlave):
             ch = None
             seg = None
             try:
-                ch = connect(host, port, timeout=self._timeout)
+                # per-link socket buffers (ISSUE 15 satellite): the
+                # dialer knows the peer, so the override applies
+                # BEFORE connect() — the TCP window scale is fixed at
+                # the handshake
+                ch = connect(host, port, timeout=self._timeout,
+                             so_bufs=self._so_buf_map.get(peer))
                 # sanctioned pre-fence send: the handshake pins the
                 # epoch the fence will enforce (mp4j-lint R10 baseline)
                 if use_shm:
@@ -1486,8 +1621,88 @@ class ProcessCommSlave(CommSlave):
         else:
             ch.send_obj(data, compress=compress)
 
+    # -- tuner decision consumption (ISSUE 15) -------------------------
+    # Per-link decisions are SENDER-LOCAL by construction: the framed
+    # wire format is receiver-auto-detected (frame tags), and chunk
+    # granularity is local on a byte-stream transport — see the safety
+    # argument in utils/tuner.py. Both helpers are one dict.get on the
+    # hot path and collapse to the static default with the tuner off,
+    # observing, or tripped.
+    def _compress_for(self, peer: int, requested: bool) -> bool:
+        tun = self._tuner
+        if tun is None or tun.mode != "act":
+            return requested
+        return tun.effective_compress(peer, requested)
+
+    def _chunk_for(self, peer: int) -> int:
+        tun = self._tuner
+        if tun is None or tun.mode != "act" or self._shm_peer(peer):
+            # shm pairs keep the job-wide schedule: the raw plane's
+            # per-exchange ring/carrier routing makes it wire contract
+            return self._chunk_bytes
+        return tun.effective_chunk(peer, self._chunk_bytes)
+
+    def _tuner_register_channel(self, peer: int, ch: Channel) -> None:
+        """Channel-setup half of the tuner wiring: record the link's
+        transport + applied socket buffer sizes in the per-link stats
+        (the ISSUE 15 satellite), and re-apply any live chunk decision
+        to the fresh channel (a recovery re-dial must not silently
+        reset an adapted link)."""
+        if ch.transport == "tcp":
+            try:
+                snd, rcv = tcp_mod.applied_buf_sizes(ch.sock)
+                self._comm_stats.note_link(peer, transport="tcp",
+                                           so_sndbuf=snd, so_rcvbuf=rcv)
+            except OSError:
+                self._comm_stats.note_link(peer, transport="tcp")
+            tun = self._tuner
+            if tun is not None and tun.mode == "act":
+                ch.set_chunk_bytes(
+                    tun.effective_chunk(peer, self._chunk_bytes))
+        else:
+            self._comm_stats.note_link(peer, transport=ch.transport)
+
+    def _tuner_apply(self, tun) -> None:
+        """Drain the tuner's pending decisions at an OUTERMOST
+        collective boundary (the recovery wrapper calls this before
+        any wire byte of the collective moves — decisions never change
+        mid-collective). Also executes the audit-trip revert: every
+        adapted link snaps back to the static defaults."""
+        pending, revert = tun.take_pending()
+        with self._peer_cv:
+            chans = dict(self._peers)
+        if revert:
+            for peer, ch in chans.items():
+                if ch.transport == "tcp":
+                    ch.set_chunk_bytes(self._chunk_bytes)
+            self._recovery.note(
+                "tuner", "reverted all links to static defaults")
+        for peer, d in pending.items():
+            ch = chans.get(peer)
+            cb = d.get("chunk_bytes")
+            if cb and ch is not None and ch.transport == "tcp":
+                ch.set_chunk_bytes(cb)
+            if ch is not None and ch.transport == "tcp" and (
+                    d.get("so_sndbuf") or d.get("so_rcvbuf")):
+                try:
+                    tcp_mod.set_so_bufs(ch.sock, d.get("so_sndbuf"),
+                                        d.get("so_rcvbuf"))
+                    snd, rcv = tcp_mod.applied_buf_sizes(ch.sock)
+                    self._comm_stats.note_link(
+                        peer, so_sndbuf=snd, so_rcvbuf=rcv)
+                except OSError:
+                    pass   # a refused resize keeps the old buffers
+            self._comm_stats.metrics.inc("tuner/decisions")
+            self._recovery.note(
+                "tuner",
+                f"link->{peer} applied chunk={d.get('chunk_bytes')} "
+                f"compress={d.get('compress')}")
+
     def _send(self, peer: int, data, compress: bool = False) -> None:
-        self._send_on(self._fenced(peer), data, compress)
+        if isinstance(data, np.ndarray):
+            self._comm_stats.add_transfer(peer, data.nbytes)
+        self._send_on(self._fenced(peer), data,
+                      self._compress_for(peer, compress))
 
     def _submit_send(self, peer: int, data, compress: bool = False):
         """Helper-thread send with the channel resolved NOW, under the
@@ -1495,8 +1710,10 @@ class ProcessCommSlave(CommSlave):
         engine has since aborted must error on its own (closed) channel,
         never late-resolve a fresh one and write stale-epoch bytes into
         the retry's stream."""
+        if isinstance(data, np.ndarray):
+            self._comm_stats.add_transfer(peer, data.nbytes)
         fut = self._pool.submit(self._send_on, self._fenced(peer),
-                                 data, compress)
+                                 data, self._compress_for(peer, compress))
         # tracked so _drain_dead_channels can wait for abandoned
         # futures (a recv that raises first orphans its paired send)
         # before it frees fds; pruned opportunistically so a healthy
@@ -1693,8 +1910,21 @@ class ProcessCommSlave(CommSlave):
         itemsize = (rarr if rarr is not None else sarr).dtype.itemsize
         n_send = 0 if sarr is None else sarr.size
         n_recv = 0 if rarr is None else rarr.size
-        sch = tuning.chunk_ranges(n_send, itemsize, self._chunk_bytes)
-        rch = tuning.chunk_ranges(n_recv, itemsize, self._chunk_bytes)
+        # bulk-transfer granularity evidence for the tuner's chunk
+        # policy (ISSUE 15): the original segment sizes, which the
+        # per-chunk wire bookings below cannot recover
+        if sarr is not None:
+            self._comm_stats.add_transfer(send_peer, sarr.nbytes)
+        if rarr is not None and recv_peer != send_peer:
+            self._comm_stats.add_transfer(recv_peer, rarr.nbytes)
+        # per-link chunk size (ISSUE 15): each direction uses ITS
+        # link's decided granularity — chunk boundaries are local on a
+        # byte-stream transport, so asymmetric schedules cannot desync
+        # (shm links always resolve to the job default, see _chunk_for)
+        sch = tuning.chunk_ranges(n_send, itemsize,
+                                  self._chunk_for(send_peer))
+        rch = tuning.chunk_ranges(n_recv, itemsize,
+                                  self._chunk_for(recv_peer))
         steps = max(len(sch), len(rch))
         if steps <= 1:
             self._exchange_raw(send_peer, recv_peer, sarr, rarr)
@@ -2038,31 +2268,37 @@ class ProcessCommSlave(CommSlave):
         return tuning.select_twolevel(
             [len(g) for g in self._host_groups])
 
-    def _group_tree_reduce(self, acc, group, operand, operator) -> None:
-        """Binomial reduce of ``acc`` toward ``group[0]`` (the host
-        leader), merging IN PLACE into ``acc`` — callers pass either a
-        buffer that will be overwritten anyway (allreduce) or an
-        explicit scratch copy (reduce_scatter). One more client of THE
-        shared binomial walk (see the map-plane comment): the merge
-        mutates ``acc``, so the threaded value is just ``acc``
-        itself."""
+    def _group_tree_reduce(self, acc, group, operand, operator,
+                           root: int | None = None) -> None:
+        """Binomial reduce of ``acc`` toward ``root`` (default: the
+        group's smallest rank), merging IN PLACE into ``acc`` —
+        callers pass either a buffer that will be overwritten anyway
+        (allreduce) or an explicit scratch copy (reduce_scatter). The
+        two-level legs pass the EFFECTIVE leader (ISSUE 15: a tuner
+        demotion may root the walk at another member). One more
+        client of THE shared binomial walk (see the map-plane
+        comment): the merge mutates ``acc``, so the threaded value is
+        just ``acc`` itself."""
         self._tree_reduce_walk(
-            acc, group[0],
+            acc, group[0] if root is None else root,
             lambda peer, a: self._send_reduce_contrib(peer, a,
                                                       operand),
             lambda peer, a: (self._recv_reduce(peer, a, operator,
                                                operand), a)[1],
             group=group)
 
-    def _group_tree_bcast(self, arr, lo, hi, group, operand) -> None:
-        """Binomial broadcast of ``group[0]``'s ``arr[lo:hi]`` to the
+    def _group_tree_bcast(self, arr, lo, hi, group, operand,
+                          root: int | None = None) -> None:
+        """Binomial broadcast of the root's ``arr[lo:hi]`` to the
         group, received in place (the walk's threaded value is unused
-        — receives land directly in ``arr[lo:hi]``)."""
+        — receives land directly in ``arr[lo:hi]``). Root defaults to
+        the group's smallest rank; the two-level legs pass the
+        effective leader (ISSUE 15)."""
         def recv(peer):
             self._recv_segment_into(peer, arr, lo, hi, operand)
 
         self._tree_bcast_walk(
-            None, group[0],
+            None, group[0] if root is None else root,
             lambda peer, _: self._send_segment(peer, arr[lo:hi],
                                                operand),
             recv, group=group)
@@ -2075,12 +2311,13 @@ class ProcessCommSlave(CommSlave):
         members, leaders = self._members, self._leaders
         if len(members) > 1:
             self._group_tree_reduce(arr[lo:hi], members, operand,
-                                    operator)
+                                    operator, root=self._leader)
         if self._rank == self._leader and len(leaders) > 1:
             self._rhd_allreduce(arr, operand, operator, lo, hi,
                                 group=leaders)
         if len(members) > 1:
-            self._group_tree_bcast(arr, lo, hi, members, operand)
+            self._group_tree_bcast(arr, lo, hi, members, operand,
+                                   root=self._leader)
         return arr
 
     def _twolevel_reduce_scatter(self, arr, ranges, operand, operator):
@@ -2095,7 +2332,8 @@ class ProcessCommSlave(CommSlave):
         try:
             np.copyto(acc, arr)
             if len(members) > 1:
-                self._group_tree_reduce(acc, members, operand, operator)
+                self._group_tree_reduce(acc, members, operand, operator,
+                                        root=self._leader)
             if self._rank == self._leader and len(leaders) > 1:
                 self._rhd_allreduce(acc, operand, operator, 0, len(acc),
                                     group=leaders)
@@ -2166,7 +2404,8 @@ class ProcessCommSlave(CommSlave):
                             fut.result()
         if len(members) > 1:
             lo, hi, _ = self._ranges_span(ranges)
-            self._group_tree_bcast(arr, lo, hi, members, operand)
+            self._group_tree_bcast(arr, lo, hi, members, operand,
+                                   root=self._leader)
         return arr
 
     @staticmethod
@@ -2714,8 +2953,9 @@ class ProcessCommSlave(CommSlave):
         return out
 
     def _send_map_columns(self, peer: int, cols, operand: Operand):
-        self._fenced(peer).send_map_columns(cols[0], cols[1],
-                                            compress=operand.compress)
+        self._fenced(peer).send_map_columns(
+            cols[0], cols[1],
+            compress=self._compress_for(peer, operand.compress))
 
     def _recv_map_columns(self, peer: int):
         # peer channels carry peer_timeout from creation
@@ -3524,6 +3764,13 @@ def _recovered(fn, snapshot: bool):
         try:
             if not outermost:
                 return fn(self, *args, **kwargs)
+            # tuner boundary application (ISSUE 15): pending per-link
+            # decisions (and the audit-trip revert) land HERE, before
+            # any wire byte of this collective moves — decisions never
+            # change mid-collective. One attribute check when idle.
+            tun = self._tuner
+            if tun is not None and tun.dirty:
+                self._tuner_apply(tun)
             ordinal = self._progress_state[0] + 1
             self._progress_state = (ordinal, True)
             if self._faults is not None:

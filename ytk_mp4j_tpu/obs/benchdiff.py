@@ -60,6 +60,15 @@ THRESHOLDS: dict[str, float] = {
     "socket_coalesce_off_keys_per_sec": 0.25,
     "socket_framed_collective_gbs": 0.20,
     "socket_collective_in_workload_gbs": 0.25,
+    # ISSUE 15 (mp4j-tuner): the framed/columnar-map planes over the
+    # shm rings (frame-level ring routing) and the tuner act leg —
+    # gated so neither the routing fast path nor the adaptive win
+    # regresses silently; same loopback noise floor. The act leg's
+    # win over socket_tuner_off_gbs is the acceptance evidence.
+    "socket_framed_shm_gbs": 0.25,
+    "socket_map_shm_keys_s": 0.25,
+    "socket_tuner_act_gbs": 0.25,
+    "socket_tuner_off_gbs": 0.25,
     "ffm_sparse_steps_per_sec": 0.10,
     "ffm_stream_rows_per_sec": 0.20,
     "ffm_stream_rows_per_sec_serialized": 0.20,

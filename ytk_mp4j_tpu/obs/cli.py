@@ -9,6 +9,7 @@ Usage::
     mp4j-scope replay /path/to/BUNDLE_DIR
     mp4j-scope analyze /path/to/MP4J_SINK_DIR [--json]
     mp4j-scope health /path/to/MP4J_SINK_DIR | http://master:PORT
+    mp4j-scope tuner /path/to/MP4J_SINK_DIR | http://master:PORT
     mp4j-scope tail /path/to/MP4J_SINK_DIR [--interval 1.0] [--once]
     mp4j-scope bench-diff BENCH_rA.json BENCH_rB.json [--threshold PCT]
     python -m ytk_mp4j_tpu.obs report ...
@@ -54,6 +55,13 @@ the ``alerts`` records (every transition, the first-degradation
 timeline, final verdicts); given a master URL it shows the live
 health document (current states, detector-pressure evidence,
 dominator window, recent alerts).
+
+``tuner`` (ISSUE 15) renders the self-tuning data plane: given a
+durable sink DIRECTORY it prints the decision history (every
+per-link decision the ranks noted, plus fenced leader updates and
+audit trips from the alert stream); given a master URL it shows the
+live tuner document (mode, leader overrides, per-rank applied
+decisions, trip state).
 
 ``bench-diff`` compares two ``bench.py`` JSON outputs against
 per-metric regression budgets (``obs.benchdiff``); exit 1 on a
@@ -134,6 +142,16 @@ def _build_parser() -> argparse.ArgumentParser:
                          "master metrics URL (current verdicts)")
     hp.add_argument("--json", action="store_true",
                     help="emit the raw health document/alert list")
+
+    tn = sub.add_parser("tuner",
+                        help="self-tuning data-plane decisions: "
+                             "history from a sink dir, or live "
+                             "per-link decisions from a master URL")
+    tn.add_argument("target",
+                    help="a MP4J_SINK_DIR (decision history) or a "
+                         "master metrics URL (live tuner document)")
+    tn.add_argument("--json", action="store_true",
+                    help="emit the raw tuner document/event list")
 
     tl = sub.add_parser("tail",
                         help="follow a durable sink directory live, "
@@ -262,6 +280,66 @@ def _health(args) -> int:
     return 0
 
 
+def _format_tuner_doc(doc: dict | None) -> str:
+    """The live tuner view (ISSUE 15): mode/trip head line, leader
+    overrides, then one line per rank with its applied per-link
+    decisions."""
+    if not doc:
+        return "tuner: off (MP4J_TUNER=off — static knobs only)"
+    lines = [f"tuner: mode={doc.get('mode')} "
+             f"demotions={doc.get('demotions', 0)} "
+             f"version={doc.get('version', 0)}"
+             + (f"  TRIPPED: {doc['tripped']}"
+                if doc.get("tripped") else "")]
+    if doc.get("overrides"):
+        lines.append(f"  leader overrides (host group -> leader): "
+                     f"{doc['overrides']}")
+    for r in sorted(doc.get("ranks") or {}, key=int):
+        t = doc["ranks"][r] or {}
+        applied = t.get("applied") or {}
+        dec = ", ".join(
+            f"->{p}: chunk={d.get('chunk_bytes') or 'static'} "
+            f"compress={'static' if d.get('compress') is None else d['compress']}"
+            for p, d in sorted(applied.items(), key=lambda kv: int(kv[0])))
+        lines.append(
+            f"  rank {r}: decisions={t.get('decisions_total', 0)}"
+            + (f"  TRIPPED: {t['tripped']}" if t.get("tripped") else "")
+            + (f"  [{dec}]" if dec else "  [all links static]"))
+    for ev in (doc.get("events") or [])[-6:]:
+        lines.append("  " + health_mod.format_alert(ev))
+    return "\n".join(lines)
+
+
+def _tuner(args) -> int:
+    """Decision history from a sink dir, or the live tuner document
+    from a master URL (the ISSUE 15 operator view)."""
+    if os.path.isdir(args.target):
+        analysis = critpath.analyze(sink_mod.load_job(args.target))
+        events = analysis.get("tuner_events") or []
+        alerts = [a for a in (analysis.get("health_alerts") or ())
+                  if a.get("kind") == "tuner"]
+        if args.json:
+            print(json.dumps({"events": events, "alerts": alerts},
+                             sort_keys=True, default=str))
+            return 0
+        if not events and not alerts:
+            print("no tuner events in this sink directory "
+                  "(MP4J_TUNER=off, or the job made no decisions)")
+            return 0
+        for ev in events:
+            print(f"rank {ev['rank']}: {ev['msg']}")
+        for a in alerts:
+            print(health_mod.format_alert(a))
+        return 0
+    doc = _fetch_doc(args.target)
+    tun = (doc.get("cluster") or {}).get("tuner")
+    if args.json:
+        print(json.dumps(tun, sort_keys=True, default=str))
+    else:
+        print(_format_tuner_doc(tun))
+    return 0
+
+
 def _live(args) -> int:
     while True:
         frame = telemetry.format_live(_fetch_doc(args.url))
@@ -297,6 +375,8 @@ def main(argv=None) -> int:
             return _analyze(args)
         if args.cmd == "health":
             return _health(args)
+        if args.cmd == "tuner":
+            return _tuner(args)
         if args.cmd == "tail":
             return _tail(args)
         if args.cmd == "bench-diff":

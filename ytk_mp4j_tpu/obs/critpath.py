@@ -306,6 +306,21 @@ def analyze(job: dict[int, dict]) -> dict:
             for k, v in w.items():
                 acc[k] += v
     divergences = [a for a in state["audit"] if a.get("err")]
+    # the self-tuning data plane's durable decision history (ISSUE 15):
+    # every applied/decided/tripped event the slaves noted into their
+    # recovery logs, pulled out for `mp4j-scope tuner` — next to the
+    # fenced leader updates and trip alerts that ride the alert pipe
+    tuner_events: list[dict] = []
+    for rank, events in state["recovery"].items():
+        for ev in events:
+            try:
+                ts, kind, detail = ev
+            except (TypeError, ValueError):
+                continue
+            if kind == "tuner":
+                tuner_events.append({"rank": rank, "ts": ts,
+                                     "msg": detail})
+    tuner_events.sort(key=lambda e: (e["ts"], e["rank"]))
     return {
         "ranks": state["ranks"],
         "ordinals_attributed": len(rows),
@@ -316,6 +331,7 @@ def analyze(job: dict[int, dict]) -> dict:
         "torn": state["torn"],
         "recovery": state["recovery"],
         "health_alerts": state["alerts"],
+        "tuner_events": tuner_events,
         "audit_records": len(state["audit"]),
         "audit_errors": divergences,
         "meta": state["meta"],

@@ -451,6 +451,13 @@ class HealthEngine:
         self._cells: dict[int, dict[int, dict]] = {}   # seq -> rank -> cell
         self._dom_recent: collections.deque = collections.deque(
             maxlen=max(self.window, 1))    # (seq, dominator, slow)
+        # cause-aware dominator rows (ISSUE 15): the tuner's leader-
+        # demotion policy needs the CAUSE ("link->K over tcp") next to
+        # the dominator, which the share window above deliberately
+        # drops — a parallel bounded deque of dicts keeps the two
+        # consumers decoupled
+        self._dom_rows: collections.deque = collections.deque(
+            maxlen=max(self.window, 1))
         self._streak_rank: int | None = None
         self._streak = 0
         self._dur_ewma = 0.0
@@ -676,6 +683,9 @@ class HealthEngine:
                               + 0.05 * (dur - self._dur_ewma))
             self._dur_n += 1
         self._dom_recent.append((int(row["seq"]), dom, slow))
+        self._dom_rows.append({"seq": int(row["seq"]), "dom": dom,
+                               "cause": row.get("cause") or "?",
+                               "slow": slow})
         if slow and dom == self._streak_rank:
             self._streak += 1
         elif slow:
@@ -837,6 +847,13 @@ class HealthEngine:
         return ev
 
     # -- the operator hook ---------------------------------------------
+    def dominator_rows(self) -> list[dict]:
+        """The recent cause-aware attribution rows ``[{seq, dom,
+        cause, slow}]`` (bounded by the window) — the evidence the
+        master's tuner controller feeds
+        :func:`ytk_mp4j_tpu.utils.tuner.decide_leaders` (ISSUE 15)."""
+        return list(self._dom_rows)
+
     def dominator_shares(self) -> dict[int, float]:
         """Sliding-window dominance share per rank (the
         ``mp4j_critpath_dominator`` gauge)."""
@@ -905,6 +922,14 @@ def format_alert(ev: dict) -> str:
         # alert pipe so timelines interleave actions with verdicts
         return (f"{_fmt_wall(ev.get('wall'))}  autoscaler "
                 f"{ev.get('event')} {ev.get('action')}"
+                + (f" rank {ev['rank']}"
+                   if ev.get("rank") is not None else "")
+                + f": {ev.get('msg', '')}")
+    if ev.get("kind") == "tuner":
+        # a self-tuning data-plane event (ISSUE 15: leader demotion,
+        # audit trip) — same pipe, same timelines
+        return (f"{_fmt_wall(ev.get('wall'))}  tuner "
+                f"{ev.get('event')}"
                 + (f" rank {ev['rank']}"
                    if ev.get("rank") is not None else "")
                 + f": {ev.get('msg', '')}")

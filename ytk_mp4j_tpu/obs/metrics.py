@@ -82,6 +82,8 @@ METRICS_DOC: dict[str, str] = {
                       "dir (bounded by MP4J_SINK_BYTES)",
     "async/outstanding": "nonblocking collectives queued + in flight "
                          "on this rank's scheduler (ISSUE 11)",
+    "tuner/decisions": "per-link tuner decisions APPLIED at collective "
+                       "boundaries on this rank (ISSUE 15)",
     # -- Prometheus series (the /metrics endpoint) --------------------
     "mp4j_ranks_reporting": "ranks whose heartbeats the master holds",
     "mp4j_slave_num": "the job's configured rank count",
@@ -148,6 +150,15 @@ METRICS_DOC: dict[str, str] = {
                               "breaker tripped it back to "
                               "recommend-only (two consecutive "
                               "failed actions)",
+    # -- self-tuning data plane (ISSUE 15) ------------------------------
+    "mp4j_tuner_decisions_total": "per-link tuner decisions applied "
+                                  "per rank (+ cluster total)",
+    "mp4j_tuner_demotions_total": "fenced host-leader demotions the "
+                                  "master's tuner controller "
+                                  "dispatched",
+    "mp4j_tuner_tripped": "1 when an audit divergence tripped the "
+                          "tuner back to static defaults (latched "
+                          "for the job)",
 }
 
 
@@ -587,6 +598,34 @@ def to_prometheus(doc: dict) -> str:
                 out.append(
                     f'mp4j_critpath_dominator{{rank="{_esc(r)}"}} '
                     f"{_fmt(float(s))}")
+
+    # self-tuning data plane (ISSUE 15): per-rank applied-decision
+    # counters (from the slave registry's tuner/decisions) plus the
+    # master controller's demotion counter and trip gauge — present
+    # whenever the master runs with MP4J_TUNER != off
+    tun_block = []
+    tun_total = 0.0
+    for r in whos:
+        v = doc["ranks"][r].get("counters", {}).get("tuner/decisions")
+        if v:
+            tun_total += v
+            tun_block.append(
+                f'mp4j_tuner_decisions_total{{rank="{_esc(r)}"}} '
+                f"{_fmt(float(v))}")
+    if tun_block:
+        tun_block.append(
+            f'mp4j_tuner_decisions_total{{rank="cluster"}} '
+            f"{_fmt(tun_total)}")
+        out.append("# TYPE mp4j_tuner_decisions_total counter")
+        out.extend(tun_block)
+    tun = doc.get("cluster", {}).get("tuner")
+    if tun is not None:
+        out.append("# TYPE mp4j_tuner_demotions_total counter")
+        out.append(f"mp4j_tuner_demotions_total "
+                   f"{int(tun.get('demotions', 0))}")
+        out.append("# TYPE mp4j_tuner_tripped gauge")
+        out.append(f"mp4j_tuner_tripped "
+                   f"{1 if tun.get('tripped') else 0}")
 
     # autoscaler (ISSUE 13): per-action dispatch counters + the
     # circuit-breaker gauge — present whenever the master runs a

@@ -159,6 +159,28 @@ class Channel(abc.ABC):
         back to the Python raw path, which is wire-identical)."""
         return None
 
+    # -- frame-level route hooks (ISSUE 15) -----------------------------
+    # The framing layer announces, just before moving a payload unit
+    # whose byte length the OTHER end already knows (it traveled in the
+    # frame header or a chunk length prefix), how many bytes follow.
+    # Transports with more than one wire (the shm ring + carrier pair)
+    # override these to steer large units onto the fast plane; both
+    # ends derive the same route from the same announced length, so
+    # the split can never desync. Base/TCP: one wire, no-ops.
+    def _route_send(self, n: int) -> None:
+        pass
+
+    def _route_recv(self, n: int) -> None:
+        pass
+
+    def set_chunk_bytes(self, n: int) -> None:
+        """Per-link pipeline chunk size (ISSUE 15): sizes this
+        channel's streamed-compression pieces and chunked framed
+        receives. Receiver-local on a byte-stream transport — the
+        peer never needs to agree — which is exactly why the tuner
+        may adapt it per link."""
+        self._chunk_bytes = max(64, int(n))
+
     def _audit(self):
         """The owning slave's audit ring when wire folds are armed
         (``MP4J_AUDIT=verify|capture``), else None — rides the stats
@@ -240,16 +262,32 @@ class Channel(abc.ABC):
         if self.stats is not None:
             self.stats.add("serialize_seconds", time.perf_counter() - t0)
 
+    def _add_compress(self, raw: int, wire: int) -> None:
+        """Book one compression outcome (raw payload bytes -> wire
+        bytes) on this link's rolling stats — the observed-ratio
+        evidence the tuner's per-link compression policy consumes
+        (ISSUE 15)."""
+        if self.stats is not None and self.peer_rank is not None:
+            self.stats.add_compress(self.peer_rank, raw, wire)
+
     # -- objects --------------------------------------------------------
     def send_obj(self, obj, compress: bool = False) -> None:
         t0 = time.perf_counter()
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         tag = TAG_OBJ
         if compress:
+            raw_len = len(payload)
             payload = zlib.compress(payload, _ZLEVEL)
             tag = TAG_OBJ_Z
+            self._add_compress(raw_len, len(payload))
         self._add_serialize(t0)
-        self._send_all(_HDR.pack(tag, len(payload)), payload)
+        # header first, then the payload as one announced route unit:
+        # the header carries the payload length, so a multi-wire
+        # transport (shm ring + carrier) steers the payload while both
+        # ends agree on the route from the same number (ISSUE 15)
+        self._send_all(_HDR.pack(tag, len(payload)))
+        self._route_send(len(payload))
+        self._send_all(payload)
 
     # -- arrays (fast path) --------------------------------------------
     def send_array(self, arr: np.ndarray, compress: bool = False) -> None:
@@ -259,8 +297,10 @@ class Channel(abc.ABC):
         self._add_serialize(t0)
         if compress:
             return self._send_array_zc(arr, header)
+        ln = len(header) + 4 + arr.nbytes
+        self._send_all(_HDR.pack(TAG_ARRAY, ln))
+        self._route_send(ln)
         self._send_all(
-            _HDR.pack(TAG_ARRAY, len(header) + 4 + arr.nbytes),
             struct.pack("<I", len(header)),
             header,
             _raw_view(arr),
@@ -274,23 +314,37 @@ class Channel(abc.ABC):
         payload covers only the header; the chunk stream is
         self-delimiting (u32 length prefixes, 0 terminator), so the
         total compressed size never needs to be known up front."""
-        self._send_all(_HDR.pack(TAG_ARRAY_ZC, len(header) + 4),
-                       struct.pack("<I", len(header)), header)
+        self._send_all(_HDR.pack(TAG_ARRAY_ZC, len(header) + 4))
+        self._route_send(len(header) + 4)
+        self._send_all(struct.pack("<I", len(header)), header)
         comp = zlib.compressobj(_ZLEVEL)
         view = memoryview(_raw_view(arr)).cast("B")
         step = self._chunk_bytes
+        wire_total = 0
+
+        def _ship(piece: bytes) -> None:
+            # each compressed piece is its own announced route unit:
+            # its length travels on the carrier ahead of it, so both
+            # ends route it the same way (ISSUE 15)
+            self._send_all(_U32.pack(len(piece)))
+            self._route_send(len(piece))
+            self._send_all(piece)
+
         for off in range(0, len(view), step):
             t0 = time.perf_counter()
             piece = comp.compress(view[off:off + step])
             self._add_serialize(t0)
             if piece:
-                self._send_all(_U32.pack(len(piece)), piece)
+                wire_total += len(piece)
+                _ship(piece)
         t0 = time.perf_counter()
         piece = comp.flush()
         self._add_serialize(t0)
         if piece:
-            self._send_all(_U32.pack(len(piece)), piece)
+            wire_total += len(piece)
+            _ship(piece)
         self._send_all(_U32.pack(0))
+        self._add_compress(len(view), wire_total)
 
     # -- paired columnar map frames ------------------------------------
     # The socket map plane's wire unit (ISSUE 4): a map travels as its
@@ -382,6 +436,7 @@ class Channel(abc.ABC):
             (clen,) = _U32.unpack(bytes(self._recv_exact(4)))
             if clen == 0:
                 break
+            self._route_recv(clen)
             piece = self._recv_payload(clen)
             t0 = time.perf_counter()
             _write(decomp.decompress(piece))
@@ -402,6 +457,11 @@ class Channel(abc.ABC):
     def recv(self):
         hdr = self._recv_exact(_HDR.size)
         tag, ln = _HDR.unpack(bytes(hdr))
+        # the mirror of the send-side _route_send: the header told us
+        # the payload length, so route the same unit the sender did
+        if tag in (TAG_OBJ, TAG_OBJ_Z, TAG_ARRAY, TAG_ARRAY_Z,
+                   TAG_ARRAY_ZC):
+            self._route_recv(ln)
         if tag in (TAG_OBJ, TAG_OBJ_Z):
             payload = self._recv_exact(ln)
             t0 = time.perf_counter()
@@ -449,6 +509,7 @@ class Channel(abc.ABC):
             raise Mp4jError(
                 f"expected an array frame, got tag {tag} (operand "
                 "disagreement between sender and receiver?)")
+        self._route_recv(ln)
         (hlen,) = struct.unpack("<I", bytes(self._recv_exact(4)))
         dtype_str, shape = pickle.loads(self._recv_exact(hlen))
         dt = self._decode_dtype(dtype_str)

@@ -104,8 +104,10 @@ _OFF_POISON = 16
 # carrier's kernel path, whose wakeup latency no user-space wait can
 # match on an oversubscribed host); pieces are sized so the reader's
 # first kernel wakeup arrives after a fraction of the transfer and the
-# two sides stream in parallel through the ring.
-_RING_MIN = 256 * 1024
+# two sides stream in parallel through the ring. The value itself
+# lives in utils.tuning (ISSUE 15's R22 knob discipline: size
+# literals feeding transport decisions are centralized there).
+_RING_MIN = tuning.SHM_RING_MIN_BYTES
 _POLL_SLEEP = 50e-6          # writer's ring-space poll (reader active)
 _PARK_TICK = 0.05            # duplex select tick (poison/deadline checks)
 
@@ -407,10 +409,16 @@ class ShmChannel(Channel):
         self._owner = owner
         self._timeout: float | None = None
         self._closed = False
+        # frame-level ring routing (ISSUE 15): framed payload units at
+        # or above this threshold stream through the ring; 0 keeps the
+        # whole framed plane on the carrier (the pre-ISSUE-15 layout)
+        self._frame_min = tuning.shm_frame_min()
+        self._tx_stream: dict | None = None
+        self._rx_stream: dict | None = None
         # piece size: reader's first wakeup lands after a fraction of
         # a large transfer; half-ring keeps writer and reader streaming
         # in parallel through the same ring
-        self._piece = max(ring_bytes // 2, 4096)
+        self._piece = max(ring_bytes // 2, tuning.SHM_RING_FLOOR)
         ring_a = _Ring(seg.buf, 0, ring_bytes)
         ring_b = _Ring(seg.buf, _HDR_BYTES + ring_bytes, ring_bytes)
         # ring A is dialer->accepter by convention
@@ -429,11 +437,37 @@ class ShmChannel(Channel):
     # carrier I/O rides THE shared socket loops (transport/tcp.py) —
     # one place to fix socket semantics for both transports; the only
     # shm flavor is the poison-aware EOF upgrade (an invalidated
-    # channel must say so, not "peer closed")
+    # channel must say so, not "peer closed"). Since ISSUE 15 the
+    # framing layer's route hooks may arm a FRAME STREAM, steering a
+    # payload unit's bytes through the ring while its header (and the
+    # sync bytes) keep the carrier.
     def _io_send(self, buf) -> None:
+        st = self._tx_stream
+        if st is not None:
+            # wire-ready byte buffers only: _send_all's callers pin
+            # contiguity/dtype before framing (channel.py discipline)
+            # mp4j-lint: disable=R13 (already-serialized frame bytes)
+            view = memoryview(buf)
+            if view.ndim != 1 or view.format != "B":
+                view = view.cast("B")
+            take = min(len(view), st["end"] - st["pos"])
+            self._stream_send(view[:take], st)
+            if take < len(view):
+                tcp_sendall_checked(self.sock, view[take:])
+            return
         tcp_sendall_checked(self.sock, buf)
 
     def _io_recv_into(self, view: memoryview) -> None:
+        st = self._rx_stream
+        if st is not None:
+            take = min(len(view), st["end"] - st["pos"])
+            self._stream_recv(view[:take], st)
+            if take < len(view):
+                self._carrier_recv_into(view[take:])
+            return
+        self._carrier_recv_into(view)
+
+    def _carrier_recv_into(self, view: memoryview) -> None:
         try:
             tcp_recv_into_checked(self.sock, view, self._whom(),
                                   what="shm carrier")
@@ -443,6 +477,87 @@ class ShmChannel(Channel):
                     f"shm channel invalidated{self._whom()} "
                     f"({len(view)} byte receive torn)") from None
             raise
+
+    # -- frame-level ring routing (ISSUE 15) ----------------------------
+    # The framing layer announces each payload unit whose length the
+    # peer already knows (frame header / chunk length prefix). Units
+    # clearing MP4J_SHM_FRAME_MIN become a RING STREAM: the unit's
+    # bytes move through the SPSC ring in the same piece schedule the
+    # raw plane uses — a pure function of (unit length, ring size), so
+    # both ends agree without 1:1 buffer pairing: the sender may write
+    # in any granularity (u32 prefix, pickle header, array body) and
+    # the receiver may read in any other (header peek, chunked fills);
+    # the stream serves both against the shared piece/sync schedule.
+    def _route_send(self, n: int) -> None:
+        if 0 < self._frame_min <= n:
+            self._check_poison("send")
+            self._tx_stream = {"end": n, "pos": 0, "idx": 0,
+                               "pieces": self._pieces(n),
+                               "bound": 0}
+            self._tx_stream["bound"] = self._tx_stream["pieces"][0]
+
+    def _route_recv(self, n: int) -> None:
+        if 0 < self._frame_min <= n:
+            self._check_poison("recv")
+            self._rx_stream = {"end": n, "pos": 0, "idx": 0,
+                               "pieces": self._pieces(n),
+                               "synced": 0}
+
+    def _stream_send(self, src: memoryview, st: dict) -> None:
+        """Move ``src`` into the tx ring as part of the armed frame
+        stream, publishing one carrier sync byte per completed piece
+        (the kernel-grade wakeup the reader blocks on)."""
+        deadline = (None if self._timeout is None
+                    else time.monotonic() + self._timeout)
+        off, n = 0, len(src)
+        while off < n:
+            moved = self._tx.write_some(src, off, st["bound"] - st["pos"])
+            if moved:
+                off += moved
+                st["pos"] += moved
+                if st["pos"] == st["bound"]:
+                    # piece complete -> ONE kernel wakeup; sync bytes
+                    # bypass _io_send (the stream must not recurse)
+                    tcp_sendall_checked(self.sock, b"\x01")
+                    st["idx"] += 1
+                    if st["idx"] < len(st["pieces"]):
+                        st["bound"] += st["pieces"][st["idx"]]
+                continue
+            if self._tx.poisoned or self._rx.poisoned:
+                self._raise_poisoned("send", n - off)
+            if deadline is not None and time.monotonic() > deadline:
+                raise Mp4jTransportError(
+                    f"shm frame-stream send timed out with {n - off} "
+                    f"bytes pending{self._whom()} (peer dead or "
+                    "stalled?)")
+            time.sleep(_POLL_SLEEP)
+        if st["pos"] >= st["end"]:
+            self._tx_stream = None
+        if self.stats is not None and n:
+            self.stats.add("wire_bytes_shm_ring", n)
+
+    def _stream_recv(self, view: memoryview, st: dict) -> None:
+        """Fill ``view`` from the rx ring's armed frame stream,
+        blocking in a normal kernel recv for each piece's sync byte
+        (TCP-grade wakeup) — after which the piece's bytes are
+        GUARANTEED present in the ring."""
+        sync = bytearray(1)
+        off, n = 0, len(view)
+        while off < n:
+            if st["pos"] == st["synced"]:
+                self._carrier_recv_into(memoryview(sync))
+                if self._tx.poisoned or self._rx.poisoned:
+                    self._raise_poisoned("recv", n - off)
+                st["synced"] += st["pieces"][st["idx"]]
+                st["idx"] += 1
+            take = min(n - off, st["synced"] - st["pos"])
+            self._rx.read_exact(view, off, take)
+            off += take
+            st["pos"] += take
+        if st["pos"] >= st["end"]:
+            self._rx_stream = None
+        if self.stats is not None and n:
+            self.stats.add("wire_bytes_shm_ring", n)
 
     # -- raw plane: hybrid ring/carrier routing -------------------------
     def _check_poison(self, op: str) -> None:
@@ -490,7 +605,10 @@ class ShmChannel(Channel):
                         f"pending{self._whom()} (peer dead or stalled?)")
                 time.sleep(_POLL_SLEEP)
             # piece complete -> ONE kernel-grade wakeup on the carrier
-            self._io_send(b"\x01")
+            # (direct: sync bytes must never enter a frame stream)
+            tcp_sendall_checked(self.sock, b"\x01")
+        if self.stats is not None:
+            self.stats.add("wire_bytes_shm_ring", n)
 
     def recv_raw_into(self, arr) -> None:
         dst = memoryview(_raw_view(arr)).cast("B")
@@ -504,11 +622,13 @@ class ShmChannel(Channel):
         for size in self._pieces(n):
             # block in a normal kernel recv for the piece's sync byte
             # (TCP-grade wakeup), then the piece is GUARANTEED present
-            self._io_recv_into(memoryview(sync))
+            self._carrier_recv_into(memoryview(sync))
             if self._tx.poisoned or self._rx.poisoned:
                 self._raise_poisoned("recv", n - off)
             self._rx.read_exact(dst, off, size)
             off += size
+        if self.stats is not None:
+            self.stats.add("wire_bytes_shm_ring", n)
 
     def _raise_poisoned(self, op: str, pending: int) -> None:
         raise Mp4jTransportError(
@@ -705,6 +825,9 @@ def duplex_exchange(send_ch: ShmChannel | None, sarr,
                 select.select(rlist, wlist, [], _PARK_TICK)
             except (OSError, ValueError):
                 pass    # torn carrier: the next recv/send adjudicates
+        ring_bytes_moved = (sn if s_ring else 0) + (rn if r_ring else 0)
+        if ring_bytes_moved and send_ch.stats is not None:
+            send_ch.stats.add("wire_bytes_shm_ring", ring_bytes_moved)
     finally:
         try:
             ssock.settimeout(send_ch._timeout)

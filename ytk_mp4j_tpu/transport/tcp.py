@@ -25,22 +25,51 @@ from ytk_mp4j_tpu.utils import tuning
 from ytk_mp4j_tpu.exceptions import Mp4jError, Mp4jTransportError
 
 
-def apply_socket_buf_sizes(sock: socket.socket) -> None:
+def apply_socket_buf_sizes(sock: socket.socket,
+                           so_bufs: tuple[int, int] | None = None
+                           ) -> None:
     """Apply ``MP4J_SO_SNDBUF`` / ``MP4J_SO_RCVBUF`` (validated; unset
-    keeps the kernel's autotuned defaults). Must run BEFORE
-    ``connect()`` on dialing sockets and before ``listen()`` on server
-    sockets (accepted sockets inherit): TCP fixes the window-scale
-    factor at the SYN/SYN-ACK from the buffer size at that moment, so
-    a post-handshake resize cannot widen the advertised window."""
-    for env, opt in (("MP4J_SO_SNDBUF", socket.SO_SNDBUF),
-                     ("MP4J_SO_RCVBUF", socket.SO_RCVBUF)):
+    keeps the kernel's autotuned defaults). ``so_bufs`` is a PER-LINK
+    ``(sndbuf, rcvbuf)`` override (ISSUE 15: ``MP4J_SO_BUF_MAP`` or a
+    tuner decision) taking precedence over the job-wide knobs; 0 in
+    either slot falls back to that direction's job-wide value. Must
+    run BEFORE ``connect()`` on dialing sockets and before
+    ``listen()`` on server sockets (accepted sockets inherit): TCP
+    fixes the window-scale factor at the SYN/SYN-ACK from the buffer
+    size at that moment, so a post-handshake resize cannot widen the
+    advertised window."""
+    for i, (env, opt) in enumerate(
+            (("MP4J_SO_SNDBUF", socket.SO_SNDBUF),
+             ("MP4J_SO_RCVBUF", socket.SO_RCVBUF))):
         size = tuning.env_bytes(env, 0, minimum=0)
+        if so_bufs is not None and so_bufs[i] > 0:
+            size = so_bufs[i]
         if size > 0:
             try:
                 sock.setsockopt(socket.SOL_SOCKET, opt, size)
             except OSError as e:
                 raise Mp4jError(f"{env}={size} rejected by the "
                                 f"kernel: {e}") from None
+
+
+def set_so_bufs(sock: socket.socket, snd: int | None,
+                rcv: int | None) -> None:
+    """Per-link buffer resize on a LIVE socket (ISSUE 15: the tuner's
+    boundary application). Post-handshake, so it cannot widen the
+    negotiated window scale — it still sizes the kernel's queue
+    (useful shrinking, or growing within the scale factor)."""
+    if snd:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, int(snd))
+    if rcv:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, int(rcv))
+
+
+def applied_buf_sizes(sock: socket.socket) -> tuple[int, int]:
+    """The kernel's ACTUAL (sndbuf, rcvbuf) for this socket — what
+    ``comm.link_stats()`` records per link (the kernel may round or
+    double requested sizes, so the readback is the truth)."""
+    return (sock.getsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF),
+            sock.getsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF))
 
 
 def sendall_checked(sock: socket.socket, buf) -> None:
@@ -170,16 +199,17 @@ class TcpChannel(Channel):
         self.sock.close()
 
 
-def connect(host: str, port: int,
-            timeout: float | None = None) -> TcpChannel:
+def connect(host: str, port: int, timeout: float | None = None,
+            so_bufs: tuple[int, int] | None = None) -> TcpChannel:
     # buffer sizes must be in place before the TCP handshake (window
-    # scale negotiation) — so no create_connection() shortcut here
+    # scale negotiation) — so no create_connection() shortcut here;
+    # so_bufs is the per-link override (ISSUE 15)
     err: Exception | None = None
     for family, socktype, proto, _, addr in socket.getaddrinfo(
             host, port, type=socket.SOCK_STREAM):
         sock = socket.socket(family, socktype, proto)
         try:
-            apply_socket_buf_sizes(sock)
+            apply_socket_buf_sizes(sock, so_bufs)
             sock.settimeout(timeout)
             sock.connect(addr)
             sock.settimeout(None)

@@ -81,10 +81,16 @@ _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
 # >= 2 collectives outstanding (suffix-free on purpose — they are
 # wall intervals, not busy phases, and must stay out of the phase
 # span/critpath machinery), the substrate of the ovl% column.
+# wire_bytes_shm_ring (ISSUE 15): the subset of wire_bytes_shm that
+# moved through the lock-free rings themselves (raw-plane pieces AND
+# frame-routed payload units) rather than the pair's TCP carrier — the
+# acceptance evidence that the framed/columnar-map planes actually
+# ride the rings for co-located pairs.
 _COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys",
              "retries", "reconnects", "aborts_seen",
              "replacements_seen", "shrinks_seen",
              "wire_bytes_tcp", "wire_bytes_shm",
+             "wire_bytes_shm_ring",
              "outstanding_peak", "coalesced_frames",
              "async_inflight", "async_overlap")
 
@@ -141,6 +147,14 @@ class CommStats:
         self._shared_name: str | None = None
         self._shared_seq = 0
         self._shared_depth = 0
+        # per-link rolling accumulators (ISSUE 15): the tuner's
+        # evidence substrate — cumulative wire bytes/seconds/frames per
+        # peer link (split per transport) plus compression outcomes
+        # (raw payload bytes -> wire bytes), all monotone so windowed
+        # deltas fall out of two snapshots. Applied per-link socket
+        # buffer sizes land here too (note_link) so the decision the
+        # transport actually took is observable next to its evidence.
+        self._links: dict[int, dict[str, float]] = {}
 
     # -- attribution ---------------------------------------------------
     def begin(self, name: str) -> int:
@@ -335,6 +349,13 @@ class CommStats:
             e["chunks"] += chunks
             if tagged is not None:
                 e[f"wire_bytes_{tagged}"] += bytes_sent + bytes_recv
+            if peer is not None:
+                lk = self._link_locked(peer)
+                lk["bytes"] += bytes_sent + bytes_recv
+                lk["secs"] += seconds
+                lk["frames"] += 1
+                if tagged is not None:
+                    lk[f"bytes_{tagged}"] += bytes_sent + bytes_recv
             self._last_phase = "wire"
         if spans._enabled:
             # transport rides the span args too (ISSUE 9): the
@@ -358,6 +379,58 @@ class CommStats:
                 self.metrics.observe(fam, bytes_recv,
                                      metrics_mod.FRAME_LO,
                                      metrics_mod.FRAME_BUCKETS)
+
+    # -- per-link evidence (ISSUE 15) ----------------------------------
+    def _link_locked(self, peer: int) -> dict[str, float]:
+        lk = self._links.get(peer)
+        if lk is None:
+            lk = self._links[peer] = {
+                "bytes": 0, "secs": 0.0, "frames": 0,
+                "bytes_tcp": 0, "bytes_shm": 0,
+                "comp_raw": 0, "comp_wire": 0, "comp_frames": 0,
+                "xfer_bytes": 0, "xfers": 0}
+        return lk
+
+    def add_transfer(self, peer: int, nbytes: int) -> None:
+        """Book one BULK transfer (a collective exchange segment) on
+        ``peer``'s link — the granularity evidence the tuner's chunk
+        policy consumes (add_wire's per-chunk frames can't recover
+        the original transfer size)."""
+        with self._lock:
+            lk = self._link_locked(peer)
+            lk["xfer_bytes"] += nbytes
+            lk["xfers"] += 1
+
+    def add_compress(self, peer: int, raw: int, wire: int) -> None:
+        """Book one compression outcome on ``peer``'s link: ``raw``
+        payload bytes went out as ``wire`` bytes. The rolling ratio
+        (and the implied zlib cost already booked as serialize
+        seconds) is the evidence the tuner's per-link compression
+        policy weighs."""
+        with self._lock:
+            lk = self._link_locked(peer)
+            lk["comp_raw"] += raw
+            lk["comp_wire"] += wire
+            lk["comp_frames"] += 1
+
+    def note_link(self, peer: int, **info) -> None:
+        """Record non-counter link facts (applied socket buffer
+        sizes, transport tag) — absolute values, not accumulators."""
+        with self._lock:
+            self._link_locked(peer).update(info)
+
+    def link_snapshot(self) -> dict[int, dict[str, float]]:
+        """Per-peer-link rolling accumulators (ISSUE 15); two
+        snapshots diff into one tuner decision window."""
+        with self._lock:
+            return {p: dict(v) for p, v in self._links.items()}
+
+    def forget_links(self) -> None:
+        """Drop the per-link accumulators (membership changes: a
+        renumbered peer id must not inherit the old occupant's
+        evidence)."""
+        with self._lock:
+            self._links.clear()
 
     # -- reading -------------------------------------------------------
     def snapshot(self) -> dict[str, dict[str, float]]:

@@ -36,7 +36,20 @@ Knobs (all validated where they are consumed; garbage raises
   to offer it.
 - ``MP4J_SHM_RING_BYTES`` — bytes per DIRECTION of each shm peer
   pair's ring buffer (default 1 MiB, matching ``MP4J_CHUNK_BYTES`` so
-  a pipeline chunk fits the ring in one pass).
+  a pipeline chunk fits the ring in one pass). Since ISSUE 15 the
+  rings carry BOTH planes: raw-plane transfers clearing
+  ``SHM_RING_MIN_BYTES`` and framed/columnar-map payloads clearing
+  ``MP4J_SHM_FRAME_MIN`` (the header-derived frame routing below) —
+  not just the raw plane.
+- ``MP4J_SHM_FRAME_MIN`` — frame-level ring routing threshold
+  (ISSUE 15): a FRAMED payload (array frames, object frames,
+  columnar-map columns, streamed-compression pieces) whose byte
+  length — already known to both ends from the frame header / chunk
+  length prefix — clears this value rides the shm ring instead of
+  the TCP carrier. ``0`` disables frame routing (every framed byte
+  keeps the carrier — the pre-ISSUE-15 wire layout). JOB-wide like
+  ``native_transport``: the threshold IS the wire protocol for shm
+  pairs, so every rank must agree.
 - ``MP4J_HEARTBEAT_SECS`` — period of the slave->master telemetry
   heartbeat (``comm/process_comm.py``); ``0`` disables heartbeats.
 - ``MP4J_SPAN_RING`` — capacity of the in-process span ring buffer
@@ -183,6 +196,30 @@ Knobs (all validated where they are consumed; garbage raises
   point at the rendezvous listener) to spawn a fresh ``spare=True``
   process; empty disables the subprocess path (the
   ``Master(provision_hook=)`` constructor seam still works).
+- ``MP4J_TUNER`` — the self-tuning data plane (ISSUE 15;
+  ``utils/tuner.py``): ``off`` (static knobs only, the pre-tuner
+  behavior bit-for-bit), ``observe`` (default: the policy core
+  evaluates the rolling per-link stats every window and RECORDS the
+  decisions it would make — telemetry, ``mp4j-scope tuner`` — but
+  applies nothing), ``act`` (per-link chunk-size / compression /
+  socket-buffer decisions apply at outermost-collective boundaries,
+  and the master may demote a persistently wire-dominated host
+  leader through a fenced topology update). A LOCAL
+  execution-strategy knob for the per-link decisions (the framed
+  wire format is receiver-auto-detected, so sender-side decisions
+  never desync a pair) — but run every rank with the same value so
+  the telemetry reads coherently.
+- ``MP4J_TUNER_WINDOW_SECS`` — how often the tuner folds the rolling
+  per-link stats into a decision window; hysteresis is counted in
+  these windows (a decision changes only after
+  ``tuner.SUSTAIN_WINDOWS`` consecutive windows agree).
+- ``MP4J_SO_BUF_MAP`` — explicit PER-LINK socket buffer overrides:
+  ``"peer:sndbuf[/rcvbuf],..."`` (e.g. ``"2:262144,3:524288/1048576"``)
+  applies those buffer sizes to the TCP link with that peer rank at
+  channel setup (dial side before ``connect()``, accept side after
+  the handshake identifies the peer), overriding the job-wide
+  ``MP4J_SO_{SND,RCV}BUF`` for that link; the applied values are
+  recorded per link in ``comm.link_stats()``.
 """
 
 from __future__ import annotations
@@ -204,6 +241,24 @@ DEFAULT_ALGO_LARGE_BYTES = 4 * 1024 * 1024
 # pipeline chunk so a chunked exchange streams through without an
 # intermediate wait in the common case.
 DEFAULT_SHM_RING_BYTES = 1024 * 1024
+# The raw-plane ring threshold (ISSUE 7, centralized here by ISSUE 15's
+# R22 knob discipline): a raw transfer below this rides the shm pair's
+# TCP carrier — the kernel's recv wakeup beats every user-space wait on
+# an oversubscribed host (measured, see transport/shm.py) — and one at
+# or above it streams through the ring in pieces. Part of the shm wire
+# protocol: both ends derive the route from the same transfer size.
+SHM_RING_MIN_BYTES = 256 * 1024
+# Floor for ring capacity (the MP4J_SHM_RING_BYTES validator, the peer
+# handshake's sanity check, and the piece-size clamp all share it): one
+# frame header plus a compressed chunk length must always be
+# ring-transitable.
+SHM_RING_FLOOR = 4096
+# Frame-level ring routing default (ISSUE 15): smaller than the raw
+# plane's SHM_RING_MIN_BYTES because framed payloads (map value
+# columns, compressed pieces) already paid the framing/serialize tax —
+# the ring memcpy wins earlier there; the sync-byte wakeup still rides
+# the carrier, so small frames keep the pure kernel path.
+DEFAULT_SHM_FRAME_MIN = 64 * 1024
 # Resilience defaults (ISSUE 5): recovery is ON by default — two
 # epoch-fenced retry rounds per failed collective — because the fence
 # itself is a flag check (~0 steady-state cost; the input-preservation
@@ -353,10 +408,25 @@ def shm_enabled() -> bool:
 
 def shm_ring_bytes() -> int:
     """Bytes per direction of each shm peer pair's ring
-    (``MP4J_SHM_RING_BYTES``). The floor keeps one frame header plus a
-    compressed chunk length always ring-transitable."""
+    (``MP4J_SHM_RING_BYTES``). Since ISSUE 15 the rings carry the
+    framed/columnar-map plane too (see :func:`shm_frame_min`), not
+    just raw transfers. The floor (:data:`SHM_RING_FLOOR`) keeps one
+    frame header plus a compressed chunk length always
+    ring-transitable."""
     return env_bytes("MP4J_SHM_RING_BYTES", DEFAULT_SHM_RING_BYTES,
-                     minimum=4096)
+                     minimum=SHM_RING_FLOOR)
+
+
+def shm_frame_min() -> int:
+    """Frame-level ring routing threshold (``MP4J_SHM_FRAME_MIN``,
+    ISSUE 15): a framed payload whose length — carried by the frame
+    header / chunk length prefix, so both ends know it BEFORE any
+    payload byte moves — clears this value rides the shm ring; ``0``
+    disables frame routing (all framed bytes keep the TCP carrier,
+    the pre-ISSUE-15 wire layout). JOB-wide like ``native_transport``:
+    the threshold is part of the shm pair's wire protocol."""
+    return env_bytes("MP4J_SHM_FRAME_MIN", DEFAULT_SHM_FRAME_MIN,
+                     minimum=0)
 
 
 def map_columnar_enabled() -> bool:
@@ -772,6 +842,79 @@ def provision_cmd() -> str:
     environment when the warm-spare pool drains to zero under
     ``MP4J_AUTOSCALE=act``."""
     return os.environ.get("MP4J_PROVISION_CMD", "").strip()
+
+
+# Self-tuning data plane defaults (ISSUE 15): OBSERVE by default — the
+# policy core runs and its would-be decisions are visible everywhere
+# (telemetry, `mp4j-scope tuner`), but nothing changes until the
+# operator opts into `act`; the window paces evidence collection (a
+# decision needs SUSTAIN_WINDOWS consecutive agreeing windows, so the
+# reaction time is window * sustain, deliberately slower than any
+# single noisy interval).
+TUNER_MODES = ("off", "observe", "act")
+DEFAULT_TUNER_MODE = "observe"
+DEFAULT_TUNER_WINDOW_SECS = 2.0
+
+
+def tuner_mode(override=None) -> str:
+    """The self-tuning data plane's mode (``MP4J_TUNER``): one of
+    :data:`TUNER_MODES`. ``override`` is the explicit constructor arg
+    (``ProcessCommSlave(tuner=...)`` / ``Master(tuner=...)``) — it
+    bypasses the env read but gets the SAME validation (one validator
+    per knob, the PR 5 discipline)."""
+    if override is not None:
+        raw = str(override)
+    else:
+        raw = os.environ.get("MP4J_TUNER")
+        if raw is None or raw.strip() == "":
+            return DEFAULT_TUNER_MODE
+    name = raw.strip().lower()
+    if name not in TUNER_MODES:
+        raise Mp4jError(
+            f"MP4J_TUNER={raw!r} is not one of {list(TUNER_MODES)}")
+    return name
+
+
+def tuner_window_secs() -> float:
+    """The tuner's decision-window period
+    (``MP4J_TUNER_WINDOW_SECS``); must be positive — disabling the
+    tuner is ``MP4J_TUNER=off``, not a zero window."""
+    return env_float("MP4J_TUNER_WINDOW_SECS",
+                     DEFAULT_TUNER_WINDOW_SECS, minimum=0.05)
+
+
+def so_buf_map() -> dict[int, tuple[int, int]]:
+    """Explicit per-link socket buffer overrides (``MP4J_SO_BUF_MAP``,
+    ISSUE 15 satellite): ``"peer:sndbuf[/rcvbuf],..."`` parsed into
+    ``{peer_rank: (sndbuf, rcvbuf)}`` (one size applies to both
+    directions when no ``/rcvbuf`` is given). Validated here like
+    every other knob — a malformed entry fails slave setup with the
+    offending token named, never a mid-dial surprise."""
+    raw = os.environ.get("MP4J_SO_BUF_MAP", "").strip()
+    out: dict[int, tuple[int, int]] = {}
+    if not raw:
+        return out
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        try:
+            rank_s, sizes = tok.split(":", 1)
+            rank = int(rank_s)
+            if "/" in sizes:
+                snd_s, rcv_s = sizes.split("/", 1)
+                snd, rcv = int(snd_s), int(rcv_s)
+            else:
+                snd = rcv = int(sizes)
+        except ValueError:
+            raise Mp4jError(
+                f"MP4J_SO_BUF_MAP entry {tok!r} is not "
+                "'peer:sndbuf[/rcvbuf]'") from None
+        if rank < 0 or snd < 0 or rcv < 0:
+            raise Mp4jError(
+                f"MP4J_SO_BUF_MAP entry {tok!r} has a negative value")
+        out[rank] = (snd, rcv)
+    return out
 
 
 def fault_plan_spec() -> str:
