@@ -1020,11 +1020,17 @@ def bench_audit_overhead(rounds=2):
 
 
 def bench_lint_runtime(reps=3):
-    """ISSUE 14: mp4j-lint's own runtime over this repo, per-file pass
-    vs the full two-pass run (per-file rules + the whole-program
-    R19-R21 index/lock-model pass). The whole-program mode rides the
-    tier-1 gate on every CI run, so its cost is tracked like any other
-    figure; budget: the full run stays <= 2x the per-file pass."""
+    """ISSUE 14 + 16: mp4j-lint's own runtime over this repo — the
+    per-file pass, the v2 two-pass run (per-file rules + the R19-R21
+    lock-model pass) and the v3 run (adds the R23 lockset / R24-R25
+    resource whole-program passes). The full mode rides the tier-1
+    gate on every CI run, so its cost is tracked like any other
+    figure; budgets: the full run stays <= 2x the per-file pass, and
+    v3 stays <= 1.5x v2 (the race/resource models reuse v2's parsed
+    index, call graph and lock summaries — their marginal cost is the
+    fixpoint over already-built structures, not a re-parse). Engine
+    caches are cleared between timed legs so every leg pays the full
+    parse it would pay on a cold CI run."""
     import time as _time
 
     from ytk_mp4j_tpu.analysis.engine import Engine, ProgramRule
@@ -1032,21 +1038,36 @@ def bench_lint_runtime(reps=3):
 
     pkg = os.path.dirname(os.path.abspath(
         __import__("ytk_mp4j_tpu").__file__))
+    v2_ids = ("R19", "R20", "R21")
     per_file = inf = float("inf")
-    full = inf
+    full = v2 = inf
     for _ in range(reps):
         rules = [r for r in get_rules()
                  if not isinstance(r, ProgramRule)]
+        eng = Engine(rules=rules)
+        eng.clear_caches()
         t0 = _time.perf_counter()
-        Engine(rules=rules).lint_paths([pkg])
+        eng.lint_paths([pkg])
         per_file = min(per_file, _time.perf_counter() - t0)
+        rules = [r for r in get_rules()
+                 if not isinstance(r, ProgramRule)
+                 or r.rule_id in v2_ids]
+        eng = Engine(rules=rules)
+        eng.clear_caches()
         t0 = _time.perf_counter()
-        Engine().lint_paths([pkg])
+        eng.lint_paths([pkg])
+        v2 = min(v2, _time.perf_counter() - t0)
+        eng = Engine()
+        eng.clear_caches()
+        t0 = _time.perf_counter()
+        eng.lint_paths([pkg])
         full = min(full, _time.perf_counter() - t0)
     return {
         "lint_runtime_secs": round(full, 3),
         "lint_perfile_secs": round(per_file, 3),
         "lint_wholeprogram_ratio": round(full / per_file, 3),
+        "lint_v2_secs": round(v2, 3),
+        "lint_v3_over_v2_ratio": round(full / v2, 3),
     }
 
 
@@ -1651,6 +1672,10 @@ def main():
             # (budget: <= 2x)
             "lint_runtime": lint_runtime,
             "lint_runtime_secs": lint_runtime["lint_runtime_secs"],
+            # ISSUE 16: v3 (R23-R25 lockset/resource passes) over v2
+            # (R19-R21) — flattened so bench-diff gates it (<= 1.5x)
+            "lint_v3_over_v2_ratio":
+                lint_runtime["lint_v3_over_v2_ratio"],
             "metrics_overhead": {
                 # False means the caller exported MP4J_METRICS=0 and
                 # the "on" leg really ran off — overhead_pct is then

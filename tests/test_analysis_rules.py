@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 23)]
+    assert ids == [f"R{i}" for i in range(1, 26)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1493,3 +1493,423 @@ def test_r22_inline_suppression():
             return n >= 1048576
     """, path="ytk_mp4j_tpu/transport/snippet.py")
     assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# R23 — inconsistent lockset on a shared field (ISSUE 16)
+# ----------------------------------------------------------------------
+def test_r23_fires_on_unlocked_thread_write():
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = "idle"
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                self.state = "running"
+
+            def status(self):
+                with self._lock:
+                    return self.state
+    """)
+    [f] = r.findings
+    assert f.rule == "R23"
+    assert f.context == "Plane._loop"
+    assert "Plane.state" in f.message
+    assert "candidate lock Plane._lock" in f.message
+    # both witness sites with their roots travel in the message
+    assert "thread:Plane._loop" in f.message
+    assert "main" in f.message
+
+
+def test_r23_quiet_when_lockset_consistent():
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = "idle"
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                with self._lock:
+                    self.state = "running"
+
+            def status(self):
+                with self._lock:
+                    return self.state
+    """)
+    assert not r.findings
+
+
+def test_r23_quiet_on_single_root_field():
+    # only the drain thread ever touches the field: nothing to race
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self.state = "idle"
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                self.state = "running"
+                self._step()
+
+            def _step(self):
+                return self.state
+    """)
+    assert not r.findings
+
+
+def test_r23_constructor_writes_are_not_a_root():
+    # __init__-time writes happen before publication: the classic
+    # happens-before edge, never a race witness
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = "idle"
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                with self._lock:
+                    self.state = "running"
+
+            def status(self):
+                with self._lock:
+                    return self.state
+    """)
+    assert not r.findings
+
+
+def test_r23_scoped_to_covered_dirs():
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+                self.state = "idle"
+
+            def _loop(self):
+                self.state = "running"
+
+            def status(self):
+                return self.state
+    """, path="ytk_mp4j_tpu/models/snippet.py")
+    assert not r.findings
+
+
+def test_r23_inline_suppression():
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+                self.state = "idle"
+
+            def _loop(self):
+                # mp4j-lint: disable=R23 (lock-free flag publication)
+                self.state = "running"
+
+            def status(self):
+                return self.state
+    """)
+    assert not r.findings
+    assert any(f.rule == "R23" for f in r.suppressed)
+
+
+def test_r23_baseline_suppression_by_write_context():
+    bl = baseline_mod.parse(textwrap.dedent("""
+        [[suppression]]
+        rule = "R23"
+        file = "ytk_mp4j_tpu/comm/snippet.py"
+        context = "Plane._loop"
+        reason = "deliberate lock-free publication (test)"
+    """))
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+                self.state = "idle"
+
+            def _loop(self):
+                self.state = "running"
+
+            def status(self):
+                return self.state
+    """, baseline=bl)
+    assert not r.findings
+    assert any(f.rule == "R23" for f in r.suppressed)
+
+
+# ----------------------------------------------------------------------
+# R24 — resource leaked on an exception path (ISSUE 16)
+# ----------------------------------------------------------------------
+def test_r24_fires_on_socket_exception_edge():
+    r = run_rule("R24", """
+        import socket
+
+        def probe(host):
+            s = socket.create_connection((host, 9999))
+            s.sendall(b"ping")
+            reply = s.recv(16)
+            s.close()
+            return reply
+    """)
+    [f] = r.findings
+    assert f.rule == "R24"
+    assert f.line == 5          # charged at the ACQUIRE site
+    assert "socket 's'" in f.message
+    assert "sendall" in f.message
+
+
+def test_r24_fires_on_accept_then_unprotected_call():
+    # the pre-PR rendezvous shape: work between accept and the guard
+    r = run_rule("R24", """
+        def serve_one(server, deadline, now):
+            sock, addr = server.accept()
+            remaining = max(0.0, deadline - now)
+            sock.settimeout(remaining)
+            return sock
+    """)
+    [f] = r.findings
+    assert f.line == 3 and "socket 'sock'" in f.message
+
+
+def test_r24_fires_on_never_released():
+    r = run_rule("R24", """
+        import socket
+
+        def hold(host):
+            s = socket.create_connection((host, 1))
+    """)
+    [f] = r.findings
+    assert "never released or handed off" in f.message
+
+
+def test_r24_fires_on_lock_acquire_exception_edge():
+    r = run_rule("R24", """
+        def charge(self, ev):
+            self._lock.acquire()
+            self._audit(ev)
+            self._lock.release()
+    """)
+    [f] = r.findings
+    assert "lock" in f.message and "try/finally" in f.message
+
+
+def test_r24_quiet_with_try_finally():
+    r = run_rule("R24", """
+        import socket
+
+        def probe(host):
+            s = socket.create_connection((host, 9999))
+            try:
+                s.sendall(b"ping")
+                return s.recv(16)
+            finally:
+                s.close()
+    """)
+    assert not r.findings
+
+
+def test_r24_quiet_with_with_block():
+    r = run_rule("R24", """
+        def read(path):
+            with open(path) as fh:
+                return fh.read()
+    """)
+    assert not r.findings
+
+
+def test_r24_quiet_on_ownership_transfer():
+    r = run_rule("R24", """
+        import socket
+
+        class Pool:
+            def adopt(self, host):
+                s = socket.create_connection((host, 9999))
+                self._socks.append(s)
+                self._greet(s)
+    """)
+    assert not r.findings
+
+
+def test_r24_quiet_on_absorbing_handler():
+    # `except Exception: ok = False` absorbs the body's exception
+    # edges; the fall-through path owns the release
+    r = run_rule("R24", """
+        import socket
+
+        def probe(host):
+            s = socket.create_connection((host, 9999))
+            ok = True
+            try:
+                s.sendall(b"ping")
+            except Exception:
+                ok = False
+            s.close()
+            return ok
+    """)
+    assert not r.findings
+
+
+def test_r24_reraising_handler_does_not_absorb():
+    r = run_rule("R24", """
+        import socket
+
+        def probe(host):
+            s = socket.create_connection((host, 9999))
+            try:
+                s.sendall(b"ping")
+            except Exception:
+                raise RuntimeError("probe failed")
+            s.close()
+    """)
+    [f] = r.findings
+    assert "socket 's'" in f.message
+
+
+def test_r24_inline_suppression():
+    r = run_rule("R24", """
+        import socket
+
+        def probe(host):
+            # mp4j-lint: disable=R24 (fd adopted by caller via errno)
+            s = socket.create_connection((host, 9999))
+            s.sendall(b"ping")
+            s.close()
+    """)
+    assert not r.findings
+    assert any(f.rule == "R24" for f in r.suppressed)
+
+
+# ----------------------------------------------------------------------
+# R25 — thread started without join/daemon/stop registration (ISSUE 16)
+# ----------------------------------------------------------------------
+def test_r25_fires_on_fire_and_forget_attr_thread():
+    r = run_rule("R25", """
+        import threading
+
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._drain)
+                self._t.start()
+
+            def _drain(self):
+                pass
+    """)
+    [f] = r.findings
+    assert f.rule == "R25"
+    assert "'_t'" in f.message and "no function joins" in f.message
+
+
+def test_r25_fires_on_inline_start():
+    r = run_rule("R25", """
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                pass
+    """)
+    [f] = r.findings
+    assert "never be joined" in f.message
+
+
+def test_r25_quiet_on_daemon_ctor_and_attr():
+    r = run_rule("R25", """
+        import threading
+
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._drain,
+                                           daemon=True)
+                self._t.start()
+
+            def kick(self):
+                t = threading.Thread(target=self._drain)
+                t.daemon = True
+                t.start()
+
+            def _drain(self):
+                pass
+    """)
+    assert not r.findings
+
+
+def test_r25_quiet_on_join_and_registry_drain():
+    r = run_rule("R25", """
+        import threading
+
+        class Pump:
+            def run_once(self):
+                t = threading.Thread(target=self._drain)
+                t.start()
+                t.join()
+
+            def spawn(self):
+                t = threading.Thread(target=self._drain)
+                self._threads.append(t)
+                t.start()
+
+            def close(self):
+                for t in self._threads:
+                    t.join()
+
+            def _drain(self):
+                pass
+    """)
+    assert not r.findings
+
+
+def test_r25_scoped_to_covered_dirs():
+    r = run_rule("R25", """
+        import threading
+
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._drain)
+                self._t.start()
+
+            def _drain(self):
+                pass
+    """, path="ytk_mp4j_tpu/models/snippet.py")
+    assert not r.findings
+
+
+def test_r25_inline_suppression():
+    r = run_rule("R25", """
+        import threading
+
+        class Pump:
+            def start(self):
+                # mp4j-lint: disable=R25 (process-lifetime collector)
+                self._t = threading.Thread(target=self._drain)
+                self._t.start()
+
+            def _drain(self):
+                pass
+    """)
+    assert not r.findings
+    assert any(f.rule == "R25" for f in r.suppressed)

@@ -62,6 +62,38 @@ def test_package_lock_order_graph_is_cycle_free():
             for scc in model.cycles()))
 
 
+def test_package_shared_field_locksets_clean_modulo_baseline():
+    """ISSUE 16 acceptance: the package's shared-field lockset report
+    is clean modulo the committed baseline — every mutable field
+    reachable from >= 2 thread roots either has a consistent lockset
+    or a reasoned R23 suppression naming why lock-free publication is
+    safe there (the cycle-free check's sibling for data races)."""
+    from ytk_mp4j_tpu.analysis import baseline as baseline_mod
+    from ytk_mp4j_tpu.analysis.engine import Engine, Program
+    from ytk_mp4j_tpu.analysis.rules import get_rules
+    contexts, errors = Engine(rules=[]).load_contexts([PKG_DIR])
+    assert not errors, errors
+    model = Program(contexts).races
+    # sanity: the model actually sees the package's concurrency
+    # (a refactor that silently blinds root discovery must fail loudly)
+    assert any(r.startswith("thread:") for r in model.roots), \
+        "no thread roots discovered — model blind?"
+    assert "main" in model.roots
+    shared = model.shared_fields()
+    assert len(shared) >= 10, "shared-field discovery collapsed"
+    displays = {fr.display for fr in shared}
+    assert "Master._slots" in displays
+    # the verdict: racy fields exist (the documented lock-free
+    # publication sites) but every one is baselined with a reason
+    bl = baseline_mod.load(DEFAULT_BASELINE)
+    result = Engine(rules=get_rules(["R23"]),
+                    baseline=bl).lint_paths([PKG_DIR])
+    assert result.ok, (
+        "shared field with inconsistent lockset (fix it or add a "
+        "reasoned R23 suppression):\n"
+        + "\n".join(f.format() for f in result.findings))
+
+
 def test_committed_baseline_exists_and_is_fully_used():
     assert os.path.exists(DEFAULT_BASELINE)
     from ytk_mp4j_tpu.analysis import baseline as baseline_mod

@@ -1,0 +1,503 @@
+"""ISSUE 16 — lockset race + resource-leak analysis tests.
+
+Covers the race model itself (thread-root discovery, canonical field
+identity, per-root lock contexts), the ``threading.Condition.wait``
+held-set satellite (a wait RELEASES the condition for its duration),
+the frozen-snippet regressions reproducing the true positives R23/R24
+found on the pre-PR tree (the sink drain-thread counters, the
+rendezvous channel leak), the ``mp4j-lint races`` CLI view, the SARIF
+2.1.0 export (validated against the vendored schema subset), and the
+engine's parsed-context/Program caching.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from ytk_mp4j_tpu.analysis import cli as cli_mod
+from ytk_mp4j_tpu.analysis.engine import Engine, Program
+from ytk_mp4j_tpu.analysis.report import (Finding, Severity,
+                                          render_sarif)
+from ytk_mp4j_tpu.analysis.rules import ALL_RULES, get_rules
+
+COMM_PATH = "ytk_mp4j_tpu/comm/snippet.py"
+
+SARIF_SCHEMA = os.path.join(
+    os.path.dirname(cli_mod.__file__), "sarif-2.1.0-subset.json")
+
+
+def run_rule(rule_id, src, path=COMM_PATH, baseline=None):
+    engine = Engine(rules=get_rules([rule_id]), baseline=baseline)
+    result = engine.lint_source(textwrap.dedent(src), path)
+    assert not [f for f in result.findings if f.rule == "E001"], \
+        f"snippet failed to parse: {result.findings}"
+    return result
+
+
+def program_of(src, path=COMM_PATH):
+    eng = Engine(rules=[])
+    ctx, errs = eng._parse(textwrap.dedent(src), path)
+    assert ctx is not None, errs
+    return Program([ctx])
+
+
+def _summary(model, display):
+    return next(s for s in model.summaries.values()
+                if s.func.display == display)
+
+
+# ----------------------------------------------------------------------
+# race model: roots, field identity, contexts
+# ----------------------------------------------------------------------
+def test_race_model_discovers_thread_timer_and_main_roots():
+    model = program_of("""
+        import threading
+
+        class Plane:
+            def __init__(self):
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+                threading.Timer(1.0, self._tick).start()
+
+            def _loop(self):
+                pass
+
+            def _tick(self):
+                pass
+
+            def status(self):
+                return self._probe()
+
+            def _probe(self):
+                return 1
+    """).races
+    assert "thread:Plane._loop" in model.roots
+    assert "thread:Plane._tick" in model.roots
+    # status is the public surface; _probe has an internal caller, so
+    # its only contexts come from status — and __init__ is no root
+    main = model.roots["main"]
+    assert any(k.endswith(":Plane.status") or k.endswith(".status")
+               for k in main)
+    assert not any("_probe" in k for k in main)
+
+
+def test_race_model_canonicalizes_base_class_fields():
+    model = program_of("""
+        import threading
+
+        class Base:
+            def __init__(self):
+                self.count = 0
+                t = threading.Thread(target=self._bump, daemon=True)
+                t.start()
+
+            def _bump(self):
+                self.count += 1
+
+        class Sub(Base):
+            def peek(self):
+                return self.count
+    """).races
+    shared = model.shared_fields()
+    assert [fr.display for fr in shared] == ["Base.count"]
+    assert sorted(shared[0].roots) == ["main", "thread:Base._bump"]
+
+
+def test_race_model_lock_context_propagates_along_call_graph():
+    # the write happens two calls below the lock acquisition: the
+    # per-root context fixpoint must still credit it with the lock
+    r = run_rule("R23", """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = "idle"
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                with self._lock:
+                    self._mid()
+
+            def _mid(self):
+                self._write()
+
+            def _write(self):
+                self.state = "running"
+
+            def status(self):
+                with self._lock:
+                    return self.state
+    """)
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# the Condition.wait satellite: wait() releases the lock
+# ----------------------------------------------------------------------
+def test_condition_wait_strips_lock_from_predicate_sites():
+    model = program_of("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._aux = threading.Lock()
+                self._cv = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._aux:
+                    with self._cv:
+                        self._cv.wait_for(lambda: self._items)
+                        return self._items
+    """).locks
+    s = _summary(model, "Q.get")
+    helds = []
+    for a in s.accesses:
+        if a.attr == "_items":
+            helds.append({model.locks[k].display for k in a.held})
+    # the predicate read lost _cv but kept _aux; the post-wait read
+    # holds both
+    assert {"Q._aux"} in helds
+    assert {"Q._aux", "Q._cv"} in helds
+
+
+def test_condition_wait_on_unheld_receiver_strips_nothing():
+    model = program_of("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._aux = threading.Lock()
+                self._cv = threading.Condition()
+                self._flag = False
+
+            def peek(self, other):
+                with self._aux:
+                    other.wait_for(lambda: self._flag)
+    """).locks
+    s = _summary(model, "Q.peek")
+    helds = [{model.locks[k].display for k in a.held}
+             for a in s.accesses if a.attr == "_flag"]
+    assert helds == [{"Q._aux"}]
+
+
+def test_r23_fires_on_wait_predicate_not_credited_with_condition():
+    """The satellite's point: a predicate evaluated inside
+    ``cv.wait_for`` must not be credited with the condition's lock —
+    crediting it would mask this R23 finding entirely."""
+    r = run_rule("R23", """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+                t = threading.Thread(target=self._fill, daemon=True)
+                t.start()
+
+            def _fill(self):
+                with self._cv:
+                    self._ready = True
+                    self._cv.notify_all()
+
+            def wait_ready(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self._ready)
+    """)
+    [f] = r.findings
+    assert f.rule == "R23" and "Pump._ready" in f.message
+    assert f.context == "Pump._fill"
+
+
+def test_r23_quiet_on_reads_after_wait_returns():
+    # only the predicate loses the lock: a read AFTER wait_for
+    # returns is back under the condition — no finding
+    r = run_rule("R23", """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+                t = threading.Thread(target=self._fill, daemon=True)
+                t.start()
+
+            def _fill(self):
+                with self._cv:
+                    self._ready = True
+                    self._cv.notify_all()
+
+            def wait_ready(self):
+                with self._cv:
+                    self._cv.wait_for(self._poll)
+                    return self._ready
+
+            def _poll(self):
+                return True
+    """)
+    assert not r.findings
+
+
+# ----------------------------------------------------------------------
+# frozen-snippet regressions: the pre-PR true positives
+# ----------------------------------------------------------------------
+def test_r23_frozen_pre_pr_sink_counter_race():
+    """Frozen pre-PR ``obs/sink.py`` shape: the drain thread bumped
+    ``dropped_records``/``last_error`` WITHOUT ``_io_lock`` while the
+    public ``status()`` read them — the first true positive R23 found
+    on the tree (fixed in this PR by taking ``_io_lock`` on both
+    sides)."""
+    r = run_rule("R23", """
+        import threading
+
+        class SinkWriter:
+            def __init__(self):
+                self._io_lock = threading.Lock()
+                self.dropped_records = 0
+                self.last_error = None
+                t = threading.Thread(target=self._drain, daemon=True)
+                t.start()
+
+            def _drain(self):
+                while True:
+                    try:
+                        self._flush()
+                    except Exception as e:
+                        self.dropped_records += 1
+                        self.last_error = repr(e)
+
+            def _flush(self):
+                with self._io_lock:
+                    pass
+
+            def status(self):
+                return {"dropped_records": self.dropped_records,
+                        "last_error": self.last_error}
+    """, path="ytk_mp4j_tpu/obs/sink_frozen.py")
+    fields = {f.message.split()[2] for f in r.findings}
+    assert f"{'SinkWriter'}.dropped_records" in fields
+    assert all(f.rule == "R23" and f.context == "SinkWriter._drain"
+               for f in r.findings)
+
+
+FROZEN_RENDEZVOUS = """
+class TcpChannel:
+    def __init__(self, sock):
+        self._sock = sock
+
+    def set_timeout(self, t):
+        self._sock.settimeout(t)
+
+    def recv(self):
+        return None, None
+
+    def close(self):
+        self._sock.close()
+
+
+def accept_pre_pr(server, deadline, now):
+    sock, addr = server.accept()
+    ch = TcpChannel(sock)
+    remaining = max(0.0, deadline - now)
+    ch.set_timeout(remaining)
+    kind, payload = ch.recv()
+    return ch
+
+
+def accept_post_pr(server, deadline, now):
+    remaining = max(0.0, deadline - now)
+    sock, addr = server.accept()
+    ch = TcpChannel(sock)
+    try:
+        ch.set_timeout(remaining)
+        kind, payload = ch.recv()
+    except Exception:
+        ch.close()
+        raise
+    return ch
+"""
+
+
+def test_r24_frozen_pre_pr_rendezvous_channel_leak(tmp_path):
+    """Frozen pre-PR ``comm/master.py`` rendezvous shape: deadline
+    arithmetic and ``set_timeout`` sat between wrapping the accepted
+    socket and any protection, so a slow/broken peer leaked the
+    channel — the true positive R24 found on the tree (fixed in this
+    PR by hoisting the arithmetic and closing in the handler)."""
+    p = tmp_path / "ytk_mp4j_tpu" / "transport" / "frozen.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(FROZEN_RENDEZVOUS)
+    result = Engine(rules=get_rules(["R24"])).lint_paths(
+        [str(tmp_path)])
+    leaks = [f for f in result.findings if f.rule == "R24"]
+    assert [f.context for f in leaks] == ["accept_pre_pr"]
+    assert "channel 'ch'" in leaks[0].message
+    # charged at the acquire (the TcpChannel wrap), not at the risk
+    assert "ch = TcpChannel(sock)" in \
+        FROZEN_RENDEZVOUS.splitlines()[leaks[0].line - 1]
+
+
+# ----------------------------------------------------------------------
+# mp4j-lint races — the concurrency-contract view
+# ----------------------------------------------------------------------
+RACY_PKG = """
+import threading
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "idle"
+        self.epoch = 0
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        self.state = "running"
+        with self._lock:
+            self.epoch += 1
+
+    def status(self):
+        with self._lock:
+            return (self.state, self.epoch)
+"""
+
+
+def _racy_tree(tmp_path):
+    p = tmp_path / "ytk_mp4j_tpu" / "comm" / "plane.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(RACY_PKG)
+    return str(tmp_path)
+
+
+def test_cli_races_text_reports_contract_and_race(tmp_path, capsys):
+    assert cli_mod.main(["races", _racy_tree(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "shared mutable fields" in out
+    # the field -> lock map: epoch is consistently under _lock,
+    # state is racy with the write witness named
+    assert "Plane.epoch" in out and "Plane._lock" in out
+    racy_lines = [ln for ln in out.splitlines()
+                  if "Plane.state" in ln and "RACE" in ln]
+    assert racy_lines
+    assert "write" in out and "Plane._loop" in out
+
+
+def test_cli_races_dot_output(tmp_path, capsys):
+    assert cli_mod.main(["races", "--dot",
+                         _racy_tree(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("digraph")
+    assert "color=red" in out          # the racy field
+    assert "Plane.epoch" in out
+
+
+def test_cli_races_output_file(tmp_path, capsys):
+    dst = tmp_path / "races.dot"
+    assert cli_mod.main(["races", "--dot", "-o", str(dst),
+                         _racy_tree(tmp_path)]) == 0
+    assert dst.read_text().startswith("digraph")
+
+
+# ----------------------------------------------------------------------
+# SARIF 2.1.0 export
+# ----------------------------------------------------------------------
+def _validate_sarif(doc):
+    jsonschema = pytest.importorskip("jsonschema")
+    with open(SARIF_SCHEMA, encoding="utf-8") as fh:
+        schema = json.load(fh)
+    jsonschema.validate(doc, schema)
+
+
+def test_sarif_document_is_schema_valid():
+    findings = [
+        Finding("R23", Severity.ERROR,
+                "ytk_mp4j_tpu/comm/plane.py", 13, 1,
+                "shared field Plane.state has inconsistent locksets",
+                context="Plane._loop"),
+        Finding("E001", Severity.ERROR,
+                "ytk_mp4j_tpu/comm/broken.py", 0, 0,
+                "syntax error"),   # no catalogue entry -> no ruleIndex
+    ]
+    doc = json.loads(render_sarif(findings, ALL_RULES))
+    _validate_sarif(doc)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mp4j-lint"
+    assert len(run["tool"]["driver"]["rules"]) == len(ALL_RULES)
+    r23, e001 = run["results"]
+    assert r23["ruleId"] == "R23" and r23["level"] == "error"
+    idx = r23["ruleIndex"]
+    assert run["tool"]["driver"]["rules"][idx]["id"] == "R23"
+    assert r23["partialFingerprints"]["mp4jContext/v1"] == "Plane._loop"
+    loc = r23["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 13
+    # 0-based engine cols clamp to SARIF's 1-based minimum
+    assert e001["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 1
+    assert "ruleIndex" not in e001
+
+
+def test_sarif_empty_run_still_carries_catalogue():
+    doc = json.loads(render_sarif([], ALL_RULES))
+    _validate_sarif(doc)
+    assert doc["runs"][0]["results"] == []
+    assert doc["runs"][0]["tool"]["driver"]["rules"]
+
+
+def test_cli_sarif_writes_validated_log(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(c):\n    if c.rank:\n        c.barrier()\n")
+    out = tmp_path / "lint.sarif"
+    assert cli_mod.main([str(bad), "--sarif", str(out)]) == 1
+    doc = json.loads(out.read_text())
+    _validate_sarif(doc)
+    results = doc["runs"][0]["results"]
+    assert any(r["ruleId"] == "R1" for r in results)
+    # --select narrows the embedded catalogue with the run
+    out2 = tmp_path / "lint2.sarif"
+    assert cli_mod.main([str(bad), "--select", "R2",
+                         "--sarif", str(out2)]) == 0
+    doc2 = json.loads(out2.read_text())
+    _validate_sarif(doc2)
+    assert [r["id"] for r in
+            doc2["runs"][0]["tool"]["driver"]["rules"]] == ["R2"]
+    assert doc2["runs"][0]["results"] == []
+
+
+# ----------------------------------------------------------------------
+# engine caching: parsed contexts + Program reuse (ISSUE 16 satellite)
+# ----------------------------------------------------------------------
+def test_engine_caches_contexts_and_program_across_runs(tmp_path):
+    tree = _racy_tree(tmp_path)
+    Engine.clear_caches()
+    try:
+        eng = Engine(rules=get_rules(["R23"]))
+        r1 = eng.lint_paths([tree])
+        ctx1 = Engine._context_cache[
+            next(iter(Engine._context_cache))][1]
+        progs1 = list(Program._cache.values())
+        assert len(progs1) == 1
+        # same-process second run (the strict gate then the rule
+        # tests): parsed module index and Program come from cache
+        r2 = Engine(rules=get_rules(["R23"])).lint_paths([tree])
+        ctx2 = Engine._context_cache[
+            next(iter(Engine._context_cache))][1]
+        assert ctx1 is ctx2
+        assert list(Program._cache.values()) == progs1
+        assert [f.format() for f in r1.findings] == \
+            [f.format() for f in r2.findings]
+        # an edit invalidates: the context signature changes
+        p = tmp_path / "ytk_mp4j_tpu" / "comm" / "plane.py"
+        p.write_text(RACY_PKG + "\n# touched\n")
+        os.utime(p, ns=(1, 1))   # force a distinct (mtime, size) sig
+        Engine(rules=get_rules(["R23"])).lint_paths([tree])
+        ctx3 = Engine._context_cache[
+            next(iter(Engine._context_cache))][1]
+        assert ctx3 is not ctx1
+        assert len(Program._cache) == 2
+    finally:
+        Engine.clear_caches()

@@ -606,6 +606,25 @@ def test_bench_diff_flags_regression(tmp_path, capsys):
                        "--threshold", "60"]) == 0
 
 
+def test_bench_diff_gates_lint_v3_ratio_growth(tmp_path, capsys):
+    """ISSUE 16: the lint v3-over-v2 runtime ratio is a tracked
+    LOWER_IS_BETTER row — growth past its budget between bench rounds
+    is a regression (the absolute <= 1.5x budget is a tier-1 assert)."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({
+        "metric": "x", "value": 10.0,
+        "extra": {"lint_v3_over_v2_ratio": 1.2}}))
+    new.write_text(json.dumps({
+        "metric": "x", "value": 10.0,
+        "extra": {"lint_v3_over_v2_ratio": 2.5}}))
+    assert scope_main(["bench-diff", str(old), str(new)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "lint_v3_over_v2_ratio" in out
+    # same ratio both rounds: within budget
+    assert scope_main(["bench-diff", str(old), str(old)]) == 0
+
+
 def test_bench_diff_rejects_non_bench_document(tmp_path):
     bad = tmp_path / "bad.json"
     bad.write_text(json.dumps({"whatever": 1}))

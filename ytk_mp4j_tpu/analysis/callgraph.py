@@ -129,6 +129,10 @@ class ProgramIndex:
         self.modules: dict[str, ModuleInfo] = {}
         self.functions: dict[str, FunctionInfo] = {}
         self.classes: dict[str, ClassInfo] = {}
+        # suffix -> resolved module: _module_by_suffix scans the whole
+        # module table per miss, and the same few dotted names resolve
+        # thousands of times across the race/resource passes
+        self._suffix_cache: dict[str, "ModuleInfo | None"] = {}
         for ctx in contexts:
             self._index_module(ctx)
         self._classes_ci = self._build_ci_table()
@@ -229,11 +233,16 @@ class ProgramIndex:
     def _module_by_suffix(self, dotted: str) -> ModuleInfo | None:
         if dotted in self.modules:
             return self.modules[dotted]
+        if dotted in self._suffix_cache:
+            return self._suffix_cache[dotted]
+        out = None
         for name, m in self.modules.items():
             if name.endswith("." + dotted.rsplit(".", 1)[-1]) \
                     and (name == dotted or name.endswith("." + dotted)):
-                return m
-        return None
+                out = m
+                break
+        self._suffix_cache[dotted] = out
+        return out
 
     def _imported_module(self, mod: ModuleInfo,
                          alias: str) -> ModuleInfo | None:

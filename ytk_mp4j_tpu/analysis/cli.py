@@ -8,6 +8,8 @@ Usage::
     mp4j-lint --strict                # stale baseline entries are findings
     mp4j-lint --prune-baseline        # rewrite the baseline minus stale rows
     mp4j-lint graph --dot             # the discovered lock-order graph
+    mp4j-lint races [--dot]           # the shared-field -> lockset map
+    mp4j-lint --sarif out.sarif       # SARIF 2.1.0 log for CI viewers
     python -m ytk_mp4j_tpu.analysis ytk_mp4j_tpu/
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 bad invocation or
@@ -25,7 +27,8 @@ import textwrap
 
 from ytk_mp4j_tpu.analysis import baseline as baseline_mod
 from ytk_mp4j_tpu.analysis.engine import Engine, Program, ProgramRule
-from ytk_mp4j_tpu.analysis.report import render_json, render_text
+from ytk_mp4j_tpu.analysis.report import (render_json, render_sarif,
+                                          render_text)
 from ytk_mp4j_tpu.analysis.rules import ALL_RULES, RULES_BY_ID, get_rules
 from ytk_mp4j_tpu.exceptions import Mp4jError
 
@@ -59,11 +62,30 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--prune-baseline", action="store_true",
                     help="rewrite the baseline file keeping only the "
                          "entries that still match a finding")
+    ap.add_argument("--sarif", metavar="OUT.sarif", default=None,
+                    help="also write the findings as a SARIF 2.1.0 "
+                         "log to OUT.sarif (for CI annotation viewers)")
     ap.add_argument("--explain", metavar="RN", default=None,
                     help="print one rule's catalogue entry and a "
                          "firing example, then exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    return ap
+
+
+def _build_races_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mp4j-lint races",
+        description=("dump the shared-field -> lockset map discovered "
+                     "by the R23 lockset analysis: every mutable field "
+                     "reachable from >= 2 thread roots, which locks "
+                     "its access sites hold (and how consistently), "
+                     "and a witness pair for each inconsistency"))
+    ap.add_argument("paths", nargs="*", default=["ytk_mp4j_tpu"])
+    ap.add_argument("--dot", action="store_true",
+                    help="GraphViz DOT output (default: text report)")
+    ap.add_argument("-o", "--output", default=None, metavar="FILE",
+                    help="write to FILE instead of stdout")
     return ap
 
 
@@ -115,16 +137,44 @@ def _explain(rule_id: str) -> int:
     return 0
 
 
-def _graph_main(argv) -> int:
-    args = _build_graph_parser().parse_args(argv)
-    contexts, errors = Engine(rules=[]).load_contexts(args.paths)
+def _load_program(paths, prog: str):
+    contexts, errors = Engine(rules=[]).load_contexts(paths)
     for f in errors:
-        print(f"mp4j-lint graph: skipped {f.path}: {f.message}",
+        print(f"mp4j-lint {prog}: skipped {f.path}: {f.message}",
               file=sys.stderr)
     if not contexts:
-        print("mp4j-lint graph: no parsable files", file=sys.stderr)
+        print(f"mp4j-lint {prog}: no parsable files", file=sys.stderr)
+        return None
+    return Program(contexts)
+
+
+def _emit(out: str, output: str | None) -> None:
+    if output:
+        tmp = output + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(out + "\n")
+        os.replace(tmp, output)
+        print(f"mp4j-lint: wrote {output}")
+    else:
+        print(out)
+
+
+def _races_main(argv) -> int:
+    args = _build_races_parser().parse_args(argv)
+    program = _load_program(args.paths, "races")
+    if program is None:
         return 2
-    model = Program(contexts).locks
+    model = program.races
+    _emit(model.to_dot() if args.dot else model.to_text(), args.output)
+    return 0
+
+
+def _graph_main(argv) -> int:
+    args = _build_graph_parser().parse_args(argv)
+    program = _load_program(args.paths, "graph")
+    if program is None:
+        return 2
+    model = program.locks
     if args.dot:
         out = model.to_dot()
     else:
@@ -136,14 +186,7 @@ def _graph_main(argv) -> int:
             lines.append("  CYCLE: " + " <-> ".join(
                 model.locks[k].display for k in scc))
         out = "\n".join(lines)
-    if args.output:
-        tmp = args.output + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            fh.write(out + "\n")
-        os.replace(tmp, args.output)
-        print(f"mp4j-lint: wrote {args.output}")
-    else:
-        print(out)
+    _emit(out, args.output)
     return 0
 
 
@@ -171,6 +214,8 @@ def main(argv=None) -> int:
         argv = sys.argv[1:]
     if argv and argv[0] == "graph":
         return _graph_main(argv[1:])
+    if argv and argv[0] == "races":
+        return _races_main(argv[1:])
     args = _build_parser().parse_args(argv)
 
     if args.list_rules:
@@ -242,6 +287,16 @@ def main(argv=None) -> int:
               f"{'y' if stale == 1 else 'ies'}, kept {len(kept)} "
               f"in {args.baseline}")
         return 0
+
+    if args.sarif:
+        sarif = render_sarif(
+            result.findings, [type(r) for r in rules])
+        tmp = args.sarif + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(sarif + "\n")
+        os.replace(tmp, args.sarif)
+        print(f"mp4j-lint: wrote SARIF log {args.sarif}",
+              file=sys.stderr)
 
     if args.format == "json" or args.json:
         print(render_json(result.findings, len(result.suppressed)))

@@ -19,6 +19,7 @@ Suppression comes in two layers:
 from __future__ import annotations
 
 import ast
+import collections
 import dataclasses
 import os
 import re
@@ -188,13 +189,40 @@ class ProgramRule:
 
 
 class Program:
-    """The parsed path set seen whole; index and lock model are built
-    lazily and shared by every ProgramRule of one engine run."""
+    """The parsed path set seen whole; index, lock model, race model
+    and resource model are built lazily and shared by every
+    ProgramRule of one engine run — and, via :meth:`shared`, across
+    SAME-PROCESS runs over identical sources (the tier-1 strict gate
+    and the rule tests used to re-parse the package per run)."""
+
+    # content-keyed cache of whole programs; tiny LRU — the tier-1
+    # gate plus a handful of snippet programs is the working set
+    _cache: "collections.OrderedDict[tuple, Program]" = \
+        collections.OrderedDict()
+    _cache_max = 4
 
     def __init__(self, contexts: list[LintContext]):
         self.contexts = contexts
         self._index = None
         self._locks = None
+        self._races = None
+        self._resources = None
+
+    @classmethod
+    def shared(cls, contexts: list[LintContext]) -> "Program":
+        """The cached Program for this exact (path, source) set.
+        Safe because Programs are read-only after construction and
+        contexts are invalidated upstream when file content changes."""
+        key = tuple((c.path, hash(c.source)) for c in contexts)
+        prog = cls._cache.get(key)
+        if prog is None:
+            prog = cls(contexts)
+            cls._cache[key] = prog
+            while len(cls._cache) > cls._cache_max:
+                cls._cache.popitem(last=False)
+        else:
+            cls._cache.move_to_end(key)
+        return prog
 
     @property
     def index(self):
@@ -209,6 +237,20 @@ class Program:
             from ytk_mp4j_tpu.analysis.locks import LockModel
             self._locks = LockModel(self.index)
         return self._locks
+
+    @property
+    def races(self):
+        if self._races is None:
+            from ytk_mp4j_tpu.analysis.races import RaceModel
+            self._races = RaceModel(self.index, self.locks)
+        return self._races
+
+    @property
+    def resources(self):
+        if self._resources is None:
+            from ytk_mp4j_tpu.analysis.resources import ResourceModel
+            self._resources = ResourceModel(self.index)
+        return self._resources
 
 
 @dataclasses.dataclass
@@ -237,6 +279,19 @@ class Engine:
     mode only makes sense when linting the full path set the baseline
     was written against (the tier-1 gate); single-file invocations
     leave it off."""
+
+    # path -> ((mtime_ns, size), LintContext): parsing + suppression
+    # scanning is the dominant per-run cost and file content is stable
+    # within a test session — contexts are reused until the file's
+    # stat signature moves (ISSUE 16)
+    _context_cache: dict[str, tuple[tuple, "LintContext"]] = {}
+
+    @classmethod
+    def clear_caches(cls) -> None:
+        """Drop the parsed-context and Program caches (benchmarks
+        measuring a cold run, tests mutating files in place)."""
+        cls._context_cache.clear()
+        Program._cache.clear()
 
     def __init__(self, rules=None, baseline=None,
                  strict_baseline: bool = False,
@@ -324,13 +379,21 @@ class Engine:
     # -- internals ------------------------------------------------------
     def _load(self, path: str):
         try:
+            st = os.stat(path)
+            sig = (st.st_mtime_ns, st.st_size)
+            cached = Engine._context_cache.get(path)
+            if cached is not None and cached[0] == sig:
+                return cached[1], []
             with open(path, encoding="utf-8") as fh:
                 source = fh.read()
         except OSError as e:
             return None, [Finding(
                 "E001", Severity.ERROR, path.replace(os.sep, "/"),
                 0, 1, f"cannot read file: {e}")]
-        return self._parse(source, path)
+        ctx, errs = self._parse(source, path)
+        if ctx is not None:
+            Engine._context_cache[path] = (sig, ctx)
+        return ctx, errs
 
     def _parse(self, source: str, path: str):
         display = path.replace(os.sep, "/")
@@ -371,7 +434,7 @@ class Engine:
     def _run_program_rules(self, contexts) -> LintResult:
         if not self.program_rules or not contexts:
             return LintResult([], [])
-        program = Program(contexts)
+        program = Program.shared(contexts)
         raw: list[Finding] = []
         for rule in self.program_rules:
             raw.extend(rule.run_program(program))

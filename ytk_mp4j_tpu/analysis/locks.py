@@ -62,6 +62,23 @@ _SUBPROCESS_BLOCKERS = {"run", "check_call", "check_output", "call",
                         "communicate"}
 _THREADISH = ("thread", "proc", "worker", "drain", "heartbeat")
 
+# -- R23 access vocabulary ---------------------------------------------
+# attribute types that are internally synchronized (or are themselves
+# the synchronization): accessing the OBJECT is safe, so these never
+# become shared-field access events — the data they guard does.
+_SYNC_TYPES = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.Thread",
+    "queue.Queue",
+})
+# container verbs that MUTATE their receiver: `self._peers.pop(r)` is
+# a write to `_peers`, not a read
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "sort",
+    "reverse", "update", "setdefault", "add", "discard", "popitem",
+    "appendleft", "popleft",
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class LockDecl:
@@ -116,12 +133,26 @@ class HookEvent:
 
 
 @dataclasses.dataclass
+class AccessEvent:
+    """One read/write of an instance attribute of an index class, with
+    the locks held at the site (ISSUE 16's lockset substrate)."""
+
+    owner: str                   # receiver's ClassInfo key ("mod:Cls")
+    attr: str
+    write: bool
+    held: tuple[str, ...]
+    lineno: int
+
+
+@dataclasses.dataclass
 class Summary:
     func: FunctionInfo
     acquires: list[AcqEvent] = dataclasses.field(default_factory=list)
     calls: list[CallEvent] = dataclasses.field(default_factory=list)
     blockers: list[BlockEvent] = dataclasses.field(default_factory=list)
     hooks: list[HookEvent] = dataclasses.field(default_factory=list)
+    accesses: list[AccessEvent] = dataclasses.field(
+        default_factory=list)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,10 +240,21 @@ class _FuncWalker:
         if isinstance(node, ast.Assign):
             self._expr(node.value, held)
             self._track_assign(node)
+            for tgt in node.targets:
+                self._assign_target(tgt, held)
+            return held
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value, held)
+            self._assign_target(node.target, held)
+            return held
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._assign_target(tgt, held)
             return held
         if isinstance(node, ast.AnnAssign):
             if node.value is not None:
                 self._expr(node.value, held)
+                self._assign_target(node.target, held)
             return held
         if isinstance(node, ast.Expr):
             # statement-level acquire()/release() adjusts the linear
@@ -299,12 +341,137 @@ class _FuncWalker:
 
     # -- expression traversal ------------------------------------------
     def _expr(self, expr, held) -> None:
-        for node in ast.walk(expr):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-                continue
-            if isinstance(node, ast.Call):
-                self._call(node, held)
+        """Recursive expression walk: classify calls, and record every
+        resolvable attribute read/write with the held-lock set. A
+        ``wait()``/``wait_for()`` on a HELD condition RELEASES it for
+        the duration, so its argument expressions (predicates, lambda
+        bodies) are walked with the condition's lock removed — a site
+        reached from inside the wait must not be credited with a lock
+        the wait gave up (ISSUE 16)."""
+        if expr is None:
+            return
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(expr, ast.Lambda):
+            self._expr(expr.body, held)
+            return
+        if isinstance(expr, ast.Call):
+            self._call(expr, held)
+            arg_held = self._wait_arg_held(expr, held)
+            f = expr.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in _MUTATORS \
+                        and isinstance(f.value, ast.Attribute) \
+                        and not self._user_method(f.value, f.attr):
+                    self._access(f.value, held, write=True)
+                    self._expr(f.value.value, held)
+                else:
+                    self._expr(f.value, held)
+            elif not isinstance(f, ast.Name):
+                self._expr(f, held)
+            for a in expr.args:
+                self._expr(a, arg_held)
+            for kw in expr.keywords:
+                self._expr(kw.value, arg_held)
+            return
+        if isinstance(expr, ast.Attribute):
+            self._access(expr, held, write=False)
+            self._expr(expr.value, held)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, held)
+            elif isinstance(child, ast.AST):
+                self._expr(child, held)
+
+    def _assign_target(self, tgt, held) -> None:
+        """Record write accesses for assignment/del targets: attribute
+        stores, and subscript stores into an attribute container."""
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._assign_target(e, held)
+        elif isinstance(tgt, ast.Starred):
+            self._assign_target(tgt.value, held)
+        elif isinstance(tgt, ast.Subscript):
+            self._expr(tgt.slice, held)
+            base = tgt.value
+            while isinstance(base, ast.Subscript):
+                self._expr(base.slice, held)
+                base = base.value
+            if isinstance(base, ast.Attribute):
+                self._access(base, held, write=True)
+        elif isinstance(tgt, ast.Attribute):
+            self._access(tgt, held, write=True)
+            self._expr(tgt.value, held)
+
+    def _access(self, node, held, write: bool) -> None:
+        """One attribute read/write, filtered down to what the lockset
+        analysis can reason about: instance fields of INDEX classes.
+        Locks themselves, internally-synchronized objects (events,
+        queues, threads) and bound-method references are not data."""
+        if not isinstance(node, ast.Attribute):
+            return
+        attr = node.attr
+        if attr.startswith("__"):
+            return
+        if self._resolve_lock(node) is not None:
+            return
+        owner = self._expr_type(node.value)
+        if not owner or ":" not in owner \
+                or owner.startswith(("list:", "dict:")):
+            return
+        oci = self.index.classes.get(owner)
+        if oci is None:
+            return
+        at = self.index.attr_type(oci, attr)
+        if at in _SYNC_TYPES:
+            return
+        if self.index.lookup_method(oci, attr) is not None:
+            return
+        self.out.accesses.append(AccessEvent(
+            owner, attr, write, held, node.lineno))
+
+    def _user_method(self, receiver: ast.Attribute, name: str) -> bool:
+        """True when ``receiver.name(...)`` resolves to a method a
+        class in the index DEFINES: then the call is tracked through
+        the call graph (the callee's own accesses carry the locksets)
+        and the container-verb heuristic must not also charge the
+        receiver field with a write — ``stats.add(...)`` mutates
+        *inside* ``CommStats.add``, it does not rebind ``stats``."""
+        owner = self._expr_type(receiver)
+        if not owner or ":" not in owner:
+            return False
+        oci = self.index.classes.get(owner)
+        if oci is None:
+            return False
+        return self.index.lookup_method(oci, name) is not None
+
+    def _wait_arg_held(self, call: ast.Call, held):
+        """Held set for a call's ARGUMENT expressions: minus the
+        receiver condition for ``wait``/``wait_for`` on a held lock."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in ("wait",
+                                                       "wait_for"):
+            lk = self._resolve_lock(f.value)
+            if lk is not None and lk in held:
+                return tuple(h for h in held if h != lk)
+        return held
+
+    def _resolve_func_ref(self, expr) -> list[FunctionInfo]:
+        """A bare function/bound-method REFERENCE (not a call):
+        ``self._drained`` / ``check_fn`` -> FunctionInfo candidates."""
+        ch = attr_chain(expr)
+        if not ch:
+            return []
+        if len(ch) == 1:
+            fi = self.func.module.functions.get(ch[0])
+            return [fi] if fi is not None else []
+        owner = self.index._owner_class(ch[:-1], self.func,
+                                        self.local_types)
+        if owner is not None:
+            fi = self.index.lookup_method(owner, ch[-1])
+            return [fi] if fi is not None else []
+        return []
 
     def _call(self, call: ast.Call, held) -> None:
         name = None
@@ -325,6 +492,21 @@ class _FuncWalker:
                         AcqEvent(lk, held, call.lineno))
             return
         self._classify_blocking(call, name, held)
+        if name == "wait_for" and call.args \
+                and isinstance(call.func, ast.Attribute):
+            # the predicate runs INSIDE the wait, i.e. with the
+            # condition's lock re-acquired around each evaluation but
+            # released between them — model the call edge with the
+            # condition removed from the held set so real R23 findings
+            # under the predicate are not masked by a false "held"
+            lk = self._resolve_lock(call.func.value)
+            inner = tuple(h for h in held if h != lk) \
+                if lk is not None else held
+            preds = self._resolve_func_ref(call.args[0])
+            if preds:
+                self.out.calls.append(CallEvent(
+                    tuple(fi.key for fi in preds), inner, call.lineno,
+                    preds[0].name))
         if _is_hookish(name):
             self.out.hooks.append(HookEvent(name, held, call.lineno))
         callees = self.index.resolve_call(call, self.func,

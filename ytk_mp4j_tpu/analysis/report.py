@@ -59,3 +59,71 @@ def render_json(findings, suppressed_count: int = 0) -> str:
         "findings": [f.to_dict() for f in findings],
         "suppressed": suppressed_count,
     }, indent=2, sort_keys=True)
+
+
+# SARIF severity levels for the three Severity tiers
+_SARIF_LEVEL = {Severity.INFO: "note",
+                Severity.WARNING: "warning",
+                Severity.ERROR: "error"}
+
+_SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                     "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_sarif(findings, rule_classes=()) -> str:
+    """The findings as a SARIF 2.1.0 log (one run, driver mp4j-lint).
+
+    ``rule_classes`` is the rule catalogue that RAN (not just the rules
+    that fired): SARIF viewers use ``tool.driver.rules`` to render the
+    catalogue, and an empty-results log should still carry it so "0
+    findings" is distinguishable from "0 rules ran". ``ruleIndex`` on
+    each result points into that array. The scope qualname the baseline
+    keys on travels as a partial fingerprint, so result identity
+    survives line drift exactly like baseline matching does.
+    """
+    rules = []
+    index: dict[str, int] = {}
+    for cls in rule_classes:
+        index[cls.rule_id] = len(rules)
+        rules.append({
+            "id": cls.rule_id,
+            "name": cls.title.title().replace(" ", "").replace("-", ""),
+            "shortDescription": {"text": cls.title},
+            "fullDescription": {"text": cls.description},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL[cls.severity]},
+        })
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": max(f.col, 1)},
+                },
+            }],
+            "partialFingerprints": {"mp4jContext/v1": f.context},
+        }
+        if f.rule in index:
+            res["ruleIndex"] = index[f.rule]
+        results.append(res)
+    return json.dumps({
+        "$schema": _SARIF_SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mp4j-lint",
+                "informationUri":
+                    "https://github.com/ytk-mp4j/ytk-mp4j-tpu",
+                "rules": rules,
+            }},
+            "columnKind": "utf16CodeUnits",
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }, indent=2, sort_keys=True)

@@ -24,10 +24,14 @@
 | R20 | error   | blocking call under a held lock (whole-program) |
 | R21 | error   | callback/dispatch under the minting lock (whole-program) |
 | R22 | error   | transport-decision size literal outside tuning/tuner |
+| R23 | error   | inconsistent lockset on a shared field (whole-program) |
+| R24 | error   | resource leaked on an exception path (whole-program) |
+| R25 | error   | thread started without join/daemon/stop (whole-program) |
 
-R19-R21 are :class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule`
-instances: they run once over the whole indexed path set (call graph
-+ lock model) instead of file by file.
+R19-R21 and R23-R25 are
+:class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule` instances: they
+run once over the whole indexed path set (call graph + lock model +
+race/resource models) instead of file by file.
 """
 
 from __future__ import annotations
@@ -70,6 +74,11 @@ from ytk_mp4j_tpu.analysis.rules.r20_blocking_under_lock import (
 from ytk_mp4j_tpu.analysis.rules.r21_callback_under_lock import (
     R21CallbackUnderLock)
 from ytk_mp4j_tpu.analysis.rules.r22_knob_literal import R22KnobLiteral
+from ytk_mp4j_tpu.analysis.rules.r23_lockset_race import R23LocksetRace
+from ytk_mp4j_tpu.analysis.rules.r24_resource_leak import (
+    R24ResourceLeak)
+from ytk_mp4j_tpu.analysis.rules.r25_thread_lifecycle import (
+    R25ThreadLifecycle)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -94,6 +103,9 @@ ALL_RULES = [
     R20BlockingUnderLock,
     R21CallbackUnderLock,
     R22KnobLiteral,
+    R23LocksetRace,
+    R24ResourceLeak,
+    R25ThreadLifecycle,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

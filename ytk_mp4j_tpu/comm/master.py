@@ -478,8 +478,10 @@ class Master:
             # unadopted spares idle in a blocking recv: release them
             # so their constructors raise Mp4jSpareReleased instead of
             # waiting out a timeout against a finished job
+            with self._lock:
+                fatal_msg = self._fatal_msg
             self._release_spares(
-                self._fatal_msg or "job completed without adopting "
+                fatal_msg or "job completed without adopting "
                 "this spare")
         if watchdog is not None:
             watchdog.join(2.0)
@@ -489,9 +491,12 @@ class Master:
         # flight-recorder manifest with the FINAL table (the slaves'
         # fatal-path telemetry flushes landed after the fan-out-time
         # write) and stops the endpoint
-        codes = [self._exit_codes.get(r, 1) for r in range(self.slave_num)]
-        self.final_code = max(codes) if codes else 0
-        return self.final_code
+        with self._lock:
+            codes = [self._exit_codes.get(r, 1)
+                     for r in range(self.slave_num)]
+            final = max(codes) if codes else 0
+            self.final_code = final
+        return final
 
     def serve_in_thread(self) -> "Master":
         self._thread = threading.Thread(target=self.serve, daemon=True,
@@ -516,23 +521,20 @@ class Master:
                     else time.monotonic() + self.timeout)
         pending = []  # (channel, (host, listen_port, fp))
         self._server.settimeout(1.0)
-        while (len(pending) < self.slave_num
-               or len(self._spare_pool) < self._spares_expected):
+        while True:
+            with self._lock:
+                pooled = len(self._spare_pool)
+            if (len(pending) >= self.slave_num
+                    and pooled >= self._spares_expected):
+                break
             if deadline is not None and time.monotonic() > deadline:
                 got = [hp for _, hp in pending]
                 raise Mp4jError(
                     f"rendezvous timeout: {len(pending)}/{self.slave_num} "
-                    f"slaves and {len(self._spare_pool)}/"
+                    f"slaves and {pooled}/"
                     f"{self._spares_expected} spares registered (heard "
                     f"from: {got or 'none'} — the missing slaves never "
                     "dialed in)")
-            try:
-                sock, addr = self._server.accept()
-            except socket.timeout:
-                continue
-            # sanctioned channel-construction site: rendezvous wraps
-            # the just-accepted control connection (R12 baseline)
-            ch = TcpChannel(sock)
             # bound the registration handshake: a stray connection that
             # never sends must neither hang rendezvous (no timeout) nor
             # consume the whole budget while real slaves queue behind it
@@ -540,8 +542,15 @@ class Master:
                          else max(0.1, deadline - time.monotonic()))
             bounds = [t for t in (remaining, self.handshake_timeout)
                       if t is not None]
-            ch.set_timeout(min(bounds) if bounds else None)
             try:
+                sock, addr = self._server.accept()
+            except socket.timeout:
+                continue
+            # sanctioned channel-construction site: rendezvous wraps
+            # the just-accepted control connection (R12 baseline)
+            ch = TcpChannel(sock)
+            try:
+                ch.set_timeout(min(bounds) if bounds else None)
                 # anything a hostile/broken dial-in can do — reset,
                 # garbage frame, non-tuple payload, malformed REGISTER
                 # body, timeout — must not kill rendezvous for the
@@ -572,11 +581,16 @@ class Master:
                 continue
             pending.append((ch, (host, listen_port, fp)))
         roster = [hp for _, hp in pending]
-        self._roster = roster
+        slots = [_Slot(rank, ch) for rank, (ch, _) in enumerate(pending)]
+        # publish table + slots under the lock (the autoscaler and
+        # spare-accept threads are already running); the handshake
+        # sends stay OUTSIDE it — send_obj blocks on the peer
+        with self._lock:
+            self._roster = roster
+            self._slots.extend(slots)
         for rank, (ch, _) in enumerate(pending):
             ch.send_obj({"rank": rank, "roster": roster,
                          "job": self.job_id})
-            self._slots.append(_Slot(rank, ch))
 
     def _serve_slave(self, slot: _Slot):
         ch = slot.ch
@@ -686,8 +700,10 @@ class Master:
         """Push one control message to a slave; a rank that dies while
         we push is marked departed, never crashes a serve thread."""
         try:
-            with self._slots[rank].lock:
-                self._slots[rank].ch.send_obj(obj)
+            with self._lock:
+                slot = self._slots[rank]
+            with slot.lock:
+                slot.ch.send_obj(obj)
         except (Mp4jError, OSError):
             self._mark_departed(rank, "unreachable on push")
 
@@ -1090,9 +1106,11 @@ class Master:
                                  f"grow round aborted: {reason}"))
             except (Mp4jError, OSError):
                 pass
-            if 0 <= r < len(self._slots) \
-                    and self._slots[r] is not None:
-                self._slots[r].dead = True
+        with self._lock:
+            for r in victims:
+                if 0 <= r < len(self._slots) \
+                        and self._slots[r] is not None:
+                    self._slots[r].dead = True
         for r in ranks:
             self._send_to(r, ("resize_go", gen, None))
         self._check_resize_complete()
@@ -1140,7 +1158,9 @@ class Master:
         # a death outranks an in-flight grow: its joiners were seeded
         # at an epoch this round is about to retire — roll the grow
         # back before the membership round claims the spare pool
-        if self._grow_state is not None and dead:
+        with self._lock:
+            grow_pending = self._grow_state is not None
+        if grow_pending and dead:
             self._abort_grow(
                 f"membership round opened (rank(s) {sorted(dead)} "
                 "dead)")
@@ -1868,8 +1888,8 @@ class Master:
             except OSError:
                 return          # listener closed with serve()
             ch = TcpChannel(sock)
-            ch.set_timeout(self.handshake_timeout)
             try:
+                ch.set_timeout(self.handshake_timeout)
                 kind, payload = ch.recv()
                 ok = (kind == REGISTER and isinstance(payload, dict)
                       and bool(payload.get("spare")))
@@ -1996,6 +2016,7 @@ class Master:
                     and gs["pending"].get(r) is rec):
                 del gs["pending"][r]
                 retry_grow = True
+            round_why = self._round_why
         self._log("M", "WARN", f"warm spare #{rec.idx} lost: {why}")
         try:
             rec.ch.close()
@@ -2004,7 +2025,7 @@ class Master:
         if retry:
             # re-enter through _begin_membership so the no-spare path
             # produces the same clean fatal as never having had one
-            self._begin_membership({}, self._round_why or
+            self._begin_membership({}, round_why or
                                    f"spare #{rec.idx} died mid-adoption")
             self._try_advance_round()
         elif retry_evict:

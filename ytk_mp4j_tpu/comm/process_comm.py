@@ -428,6 +428,16 @@ class ProcessCommSlave(CommSlave):
         # heartbeat thread interleaving frame bytes with a barrier or
         # log send would corrupt the control plane
         self._master_lock = threading.Lock()
+        # heartbeat delta state (ISSUE 6): the last stats/metrics
+        # snapshots shipped to the master, so every beat carries only
+        # what changed since. One lock serializes the heartbeat
+        # thread, the DIAGNOSE hook and close's final flush; it NEVER
+        # nests inside _master_lock (deadlock discipline: payload
+        # first, then send). Created before _sync_identity — the rank
+        # mirror publishes under it.
+        self._tel_lock = threading.Lock()
+        self._tel_last_stats: dict = {}
+        self._tel_last_metrics: dict = {}
         self._sync_identity()
 
         # peer channels: canonical rule — the HIGHER rank connects to the
@@ -453,15 +463,6 @@ class ProcessCommSlave(CommSlave):
             inj = faults_mod.FaultInjector(self._fault_plan, self._rank)
             if not inj.empty:
                 self._faults = inj
-        # heartbeat delta state (ISSUE 6): the last stats/metrics
-        # snapshots shipped to the master, so every beat carries only
-        # what changed since. One lock serializes the heartbeat
-        # thread, the DIAGNOSE hook and close's final flush; it NEVER
-        # nests inside _master_lock (deadlock discipline: payload
-        # first, then send).
-        self._tel_lock = threading.Lock()
-        self._tel_last_stats: dict = {}
-        self._tel_last_metrics: dict = {}
         self._recovery = RecoveryManager(
             rank=self._rank, max_retries=self._max_retries,
             dead_rank_secs=self._dead_rank_secs,
@@ -712,7 +713,9 @@ class ProcessCommSlave(CommSlave):
             try:
                 msg = self._master.recv()
             except (Mp4jError, OSError, EOFError) as e:
-                if not self._closed:
+                with self._master_lock:
+                    closed = self._closed
+                if not closed:
                     self._recovery.on_fatal(
                         f"master connection lost: {e!r}")
                     self._ctl_wake()
@@ -741,6 +744,9 @@ class ProcessCommSlave(CommSlave):
                     # manifest (ISSUE 10): vocabulary export + progress
                     # + barrier position, all quiescent while the
                     # collective thread waits out the round
+                    with self._ctl_cv:
+                        barrier_gen = self._barrier_done
+                        resize_gen = self._resize_done
                     try:
                         self._master_send((master_mod.MANIFEST, {
                             "epoch": int(msg[1]),
@@ -749,8 +755,8 @@ class ProcessCommSlave(CommSlave):
                             "inflight": self._progress_state[1],
                             "stats_seq": self._comm_stats.progress()[
                                 "seq"],
-                            "barrier_gen": self._barrier_done,
-                            "resize_gen": self._resize_done,
+                            "barrier_gen": barrier_gen,
+                            "resize_gen": resize_gen,
                         }))
                     except (Mp4jError, OSError):
                         pass  # master gone; its watchdog owns this
@@ -1051,9 +1057,15 @@ class ProcessCommSlave(CommSlave):
             # off the collective hot path — and the committed (or, in
             # observe mode, would-be) decisions land in the recovery
             # log (-> durable sink) and the shipped status document
+            # the payload builder runs on the heartbeat thread AND on
+            # the terminal-abort hook's final flush: the window gate
+            # must be claimed atomically or both fold the same window
             now = time.monotonic()
-            if now >= self._tuner_next:
-                self._tuner_next = now + self._tuner_window
+            with self._tel_lock:
+                due = now >= self._tuner_next
+                if due:
+                    self._tuner_next = now + self._tuner_window
+            if due:
                 for peer, d in tun.observe(
                         self._comm_stats.link_snapshot()):
                     self._recovery.note(
@@ -1175,7 +1187,9 @@ class ProcessCommSlave(CommSlave):
             # a vanished master must not wedge shutdown
             self._closed_ack.wait(5.0)
         self._master.close()
-        for ch in list(self._peers.values()):
+        with self._peer_cv:
+            peers = list(self._peers.values())
+        for ch in peers:
             # graceful: a peer recovering from a late abort round may
             # still be draining our final collective's bytes
             ch.close(graceful=True)
@@ -1306,7 +1320,8 @@ class ProcessCommSlave(CommSlave):
         observability/recovery planes — the ONE place those mirrors
         are written, so a shrink renumbering cannot strand one of
         them on the old id (mp4j-lint R15 baseline)."""
-        self._comm_stats.rank = self._rank  # tags spans + heartbeats
+        with self._tel_lock:
+            self._comm_stats.rank = self._rank  # tags spans + heartbeats
         if self._audit is not None:
             self._audit.rank = self._rank   # tags the audit bundle
             self._audit.slave_num = self._n  # replay's dead-rank guard
@@ -1326,6 +1341,7 @@ class ProcessCommSlave(CommSlave):
                 sock, _ = self._server.accept()
             except OSError:
                 return  # server closed
+            ch = None
             try:
                 # sanctioned channel-construction site: the inbound
                 # peer handshake must be read over SOME transport
@@ -1400,51 +1416,63 @@ class ProcessCommSlave(CommSlave):
                                             owner=False)
             except Exception:
                 # a peer (or stray connection) died mid-handshake; the
-                # accept loop must survive to serve the healthy peers
-                sock.close()
-                continue
-            with self._peer_cv:
-                # a dialer can be ahead of us by one abort round (its
-                # go arrived first): wait for our own go instead of
-                # rejecting a healthy reconnect
-                if peer_epoch > self._recovery.epoch:
-                    self._peer_cv.wait_for(
-                        lambda: self._recovery.epoch >= peer_epoch
-                        or self._recovery.fatal is not None,
-                        timeout=self._handshake_timeout)
-                # only a well-formed, novel rank dialing at the CURRENT
-                # epoch may claim a peer slot: a stray dial-in — or a
-                # stale one from a torn-down epoch — must not hijack
-                # (or orphan) a healthy peer's channel. abort_pending
-                # closes the announce->go window, where the epoch
-                # number still matches but the teardown may already
-                # have drained _peers (a registration after it would
-                # never be invalidated)
-                if (not 0 <= peer_rank < self._n
-                        or peer_rank == self._rank
-                        or peer_rank in self._peers
-                        or peer_epoch != self._recovery.epoch
-                        or self._recovery.abort_pending()):
+                # accept loop must survive to serve the healthy peers.
+                # Close the CHANNEL when one got as far as wrapping the
+                # socket (an shm upgrade owns a segment the raw socket
+                # close would strand), else the socket itself.
+                if ch is not None:
                     ch.close()
-                    continue
-                ch.set_timeout(self._peer_timeout)
-                ch.stats = self._comm_stats  # peer channels book wire time
-                ch.peer_rank = peer_rank     # tags wire spans
-                ch.faults = self._faults     # fault-injection hook
-                ch.epoch = peer_epoch        # pinned for the fence
-                # per-link socket buffers (ISSUE 15 satellite): the
-                # accept side learns the peer only now, so the map
-                # applies post-handshake (no window-scale effect —
-                # documented; the dial side applies before connect)
-                if peer_rank in self._so_buf_map \
-                        and ch.transport == "tcp":
-                    try:
-                        tcp_mod.set_so_bufs(
-                            ch.sock, *self._so_buf_map[peer_rank])
-                    except OSError:
-                        pass
-                self._peers[peer_rank] = ch
-                self._peer_cv.notify_all()
+                else:
+                    sock.close()
+                continue
+            try:
+                with self._peer_cv:
+                    # a dialer can be ahead of us by one abort round
+                    # (its go arrived first): wait for our own go
+                    # instead of rejecting a healthy reconnect
+                    if peer_epoch > self._recovery.epoch:
+                        self._peer_cv.wait_for(
+                            lambda: self._recovery.epoch >= peer_epoch
+                            or self._recovery.fatal is not None,
+                            timeout=self._handshake_timeout)
+                    # only a well-formed, novel rank dialing at the
+                    # CURRENT epoch may claim a peer slot: a stray
+                    # dial-in — or a stale one from a torn-down epoch —
+                    # must not hijack (or orphan) a healthy peer's
+                    # channel. abort_pending closes the announce->go
+                    # window, where the epoch number still matches but
+                    # the teardown may already have drained _peers (a
+                    # registration after it would never be invalidated)
+                    if (not 0 <= peer_rank < self._n
+                            or peer_rank == self._rank
+                            or peer_rank in self._peers
+                            or peer_epoch != self._recovery.epoch
+                            or self._recovery.abort_pending()):
+                        ch.close()
+                        continue
+                    ch.set_timeout(self._peer_timeout)
+                    ch.stats = self._comm_stats  # books wire time
+                    ch.peer_rank = peer_rank     # tags wire spans
+                    ch.faults = self._faults     # fault-injection hook
+                    ch.epoch = peer_epoch        # pinned for the fence
+                    # per-link socket buffers (ISSUE 15 satellite): the
+                    # accept side learns the peer only now, so the map
+                    # applies post-handshake (no window-scale effect —
+                    # documented; the dial side applies before connect)
+                    if peer_rank in self._so_buf_map \
+                            and ch.transport == "tcp":
+                        try:
+                            tcp_mod.set_so_bufs(
+                                ch.sock, *self._so_buf_map[peer_rank])
+                        except OSError:
+                            pass
+                    self._peers[peer_rank] = ch
+                    self._peer_cv.notify_all()
+            except Exception:
+                # the epoch gate raising (fatal mid-wait, interpreter
+                # teardown) must not strand the accepted channel's fd
+                ch.close()
+                raise
             self._tuner_register_channel(peer_rank, ch)
             if peer_epoch > 0:
                 self._comm_stats.add("reconnects", 1)
@@ -3454,7 +3482,7 @@ class ProcessCommSlave(CommSlave):
     def outstanding(self) -> int:
         """How many nonblocking collectives are queued or in flight."""
         return (0 if self._async is None
-                else self._async._outstanding)
+                else self._async.outstanding())
 
     # -- the fused (coalesced) map collective ---------------------------
     @staticmethod

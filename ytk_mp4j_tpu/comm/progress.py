@@ -434,7 +434,14 @@ class ProgressScheduler:
         raise Mp4jFatalError(str(exc))
 
     def active(self) -> bool:
-        return self._outstanding > 0
+        with self._cv:
+            return self._outstanding > 0
+
+    def outstanding(self) -> int:
+        """Queued-or-in-flight count, read under the scheduler's
+        condition (the progression thread decrements it there)."""
+        with self._cv:
+            return self._outstanding
 
     def wait_all(self, timeout: float | None = None) -> None:
         """The collective-boundary drain: block until every outstanding
@@ -466,7 +473,7 @@ class ProgressScheduler:
         the blocking methods from there)."""
         if threading.current_thread() is self._thread:
             return
-        if self._outstanding > 0:
+        if self.active():
             self.wait_all()
 
     def shutdown(self, timeout: float = 30.0) -> None:

@@ -94,6 +94,12 @@ THRESHOLDS: dict[str, float] = {
     # wall-clock caveat and wide budget as the membership rows above
     "socket_planned_evict_ms": 1.0,
     "socket_grow_latency_ms": 1.0,
+    # ISSUE 16: mp4j-lint v3 (R23-R25 lockset/resource whole-program
+    # passes) over v2 (R19-R21) — a RATIO, so already normalized
+    # against host speed; the budget bounds growth of the marginal
+    # analysis cost (v3 <= 1.5x v2 absolute is asserted in tier-1,
+    # this row gates drift between bench rounds)
+    "lint_v3_over_v2_ratio": 0.5,
 }
 
 # metrics where SMALLER is the good direction (latencies): the budget
@@ -104,6 +110,7 @@ LOWER_IS_BETTER = frozenset({
     "socket_shrink_latency_ms",
     "socket_planned_evict_ms",
     "socket_grow_latency_ms",
+    "lint_v3_over_v2_ratio",
 })
 
 

@@ -458,6 +458,10 @@ class HealthEngine:
         # consumers decoupled
         self._dom_rows: collections.deque = collections.deque(
             maxlen=max(self.window, 1))
+        # _dom_rows crosses lock domains: folds append under the
+        # master's lock, the tuner controller snapshots under its own
+        # — a private lock makes the rows' discipline self-contained
+        self._dom_lock = threading.Lock()
         self._streak_rank: int | None = None
         self._streak = 0
         self._dur_ewma = 0.0
@@ -683,9 +687,10 @@ class HealthEngine:
                               + 0.05 * (dur - self._dur_ewma))
             self._dur_n += 1
         self._dom_recent.append((int(row["seq"]), dom, slow))
-        self._dom_rows.append({"seq": int(row["seq"]), "dom": dom,
-                               "cause": row.get("cause") or "?",
-                               "slow": slow})
+        with self._dom_lock:
+            self._dom_rows.append({"seq": int(row["seq"]), "dom": dom,
+                                   "cause": row.get("cause") or "?",
+                                   "slow": slow})
         if slow and dom == self._streak_rank:
             self._streak += 1
         elif slow:
@@ -852,7 +857,8 @@ class HealthEngine:
         cause, slow}]`` (bounded by the window) — the evidence the
         master's tuner controller feeds
         :func:`ytk_mp4j_tpu.utils.tuner.decide_leaders` (ISSUE 15)."""
-        return list(self._dom_rows)
+        with self._dom_lock:
+            return list(self._dom_rows)
 
     def dominator_shares(self) -> dict[int, float]:
         """Sliding-window dominance share per rank (the

@@ -346,8 +346,9 @@ class SinkWriter:
             with self._io_lock:
                 self._drain_locked()
         except Exception as e:          # noqa: BLE001 - see docstring
-            self.dropped_records += 1
-            self.last_error = repr(e)
+            with self._io_lock:
+                self.dropped_records += 1
+                self.last_error = repr(e)
             if self._metrics is not None and self._metrics.enabled:
                 self._metrics.inc("sink/dropped_records", 1)
 
@@ -636,12 +637,15 @@ class SinkWriter:
 
     def status(self) -> dict:
         """One sink-health record (postmortem bundle's ``sink.json``,
-        the master's manifest)."""
-        return {"dir": self.dir, "root": self.root,
-                "bytes_written": self.bytes_written,
-                "records_written": self.records_written,
-                "dropped_records": self.dropped_records,
-                "evicted_segments": self.evicted_segments,
-                "last_error": self.last_error,
-                "budget_bytes": self.budget,
-                "segment_bytes": self.seg_bytes}
+        the master's manifest). Counters are written by the drain
+        thread under ``_io_lock`` — snapshot under the same lock so a
+        status render never shows a half-applied flush."""
+        with self._io_lock:
+            return {"dir": self.dir, "root": self.root,
+                    "bytes_written": self.bytes_written,
+                    "records_written": self.records_written,
+                    "dropped_records": self.dropped_records,
+                    "evicted_segments": self.evicted_segments,
+                    "last_error": self.last_error,
+                    "budget_bytes": self.budget,
+                    "segment_bytes": self.seg_bytes}

@@ -275,7 +275,8 @@ class Autoscaler:
 
     # -- lifecycle ------------------------------------------------------
     def start(self, stop: threading.Event) -> "Autoscaler":
-        self._stop = stop
+        with self._lock:
+            self._stop = stop
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="mp4j-autoscaler")
         self._thread.start()
@@ -286,10 +287,15 @@ class Autoscaler:
             self._thread.join(timeout)
 
     def _loop(self) -> None:
+        # snapshot the stop event once under the controller lock: it
+        # is published by start() on the spawning thread and never
+        # rebound afterwards
+        with self._lock:
+            stop = self._stop
         # Event.wait, never time.sleep (mp4j-lint R18): the master's
         # stop event ends the loop within one tick, and a trip takes
         # effect on the very next evaluation
-        while not self._stop.wait(self._tick):
+        while not stop.wait(self._tick):
             try:
                 self.tick()
             # the controller must outlive any single bad tick (a
