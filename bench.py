@@ -178,6 +178,11 @@ def _run_socket_job(procs, body, native_transport, join_timeout=300.0,
 
     def worker():
         try:
+            # child-only pin (after fork, parent env untouched): frozen
+            # figures must not move because an operator exported
+            # MP4J_OVERLAP=1 (ISSUE 17) — the trainer-overlap leg opts
+            # back in explicitly via StepStatsExchanger(overlap=True)
+            os.environ["MP4J_OVERLAP"] = "0"
             slave = ProcessCommSlave("127.0.0.1", master.port, timeout=60.0,
                                      native_transport=native_transport,
                                      **slave_kwargs)
@@ -520,6 +525,111 @@ def bench_socket_coalesce(procs=4, maps=400, keys=16, window_us=500):
         else:
             os.environ["MP4J_COALESCE_USECS"] = prior
     return {"on": min(on), "off": min(off), "stats": stats}
+
+
+def bench_socket_coalesce_array(procs=4, arrays=400, size=256,
+                                window_us=500):
+    """ISSUE 17 dense-coalescing figure: ``arrays`` tiny ``iallreduce``
+    submissions (``size`` float32 elems each, tree-schedule payloads)
+    under the ``MP4J_COALESCE_USECS`` window vs the same stream with
+    the window off (each array its own negotiation + tree walk). The
+    array twin of ``bench_socket_coalesce``: consecutive same-signature
+    submissions fuse into ONE count-negotiated multi-exchange
+    (``allreduce_array_multi``), so the per-collective fixed cost
+    amortizes across the backlog — acceptance is >= 2x elems/s over
+    the sequential ``i*`` stream on this host. Needs procs >= 3: the
+    fused walk is pinned to the tree schedule and ``algo="auto"`` only
+    selects tree at n >= 3 (at n=2 RHD degenerates to the optimal
+    pairwise exchange)."""
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+
+    def body(slave, r):
+        bufs = [np.full(size, float(r + 1) * (i + 1), np.float32)
+                for i in range(arrays)]
+        slave.barrier()
+        t0 = time.perf_counter()
+        for b in bufs:
+            slave.iallreduce(b, Operands.FLOAT, Operators.SUM)
+        slave.wait_all()
+        return arrays * size / (time.perf_counter() - t0)
+
+    prior = os.environ.get("MP4J_COALESCE_USECS")
+    try:
+        os.environ["MP4J_COALESCE_USECS"] = str(window_us)
+        on, stats = _run_socket_job(procs, body, True, shm=False,
+                                    audit="off", sink_dir="",
+                                    async_collectives=True)
+        os.environ["MP4J_COALESCE_USECS"] = "0"
+        off, _ = _run_socket_job(procs, body, True, shm=False,
+                                 audit="off", sink_dir="",
+                                 async_collectives=True)
+    finally:
+        if prior is None:
+            os.environ.pop("MP4J_COALESCE_USECS", None)
+        else:
+            os.environ["MP4J_COALESCE_USECS"] = prior
+    return {"on": min(on), "off": min(off), "stats": stats}
+
+
+def bench_trainer_overlap(procs=2, steps=30, grad_elems=65_536,
+                          matmul_dim=192, matmul_reps=6):
+    """ISSUE 17 trainer-overlap A/B: a trainer-shaped epoch loop —
+    per step, a device-compute stand-in (BLAS matmuls, GIL released)
+    plus a dense per-step gradient/statistics exchange through
+    ``StepStatsExchanger`` — run with overlap ON (``iallreduce``
+    posted, step k's wire rides the progression thread under step
+    k+1's compute, ``drain()`` at the epoch boundary) vs OFF (today's
+    blocking ``allreduce_array`` per step). Identical collectives in
+    identical submit order; only the wait point moves.
+
+    MULTI-CORE ONLY: ``len(os.sched_getaffinity(0))`` decides. On a
+    1-core host (this bench rig) the wire and the compute time-share
+    the same CPU, so overlap cannot create cycles — the leg records a
+    ``skipped_1core`` marker INSTEAD of a bogus figure (the
+    ``socket_async_overlap_gbs`` lesson, measured and documented in
+    that leg's docstring: dense overlap lands BELOW sequential at 1
+    core). When nproc > 1 the gate is >= 1.3x steps/s; a miss is
+    reported in the ``gate`` field and the frozen ratio is bench-diff
+    budgeted so it cannot silently regress between rounds."""
+    nproc = len(os.sched_getaffinity(0))
+    if nproc < 2:
+        return {"skipped_1core": True, "nproc": nproc}
+
+    from ytk_mp4j_tpu.models._base import StepStatsExchanger
+
+    def make_body(overlap):
+        def body(slave, r):
+            rng = np.random.default_rng(r)
+            a = rng.standard_normal((matmul_dim, matmul_dim),
+                                    np.float32)
+            grads = [np.full(grad_elems, float(r + 1) * (k + 1),
+                             np.float64) for k in range(steps)]
+            ex = StepStatsExchanger(slave, overlap=overlap)
+            slave.barrier()
+            t0 = time.perf_counter()
+            for g in grads:
+                ex.submit(g)
+                # step k+1's independent compute: overlap mode drives
+                # step k's wire under it, blocking mode already paid
+                for _ in range(matmul_reps):
+                    a = np.tanh(a @ a) + 0.1
+            ex.drain()
+            return steps / (time.perf_counter() - t0)
+        return body
+
+    blk, _ = _run_socket_job(procs, make_body(False), True, shm=False,
+                             audit="off", sink_dir="",
+                             async_collectives=True)
+    ovl, stats = _run_socket_job(procs, make_body(True), True,
+                                 shm=False, audit="off", sink_dir="",
+                                 async_collectives=True)
+    ratio = min(ovl) / min(blk)
+    return {"overlap": min(ovl), "blocking": min(blk),
+            "ratio": ratio, "nproc": nproc, "gate_min": 1.3,
+            "gate": "ok" if ratio >= 1.3 else
+                    f"MISS: {ratio:.2f}x < 1.3x dense-overlap gate",
+            "stats": stats}
 
 
 def bench_socket_tuner_act(procs=4, size=400_000, reps=6,
@@ -1466,6 +1576,12 @@ def main():
     # coalescing A/B (window on vs off)
     async_overlap = bench_socket_async_overlap()
     coalesce = bench_socket_coalesce()
+    # ISSUE 17 (mp4j-overlap): the dense small-array coalescing A/B
+    # (the array twin of the map figure above) and the trainer-shaped
+    # overlap epoch — multi-core only, records skipped_1core on this
+    # 1-core rig instead of a bogus figure (see the leg docstring)
+    coalesce_array = bench_socket_coalesce_array()
+    trainer_overlap = bench_trainer_overlap()
     # ISSUE 15 (mp4j-tuner): the framed + columnar-map planes over the
     # shm rings (frame-level routing — these bytes were carrier-bound
     # before), and the tuner act-vs-off A/B on a compressed-operand
@@ -1555,6 +1671,31 @@ def main():
                 coalesce["off"], 0),
             "socket_coalesce_ratio": round(
                 coalesce["on"] / coalesce["off"], 3),
+            # ISSUE 17 (mp4j-overlap): the dense small-array fused
+            # plane (count-negotiated allreduce_array_multi) vs the
+            # same stream as sequential i* submissions — acceptance
+            # >= 2x elems/s — and the trainer-overlap epoch A/B. The
+            # trainer leg is multi-core only: on this 1-core rig the
+            # dict records skipped_1core and NO ratio figure is
+            # emitted (bench-diff skips missing metrics, so the gate
+            # arms itself the first time the bench runs on a
+            # multi-core host)
+            "socket_coalesce_array_elems_per_sec": round(
+                coalesce_array["on"], 0),
+            "socket_coalesce_array_off_elems_per_sec": round(
+                coalesce_array["off"], 0),
+            "socket_coalesce_array_ratio": round(
+                coalesce_array["on"] / coalesce_array["off"], 3),
+            "socket_trainer_overlap": {
+                k: v for k, v in trainer_overlap.items()
+                if k != "stats"},
+            **({"socket_trainer_overlap_ratio": round(
+                    trainer_overlap["ratio"], 3),
+                "socket_trainer_overlap_steps_per_sec": round(
+                    trainer_overlap["overlap"], 2),
+                "socket_trainer_blocking_steps_per_sec": round(
+                    trainer_overlap["blocking"], 2)}
+               if "ratio" in trainer_overlap else {}),
             # ISSUE 15 (mp4j-tuner): the framed/columnar-map planes
             # over the shm rings (frame-level routing — previously
             # carrier-bound even intra-host), and the tuner A/B: act

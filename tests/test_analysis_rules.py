@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 26)]
+    assert ids == [f"R{i}" for i in range(1, 27)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1913,3 +1913,166 @@ def test_r25_inline_suppression():
     """)
     assert not r.findings
     assert any(f.rule == "R25" for f in r.suppressed)
+
+
+# ----------------------------------------------------------------------
+# R26 — in-loop i* submit awaited with no intervening compute
+# ----------------------------------------------------------------------
+def test_r26_fires_on_submit_then_wait():
+    r = run_rule("R26", """
+        def epoch(comm, grads):
+            for g in grads:
+                f = comm.iallreduce(g)
+                f.wait()
+    """)
+    [f] = r.findings
+    assert f.rule == "R26" and f.line == 5
+    assert "'f'" in f.message and "overlap" in f.message
+
+
+def test_r26_fires_on_lone_submit_then_wait_all():
+    r = run_rule("R26", """
+        def epoch(comm, grads):
+            for g in grads:
+                f = comm.iallreduce(g)
+                comm.wait_all()
+    """)
+    [f] = r.findings
+    assert f.rule == "R26" and "wait_all" in f.message
+
+
+def test_r26_fires_on_result_in_while_loop():
+    r = run_rule("R26", """
+        def pump(comm, q):
+            while q:
+                f = comm.iallreduce_map(q.pop())
+                merged = f.result()
+    """)
+    [f] = r.findings
+    assert f.rule == "R26"
+
+
+def test_r26_quiet_with_intervening_compute():
+    r = run_rule("R26", """
+        def epoch(comm, grads, model):
+            for k, g in enumerate(grads):
+                f = comm.iallreduce(g)
+                model.forward(k + 1)
+                f.wait()
+    """)
+    assert not r.findings
+
+
+def test_r26_quiet_on_batched_submits_before_wait_all():
+    # several outstanding submits pipeline against each other — that
+    # IS the engine's k-fold amortization, not a defeated overlap
+    r = run_rule("R26", """
+        def epoch(comm, grads):
+            for a, b in grads:
+                f1 = comm.iallreduce(a)
+                f2 = comm.iallreduce(b)
+                comm.wait_all()
+    """)
+    assert not r.findings
+
+
+def test_r26_quiet_outside_loops():
+    # a one-shot submit-and-wait is a deliberate blocking call with
+    # future plumbing (e.g. a drain helper): only LOOPS pay per-step
+    r = run_rule("R26", """
+        def drain(comm, x):
+            f = comm.iallreduce(x)
+            f.wait()
+    """)
+    assert not r.findings
+
+
+def test_r26_inline_suppression():
+    r = run_rule("R26", """
+        def bench_sequential(comm, arrs):
+            for a in arrs:
+                f = comm.iallreduce(a)
+                # mp4j-lint: disable=R26 (the sequential A/B baseline)
+                f.wait()
+    """)
+    assert not r.findings
+    assert any(f.rule == "R26" for f in r.suppressed)
+
+
+# ----------------------------------------------------------------------
+# diff-sarif — fingerprint-ratchet CI gate
+# ----------------------------------------------------------------------
+def _sarif_log(tmp_path, name, src):
+    """Lint ONE canonical module path (so artifact URIs match across
+    revisions, as in real CI) and emit a SARIF log named ``name``."""
+    import os
+
+    from ytk_mp4j_tpu.analysis.cli import main as cli_main
+    py = tmp_path / "mod.py"
+    py.write_text(textwrap.dedent(src))
+    out = tmp_path / (name + ".sarif")
+    rc = cli_main([str(py), "--sarif", str(out), "--no-baseline"])
+    assert os.path.exists(out)
+    return rc, str(out)
+
+
+def test_diff_sarif_exits_zero_on_identical_and_fixed(tmp_path, capsys):
+    from ytk_mp4j_tpu.analysis.cli import main as cli_main
+    bad = """
+        def step_a(comm, xs):
+            for x in xs:
+                f = comm.iallreduce(x)
+                f.wait()
+    """
+    _rc, old = _sarif_log(tmp_path, "old", bad)
+    assert cli_main(["diff-sarif", old, old]) == 0
+    # NEW with the finding FIXED: fewer findings never trips the gate
+    _rc, fixed = _sarif_log(tmp_path, "fixed", """
+        def step_a(comm, xs):
+            for x in xs:
+                f = comm.iallreduce(x)
+                compute(x)
+                f.wait()
+    """)
+    assert cli_main(["diff-sarif", old, fixed]) == 0
+
+
+def test_diff_sarif_nonzero_only_on_new_fingerprints(tmp_path, capsys):
+    from ytk_mp4j_tpu.analysis.cli import main as cli_main
+    _rc, old = _sarif_log(tmp_path, "old", """
+        def step_a(comm, xs):
+            for x in xs:
+                f = comm.iallreduce(x)
+                f.wait()
+    """)
+    # the pre-existing finding survives a refactor that DRIFTS its
+    # line; a genuinely new finding appears in another scope
+    _rc, new = _sarif_log(tmp_path, "new", """
+        HEADROOM = 1  # pushes step_a down
+
+
+        def step_a(comm, xs):
+            for x in xs:
+                f = comm.iallreduce(x)
+                f.wait()
+
+
+        def step_b(comm, ys):
+            for y in ys:
+                g = comm.iallreduce(y)
+                g.wait()
+    """)
+    assert cli_main(["diff-sarif", old, new]) == 1
+    out = capsys.readouterr().out
+    assert "step_b" in out and out.count("NEW ") == 1
+
+
+def test_diff_sarif_unreadable_input_is_usage_error(tmp_path):
+    from ytk_mp4j_tpu.analysis.cli import main as cli_main
+    missing = str(tmp_path / "nope.sarif")
+    good = tmp_path / "ok.sarif"
+    good.write_text("{}")
+    assert cli_main(["diff-sarif", missing, str(good)]) == 2
+    bad = tmp_path / "bad.sarif"
+    bad.write_text("{not json")
+    assert cli_main(["diff-sarif", str(good), str(bad)]) == 2

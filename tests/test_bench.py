@@ -133,6 +133,35 @@ def test_bench_socket_map_shm_smoke(monkeypatch):
     assert sum(e["wire_bytes_shm_ring"] for e in stats.values()) > 0
 
 
+def test_bench_socket_coalesce_array_smoke():
+    # procs=3: the fused array plane is pinned to the tree schedule
+    # and algo=auto only selects tree at n >= 3 (leg docstring)
+    out = bench.bench_socket_coalesce_array(procs=3, arrays=40,
+                                            size=64)
+    assert np.isfinite(out["on"]) and out["on"] > 0
+    assert np.isfinite(out["off"]) and out["off"] > 0
+    # the window leg actually fused: coalesced_elems books the
+    # count-negotiated multi-exchange totals
+    assert sum(e.get("coalesced_elems", 0)
+               for e in out["stats"].values()) > 0
+
+
+def test_bench_trainer_overlap_skips_or_measures():
+    import os
+
+    out = bench.bench_trainer_overlap(procs=2, steps=3,
+                                      grad_elems=512, matmul_dim=32,
+                                      matmul_reps=1)
+    nproc = len(os.sched_getaffinity(0))
+    if nproc < 2:
+        # the 1-core contract: a recorded marker, never a bogus figure
+        assert out == {"skipped_1core": True, "nproc": nproc}
+    else:
+        assert np.isfinite(out["ratio"]) and out["ratio"] > 0
+        assert out["overlap"] > 0 and out["blocking"] > 0
+        assert out["gate_min"] == 1.3 and "gate" in out
+
+
 def test_bench_socket_tuner_act_smoke():
     out = bench.bench_socket_tuner_act(procs=2, size=60_000, reps=2,
                                        warmup_secs=1.3)

@@ -303,6 +303,46 @@ def test_policy_shm_links_never_get_chunk_decisions():
         assert d is None or not d.get("chunk_bytes")
 
 
+def test_policy_sockbuf_raises_toward_bdp():
+    # 1 GB/s sustained bulk on tcp with small applied buffers: the
+    # BDP at the assumed RTT (~1 MB) dwarfs them — one doubling per
+    # sustained verdict, per buffer
+    st = tuner.initial_state()
+    w = _win(bytes_=40_000_000, secs=0.04)
+    w["transport"] = "tcp"
+    w["so_sndbuf"] = 128 * 1024
+    w["so_rcvbuf"] = 256 * 1024
+    d = None
+    for _ in range(tuner.SUSTAIN_WINDOWS):
+        st, d = tuner.decide_link(w, st, CHUNK)
+    assert d is not None
+    assert d["so_sndbuf"] == 256 * 1024
+    assert d["so_rcvbuf"] == 512 * 1024
+
+
+def test_policy_sockbuf_quiet_cases():
+    # links carrying shm bytes, trickle windows, non-tcp transports
+    # and at-cap buffers never propose a resize
+    bufs = {"so_sndbuf": 128 * 1024, "so_rcvbuf": 128 * 1024}
+    quiet = [
+        {**_win(bytes_=40_000_000, secs=0.04, shm=1),
+         "transport": "tcp", **bufs},
+        {**_win(bytes_=1_000_000, secs=0.01),
+         "transport": "tcp", **bufs},
+        {**_win(bytes_=40_000_000, secs=0.04),
+         "transport": "shm", **bufs},
+        {**_win(bytes_=40_000_000, secs=0.04), "transport": "tcp",
+         "so_sndbuf": tuner.SOCKBUF_MAX,
+         "so_rcvbuf": tuner.SOCKBUF_MAX},
+    ]
+    for w in quiet:
+        st = tuner.initial_state()
+        for _ in range(tuner.SUSTAIN_WINDOWS + 1):
+            st, d = tuner.decide_link(w, st, CHUNK)
+            assert d is None or not (d.get("so_sndbuf")
+                                     or d.get("so_rcvbuf")), w
+
+
 def test_link_tuner_boundary_only_application():
     # decisions commit on the heartbeat side but take effect ONLY when
     # the collective boundary drains the queue

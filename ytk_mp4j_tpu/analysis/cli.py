@@ -10,6 +10,7 @@ Usage::
     mp4j-lint graph --dot             # the discovered lock-order graph
     mp4j-lint races [--dot]           # the shared-field -> lockset map
     mp4j-lint --sarif out.sarif       # SARIF 2.1.0 log for CI viewers
+    mp4j-lint diff-sarif OLD NEW      # nonzero only on NEW fingerprints
     python -m ytk_mp4j_tpu.analysis ytk_mp4j_tpu/
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 bad invocation or
@@ -21,6 +22,7 @@ shows everything, ``--write-baseline`` accepts the current findings.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import textwrap
@@ -190,6 +192,60 @@ def _graph_main(argv) -> int:
     return 0
 
 
+def _build_diff_sarif_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="mp4j-lint diff-sarif",
+        description=("compare two SARIF logs by result fingerprint "
+                     "and exit nonzero ONLY when NEW carries findings "
+                     "whose partialFingerprints are absent from OLD — "
+                     "the ratchet CI gate: pre-existing findings never "
+                     "block, line drift never false-alarms (the "
+                     "fingerprint is the scope qualname, not a line "
+                     "number)"))
+    ap.add_argument("old", help="baseline SARIF log")
+    ap.add_argument("new", help="candidate SARIF log")
+    return ap
+
+
+def _sarif_result_keys(doc) -> dict[tuple, dict]:
+    """Identity map of a SARIF log's results: ``(ruleId, artifact
+    uri, sorted partialFingerprints) -> result``. Line numbers are
+    deliberately NOT part of the key."""
+    out: dict[tuple, dict] = {}
+    for run in doc.get("runs") or []:
+        for res in run.get("results") or []:
+            locs = res.get("locations") or [{}]
+            uri = (locs[0].get("physicalLocation") or {}) \
+                .get("artifactLocation", {}).get("uri", "")
+            fp = tuple(sorted(
+                (res.get("partialFingerprints") or {}).items()))
+            out.setdefault((res.get("ruleId"), uri, fp), res)
+    return out
+
+
+def _diff_sarif_main(argv) -> int:
+    args = _build_diff_sarif_parser().parse_args(argv)
+    docs = []
+    for path in (args.old, args.new):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                docs.append(json.load(fh))
+        except (OSError, ValueError) as e:
+            print(f"mp4j-lint diff-sarif: unreadable {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    old_keys = set(_sarif_result_keys(docs[0]))
+    fresh = [(k, r) for k, r in _sarif_result_keys(docs[1]).items()
+             if k not in old_keys]
+    for (rule, uri, _fp), res in fresh:
+        region = (res.get("locations") or [{}])[0] \
+            .get("physicalLocation", {}).get("region", {})
+        msg = res.get("message", {}).get("text", "")
+        print(f"NEW {rule} {uri}:{region.get('startLine', 0)} {msg}")
+    print(f"mp4j-lint diff-sarif: {len(fresh)} new finding(s)")
+    return 1 if fresh else 0
+
+
 def _baseline_header(path: str) -> str | None:
     """The leading comment block of the committed baseline, preserved
     across --prune-baseline rewrites."""
@@ -216,6 +272,8 @@ def main(argv=None) -> int:
         return _graph_main(argv[1:])
     if argv and argv[0] == "races":
         return _races_main(argv[1:])
+    if argv and argv[0] == "diff-sarif":
+        return _diff_sarif_main(argv[1:])
     args = _build_parser().parse_args(argv)
 
     if args.list_rules:

@@ -27,6 +27,7 @@
 | R23 | error   | inconsistent lockset on a shared field (whole-program) |
 | R24 | error   | resource leaked on an exception path (whole-program) |
 | R25 | error   | thread started without join/daemon/stop (whole-program) |
+| R26 | warning | in-loop i* submit awaited with no compute (overlap defeated) |
 
 R19-R21 and R23-R25 are
 :class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule` instances: they
@@ -79,6 +80,8 @@ from ytk_mp4j_tpu.analysis.rules.r24_resource_leak import (
     R24ResourceLeak)
 from ytk_mp4j_tpu.analysis.rules.r25_thread_lifecycle import (
     R25ThreadLifecycle)
+from ytk_mp4j_tpu.analysis.rules.r26_immediate_await import (
+    R26ImmediateAwait)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -106,6 +109,7 @@ ALL_RULES = [
     R23LocksetRace,
     R24ResourceLeak,
     R25ThreadLifecycle,
+    R26ImmediateAwait,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

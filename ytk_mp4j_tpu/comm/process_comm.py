@@ -1493,6 +1493,28 @@ class ProcessCommSlave(CommSlave):
         self._recovery.check_channel(ch.epoch)
         return ch
 
+    def _fenced_try(self, peer: int) -> "Channel | None":
+        """Non-blocking :meth:`_fenced` for the async engine's
+        incremental arming. When this rank is the ACCEPT side (peer >
+        rank) and the higher rank has not dialed in yet, returns None
+        instead of parking in the peer cv: a blocked progression
+        thread stops pumping every OTHER leg it owns, and the dial it
+        waits for may itself be cursor-gated behind bytes those legs
+        owe — a mixed establishment/byte-dependency deadlock (seen on
+        the n=5 shm engine grid). Dial-side establishment stays
+        synchronous: the peer's accept loop is always responsive, so
+        the connect is bounded and cannot join a cycle."""
+        self._recovery.poll()
+        if peer > self._rank:
+            with self._peer_cv:
+                ch = self._peers.get(peer)
+            if ch is None:
+                return None
+        else:
+            ch = self._channel(peer)
+        self._recovery.check_channel(ch.epoch)
+        return ch
+
     def _channel(self, peer: int) -> Channel:
         if peer == self._rank or not (0 <= peer < self._n):
             raise Mp4jError(f"bad peer {peer}")
@@ -3400,6 +3422,11 @@ class ProcessCommSlave(CommSlave):
                     and self._map_columnar_ok(args[1], args[2]):
                 return "map"
             return "inline"
+        if name == "allreduce_array" and self._coalesce_usecs > 0 \
+                and self._array_multi_ok(args, kwargs):
+            # the dense small-array twin of the map plane (ISSUE 17):
+            # same job-wide protocol-selection rule as "map" above
+            return "array"
         if progress_mod.engine_eligible(self, name, args, kwargs):
             return "engine"
         return "inline"
@@ -3608,6 +3635,128 @@ class ProcessCommSlave(CommSlave):
             self._comm_stats.add("coalesced_frames", 1)
         return m
 
+    # -- the fused (coalesced) ARRAY collective (ISSUE 17) --------------
+    @staticmethod
+    def _merge_array_headers_multi(a, b):
+        """Header merge for the array-plane count negotiation:
+        ``(count, lengths, bad)`` — the agreed batch is the MIN count,
+        and the per-slot lengths must agree over that prefix (ragged
+        COUNTS are the protocol's whole point; ragged LENGTHS are a
+        caller error surfaced job-wide)."""
+        m = min(a[0], b[0])
+        if a[1][:m] != b[1][:m]:
+            return (m, a[1][:m], True)
+        return (m, a[1][:m], a[2] or b[2])
+
+    def _array_sync_multi(self, header, root: int):
+        """Count-negotiating sync for :meth:`allreduce_array_multi`:
+        the 3-field header merges up the binomial tree and the root's
+        decision (agreed batch size m, or the length-mismatch error)
+        broadcasts back — one small-object round trip amortized over
+        the whole fused batch, exactly :meth:`_map_sync_multi`'s
+        shape."""
+        header = self._tree_reduce_walk(
+            header, root, self._send,
+            lambda peer, h: self._merge_array_headers_multi(
+                h, self._recv(peer)))
+        decision = header if self._rank == root else None
+        return self._map_bcast_obj(decision, root)
+
+    def allreduce_array_multi(self, arrs: list,
+                              operand: Operand = Operands.FLOAT,
+                              operator: Operator = Operators.SUM) -> int:
+        """Fused allreduce of SEVERAL small dense arrays under ONE
+        count negotiation (the ISSUE 11 map-coalescing engine ported
+        to the array plane, ISSUE 17): each rank offers
+        ``len(arrs)`` arrays, the sync negotiates the agreed batch
+        ``m = min`` over every rank's offer, and the first ``m``
+        arrays ship concatenated as ONE tree reduce + broadcast — the
+        per-collective fixed cost (two tree walks of small frames,
+        their syscalls and scheduler wakeups) amortizes across the
+        batch.
+
+        The fused exchange is pinned to the TREE schedule: each fused
+        element's reduction association is the binomial-tree rank
+        order regardless of array boundaries, which is exactly the
+        schedule ``algo="auto"`` resolves for these arrays one at a
+        time (small payloads -> "tree"), so every array's result is
+        bit-identical to its own ``allreduce_array``. Returns ``m``;
+        callers re-offer the remainder. In place on every merged
+        array; arrays past ``m`` are untouched."""
+        if not isinstance(arrs, list) or not arrs:
+            raise Mp4jError(
+                "allreduce_array_multi needs a non-empty list of arrays")
+        if not operand.is_numeric:
+            raise Mp4jError(
+                "allreduce_array_multi is numeric-only (the dense "
+                "small-array plane)")
+        for a in arrs:
+            if not (isinstance(a, np.ndarray) and a.ndim == 1
+                    and a.flags.c_contiguous
+                    and a.dtype == operand.dtype):
+                raise Mp4jError(
+                    "allreduce_array_multi needs 1-D contiguous "
+                    f"arrays of dtype {operand.dtype}, got "
+                    f"{type(a).__name__}"
+                    + (f" {a.dtype} shape {a.shape}"
+                       if isinstance(a, np.ndarray) else ""))
+        if self._n == 1:
+            return len(arrs)
+        header = (len(arrs), tuple(int(a.size) for a in arrs), False)
+        decision = self._array_sync_multi(header, 0)
+        m, lengths, bad = decision
+        if bad:
+            raise Mp4jError(
+                "allreduce_array_multi: ranks disagree on the fused "
+                "arrays' lengths over the negotiated batch — every "
+                "rank must offer identically-shaped slots")
+        total = int(sum(lengths))
+        if total:
+            # one scratch buffer, one tree walk: the merge runs in
+            # reduce_array's internal copy, the callers' arrays are
+            # only READ until the final local scatter — snapshot-free
+            # by the broadcast_map reasoning (_SNAPSHOT_FREE)
+            scratch = np.empty(total, operand.dtype)
+            off = 0
+            for i in range(m):
+                scratch[off:off + lengths[i]] = arrs[i]
+                off += lengths[i]
+            self.reduce_array(scratch, operand, operator, root=0)
+            self.broadcast_array(scratch, operand, root=0)
+            off = 0
+            for i in range(m):
+                arrs[i][:] = scratch[off:off + lengths[i]]
+                off += lengths[i]
+        if m > 1:
+            self._comm_stats.add("coalesced_frames", 1)
+            self._comm_stats.add("coalesced_elems", total)
+        return m
+
+    def _array_multi_ok(self, args: tuple, kwargs: dict) -> bool:
+        """Whether an ``iallreduce`` submission may ride the fused
+        array plane. A JOB-wide pure function of the call parameters
+        (dtype/shape/size/knobs) — never of rank-local queue depth —
+        so every rank classifies the same call sequence identically
+        (the negotiated count then absorbs ragged coalescing depth)."""
+        arr, operand = args[0], args[1]
+        if not (isinstance(arr, np.ndarray) and arr.ndim == 1
+                and arr.flags.c_contiguous
+                and operand.is_numeric
+                and arr.dtype == operand.dtype):
+            return False
+        if kwargs.get("from_", 0) != 0 or kwargs.get("to") is not None \
+                or kwargs.get("algo", "auto") != "auto":
+            return False
+        if self._n <= 1 or self._use_twolevel():
+            return False
+        # only arrays whose auto schedule IS the tree (small payloads,
+        # n >= 3): the fused walk is pinned to tree, and fused ==
+        # sequential bit-exactness needs the blocking twin on the same
+        # schedule
+        return tuning.select_allreduce_algo(
+            arr.nbytes, self._n, self._algo_small,
+            self._algo_large) == "tree"
+
     # ------------------------------------------------------------------
     def _check_root(self, root: int):
         if not (0 <= root < self._n):
@@ -3648,6 +3797,10 @@ _SNAPSHOT_FREE = frozenset({
     # value copies; the caller's dicts mutate only after the last wire
     # operation of the walk — the broadcast_map reasoning, per slot
     "allreduce_map_multi",
+    # the fused array batch (ISSUE 17): the tree walk runs on an
+    # internal scratch concat; the callers' arrays are only read until
+    # the final local scatter — same reasoning, per slot
+    "allreduce_array_multi",
 })
 
 # Root-only mutators: every non-root rank only SENDS (both planes of

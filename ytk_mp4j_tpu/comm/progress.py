@@ -33,8 +33,10 @@ Architecture
     recv legs enqueue tickets into per-``(peer, direction)`` FIFO
     queues at admission, and the poll loop moves bytes on whichever
     runnable leg's socket is ready (nonblocking TCP via ``select``;
-    ops whose channel rides the shm rings execute through the existing
-    blocking ``_chunked_exchange`` as one atomic step). Because every
+    legs whose channel rides the shm rings pump the SPSC ring
+    piece/sync-byte schedule chunk-granularly through
+    ``transport.shm.SendPump``/``RecvPump`` — wire-identical to the
+    blocking chunked exchange, never blocking the loop). Because every
     rank enqueues the SAME per-channel leg sequence (pure schedules ×
     identical submit order — the R1/R8 discipline), bytes always pair
     with the peer's matching leg whatever the local interleaving; and
@@ -275,7 +277,7 @@ class _Op:
     """
 
     __slots__ = ("item", "idx", "sp", "sarr", "rp", "rdst", "acc",
-                 "operator", "ring", "on_done", "atomic", "armed",
+                 "operator", "ring", "on_done", "armed", "wait_since",
                  "legs", "pending_legs", "rbuf")
 
     def __init__(self, item, idx, sp=None, sarr=None, rp=None,
@@ -291,8 +293,8 @@ class _Op:
         self.operator = operator
         self.ring = ring
         self.on_done = on_done
-        self.atomic = False
         self.armed = False
+        self.wait_since = None    # first deferred-arm tick (see _arm)
         self.legs: list[_Leg] = []
         if sp is not None:
             self.legs.append(_Leg(self, "send", sp))
@@ -316,7 +318,7 @@ class _Op:
 class _Leg:
     __slots__ = ("op", "dir", "peer", "ch", "view", "off", "n",
                  "chunks", "merged", "busy", "last_progress", "src",
-                 "started")
+                 "started", "pump")
 
     def __init__(self, op, dir_, peer):
         self.op = op
@@ -332,6 +334,7 @@ class _Leg:
         self.last_progress = 0.0
         self.src = None           # ndarray backing the view
         self.started = False      # first byte attempted (fold point)
+        self.pump = None          # shm chunk pump (SendPump/RecvPump)
 
 
 class ProgressScheduler:
@@ -564,6 +567,8 @@ class ProgressScheduler:
                     self._run_engine_batch()
                 elif head.kind == "map":
                     self._run_map_batch()
+                elif head.kind == "array":
+                    self._run_array_batch()
                 else:
                     self._run_inline()
             except BaseException as e:
@@ -659,12 +664,71 @@ class ProgressScheduler:
         for it in batch[:m]:
             self._finish(it, value=it.args[0])
 
+    # -- fused dense small arrays (ISSUE 17) ----------------------------
+    def _run_array_batch(self) -> None:
+        """The array-plane twin of :meth:`_run_map_batch`: consecutive
+        same-signature small ``iallreduce`` submissions arriving within
+        the coalescing window fuse into ONE count-negotiated
+        ``allreduce_array_multi`` exchange; the negotiated first ``m``
+        resolve, leftovers re-queue at the front (submit order
+        preserved — the job-wide collective order)."""
+        s = self._s
+        batch = [self._pop_head()]
+        operand = batch[0].args[1]
+        operator = batch[0].args[2]
+        deadline = time.monotonic() + self._coalesce_s
+        while len(batch) < self._max_out:
+            with self._cv:
+                if not self._pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(min(remaining, 0.002))
+                nxt = self._pending[0] if self._pending else None
+                # only CONSECUTIVE same-signature arrays fuse — the
+                # map batch's rule, for the same job-wide reason
+                if (nxt is not None and nxt.kind == "array"
+                        and nxt.args[1] is operand
+                        and nxt.args[2] is operator):
+                    batch.append(self._pending.popleft())
+                    continue
+                if nxt is not None:
+                    break
+        arrs = [it.args[0] for it in batch]
+        try:
+            m = s.allreduce_array_multi(arrs, operand, operator)
+        except Mp4jFatalError:
+            for it in batch:
+                self._finish(it, exc=s._recovery.fatal_exc(
+                    str(s._recovery.fatal or "fatal abort")))
+            raise
+        except Exception as e:
+            for it in batch:
+                self._finish(it, exc=e)
+            if _is_kill(e):
+                raise
+            return
+        leftovers = batch[m:]
+        if leftovers:
+            with self._cv:
+                self._pending.extendleft(reversed(leftovers))
+        for it in batch[:m]:
+            self._finish(it, value=it.args[0])
+
     # ==================================================================
     # the interleaved raw-plane engine
     # ==================================================================
     def _run_engine_batch(self) -> None:
         s = self._s
         rec = s._recovery
+        tun = s._tuner
+        if tun is not None and tun.dirty:
+            # the batch is ONE collective boundary: pending tuner
+            # decisions (chunk granularity, socket buffers) land
+            # before any member's wire byte moves, exactly where the
+            # blocking wrapper applies them — engine legs then read
+            # the adapted per-link chunk schedule via _chunk_for
+            s._tuner_apply(tun)
         batch: list[_Item] = []
         queues: dict[tuple[int, str], collections.deque] = {}
         touched: dict = {}       # channels switched to nonblocking
@@ -942,8 +1006,8 @@ class ProgressScheduler:
         rec = s._recovery
         for it in batch:
             for op in it.ops:
-                if not op.armed:
-                    self._arm(op, touched)
+                if not op.armed and not self._arm(op, touched):
+                    return False     # peer not dialed in yet
                 for leg in op.legs:
                     if isinstance(leg.ch, shm_mod.ShmChannel):
                         return False     # hybrid loop owns the rings
@@ -963,13 +1027,22 @@ class ProgressScheduler:
                         leg = legs[i]
                         # gate 0: the per-(peer, direction) FIFO
                         # predecessor; gates 1-2: the previous op's
-                        # legs (the collective's own sequencing)
+                        # legs (the collective's own sequencing).
+                        # Only wire-touching legs may anchor the FIFO
+                        # chain: a zero-length leg (an empty rhd
+                        # segment) is "complete" at birth, so a
+                        # successor gated on it would unblock before
+                        # the chain BEHIND it finished — two same-
+                        # (peer, dir) legs ungated at once, and the
+                        # fd slot scan would pair the stream's bytes
+                        # with the wrong collective
                         g = ([last_q.get((leg.peer, leg.dir), -1)]
                              + prev_op[:2])
                         while len(g) < 3:
                             g.append(-1)
                         gates[i * 3:i * 3 + 3] = g
-                        last_q[(leg.peer, leg.dir)] = i
+                        if leg.n > 0:
+                            last_q[(leg.peer, leg.dir)] = i
                     if cur:
                         prev_op = cur
             return legs
@@ -990,13 +1063,16 @@ class ProgressScheduler:
                 *[lg.src.ctypes.data for lg in legs])
             lens = np.fromiter((lg.n for lg in legs), np.int64, n)
             dones = np.fromiter((lg.off for lg in legs), np.int64, n)
-            merged = np.fromiter(
-                (1 if lg.merged else 0 for lg in legs), np.int8, n)
             mdst = (ctypes.c_void_p * n)()
             msrc = (ctypes.c_void_p * n)()
             mdtype = np.zeros(n, np.int32)
             mopcode = np.zeros(n, np.int32)
             mcount = np.zeros(n, np.int64)
+            # chunk-granular native merges (ISSUE 17): the merge step
+            # is the leg's tuner-adapted chunk schedule, the cursor
+            # resumes mid-buffer across rebuilds/handovers
+            mchunk = np.zeros(n, np.int64)
+            melems = np.zeros(n, np.int64)
             for i, lg in enumerate(legs):
                 op = lg.op
                 if lg.dir == "recv" and op.acc is not None:
@@ -1007,6 +1083,10 @@ class ProgressScheduler:
                     mdtype[i] = dt
                     mopcode[i] = oc
                     mcount[i] = op.acc.size
+                    if lg.chunks:
+                        mchunk[i] = lg.chunks[0][1] - lg.chunks[0][0]
+                        melems[i] = (lg.chunks[lg.merged - 1][1]
+                                     if lg.merged else 0)
             status = np.zeros(n, np.int8)
             stall_since = time.monotonic()
             last_total = int(dones.sum())
@@ -1016,10 +1096,10 @@ class ProgressScheduler:
                 try:
                     rc = native.run_legs(
                         fds, dirs, bufs, lens, dones, gates,
-                        mdst, msrc, mdtype, mopcode, mcount, merged,
-                        status, self._wake_r, 0.05)
+                        mdst, msrc, mdtype, mopcode, mcount,
+                        mchunk, melems, status, self._wake_r, 0.05)
                 except Mp4jError as e:
-                    self._sync_full(legs, dones, merged)
+                    self._sync_full(legs, dones, melems)
                     bad = np.flatnonzero(status != 0)
                     peer = (legs[int(bad[0])].peer if bad.size
                             else "?")
@@ -1035,12 +1115,12 @@ class ProgressScheduler:
                     stall_since = time.monotonic()
                 elif timeout is not None and \
                         time.monotonic() - stall_since > timeout:
-                    self._sync_full(legs, dones, merged)
+                    self._sync_full(legs, dones, melems)
                     raise Mp4jTransportError(
                         f"async batch stalled for {timeout}s "
                         f"({int((lens - dones).sum())} bytes pending)")
                 if rc == 2 and admit:
-                    self._sync_full(legs, dones, merged)
+                    self._sync_full(legs, dones, melems)
                     added = False
                     with self._cv:
                         while (self._pending
@@ -1063,8 +1143,16 @@ class ProgressScheduler:
                             return True
                         for it in batch:
                             for op in it.ops:
-                                if not op.armed:
-                                    self._arm(op, touched)
+                                if not op.armed and \
+                                        not self._arm(op, touched):
+                                    # a newcomer whose peer has not
+                                    # dialed in yet: the hybrid loop
+                                    # retries arming each pass
+                                    self._handover_folds(legs)
+                                    self._drive_native(
+                                        batch, queues, touched,
+                                        False, base)
+                                    return True
                                 for leg in op.legs:
                                     if isinstance(
                                             leg.ch,
@@ -1079,7 +1167,7 @@ class ProgressScheduler:
             if grew:
                 continue
             dt_total = time.perf_counter() - t0
-            self._sync_full(legs, dones, merged)
+            self._sync_full(legs, dones, melems)
             # post-hoc stats bookkeeping (the driver ran the bytes;
             # records follow). Wire AUDIT folds never ride this path:
             # verify mode routes to the per-leg loop (see _drive) —
@@ -1100,13 +1188,18 @@ class ProgressScheduler:
             return True
 
     @staticmethod
-    def _sync_full(legs, dones, merged) -> None:
+    def _sync_full(legs, dones, melems) -> None:
         """Mirror the native driver's in-out progress back onto the
-        leg objects (rebuilds and error paths read them)."""
+        leg objects (rebuilds and error paths read them). ``melems``
+        always lands on a chunk boundary — the native merge step IS
+        the leg's chunk schedule — so the chunk cursor is exact."""
         for i, lg in enumerate(legs):
             lg.off = int(dones[i])
-            if merged[i]:
-                lg.merged = len(lg.chunks) or 1
+            done = int(melems[i])
+            if done:
+                lg.merged = (sum(1 for _, hi in lg.chunks
+                                 if hi <= done)
+                             if lg.chunks else 1)
 
     def _handover_folds(self, legs) -> None:
         """Catch the wire folds up before handing a part-run batch to
@@ -1131,12 +1224,12 @@ class ProgressScheduler:
         per-channel queue's head whose op's turn has come) goes down
         to ONE C++ poll loop per pass (``mp4j_progress_multi``), which
         moves bytes on whichever fd is ready and returns on leg
-        completions (or a fence-poll tick); shm ops execute atomically
-        through the blocking chunked primitive (wire-identical to the
-        blocking path at every size — see :meth:`_arm`). This is the
-        engine's fallback when the whole-batch leg-graph driver
-        (:meth:`_drive_full`) cannot express a member; correctness
-        equal, more Python per leg."""
+        completions (or a fence-poll tick); shm legs pump the ring
+        piece/sync-byte schedule chunk-granularly in Python each pass
+        (wire-identical to the blocking path at every size — see
+        :meth:`_pump_shm`). This is the engine's fallback when the
+        whole-batch leg-graph driver (:meth:`_drive_full`) cannot
+        express a member; correctness equal, more Python per leg."""
         import ctypes
 
         s = self._s
@@ -1161,11 +1254,25 @@ class ProgressScheduler:
                 if op.item.cursor != op.idx:
                     continue      # not this collective's turn yet
                 if not op.armed:
-                    self._arm(op, touched)
+                    if not self._arm(op, touched):
+                        continue  # peer not dialed in yet: next pass
                     progressed = True
-                if op.atomic:
-                    if self._try_atomic(op, queues):
+                if isinstance(leg.ch, shm_mod.ShmChannel):
+                    # the rings are not fds: pump in Python each pass
+                    if self._pump_shm(leg):
                         progressed = True
+                    if self._leg_settled(leg):
+                        q.popleft()
+                        self._leg_done(leg)
+                        progressed = True
+                    elif timeout is not None and \
+                            time.monotonic() - leg.last_progress \
+                            > timeout:
+                        to = "to" if leg.dir == "send" else "from"
+                        raise Mp4jTransportError(
+                            f"async {leg.dir} {to} peer {leg.peer} "
+                            f"stalled for {timeout}s (collective "
+                            f"#{leg.op.item.ordinal})")
                     continue
                 if not leg.started:
                     self._leg_start(leg)
@@ -1268,6 +1375,7 @@ class ProgressScheduler:
             progressed = False
             rsel: dict[int, _Leg] = {}
             wsel: dict[int, _Leg] = {}
+            rwait: list[_Leg] = []
             for q in queues.values():
                 if not q:
                     continue
@@ -1276,21 +1384,26 @@ class ProgressScheduler:
                 if op.item.cursor != op.idx:
                     continue      # not this collective's turn yet
                 if not op.armed:
-                    self._arm(op, touched)
+                    if not self._arm(op, touched):
+                        continue  # peer not dialed in yet: next pass
                     progressed = True
-                if op.atomic:
-                    if self._try_atomic(op, queues):
-                        progressed = True
-                    continue
-                moved = (self._pump_send(leg) if leg.dir == "send"
-                         else self._pump_recv(leg))
+                if isinstance(leg.ch, shm_mod.ShmChannel):
+                    moved = self._pump_shm(leg)
+                else:
+                    moved = (self._pump_send(leg) if leg.dir == "send"
+                             else self._pump_recv(leg))
                 if moved:
                     progressed = True
                     leg.last_progress = time.monotonic()
-                if leg.off >= leg.n:
+                if self._leg_settled(leg):
                     q.popleft()
                     self._leg_done(leg)
                     progressed = True
+                elif leg.pump is not None and leg.dir == "send" \
+                        and not leg.pump.want_carrier:
+                    # blocked on ring SPACE (peer reader behind):
+                    # nothing selectable — park on a short tick
+                    rwait.append(leg)
                 else:
                     fd = leg.ch.sock.fileno()
                     (wsel if leg.dir == "send" else rsel)[fd] = leg
@@ -1303,22 +1416,23 @@ class ProgressScheduler:
                     return
                 continue          # admit the newcomers first
             if not progressed:
-                self._park(rsel, wsel)
+                self._park(rsel, wsel, rwait)
 
-    def _park(self, rsel, wsel) -> None:
+    def _park(self, rsel, wsel, rwait=()) -> None:
         if rsel or wsel:
             try:
-                select.select(list(rsel), list(wsel), [], 0.02)
+                select.select(list(rsel), list(wsel), [],
+                              0.002 if rwait else 0.02)
             except (OSError, ValueError):
                 # a torn-down fd (abort teardown raced the select):
                 # the next pump raises a clean transport error
                 time.sleep(0.001)
         else:
-            time.sleep(0.001)
+            time.sleep(0.0005 if rwait else 0.001)
         timeout = self._s._peer_timeout
         if timeout is not None:
             now = time.monotonic()
-            for leg in [*rsel.values(), *wsel.values()]:
+            for leg in [*rsel.values(), *wsel.values(), *rwait]:
                 if now - leg.last_progress > timeout:
                     to = "to" if leg.dir == "send" else "from"
                     raise Mp4jTransportError(
@@ -1327,34 +1441,42 @@ class ProgressScheduler:
                         f"#{leg.op.item.ordinal})")
 
     # -- arming ---------------------------------------------------------
-    def _arm(self, op: _Op, touched: dict) -> None:
+    def _arm(self, op: _Op, touched: dict) -> bool:
         """Bind the op's channels NOW, under the epoch fence (the PR 5
         submit-time-binding discipline: an op from an aborted attempt
         must die with its own epoch's channel, never late-resolve a
         fresh one), resolve buffers, and flip TCP sockets nonblocking
-        for the poll loop."""
+        for the poll loop.
+
+        Channel binding is NON-blocking: when an accept-side channel
+        has not been dialed yet this returns False and the op stays
+        queued for a later pass — parking the progression thread here
+        would stop every other leg it owns, and the missing dial can
+        be cursor-gated behind exactly those legs' bytes on the peer
+        (a cross-rank establishment/byte deadlock). A dead peer still
+        surfaces: the deferral clock raises after the job timeout."""
         s = self._s
+        chans = []
+        for leg in op.legs:
+            ch = s._fenced_try(leg.peer)
+            if ch is None:
+                now = time.monotonic()
+                if op.wait_since is None:
+                    op.wait_since = now
+                elif s._timeout is not None and \
+                        now - op.wait_since > s._timeout:
+                    raise Mp4jTransportError(
+                        f"timeout waiting for peer {leg.peer} to "
+                        f"connect (collective #{op.item.ordinal})")
+                return False
+            chans.append(ch)
+        op.wait_since = None
         sarr = op.sarr() if callable(op.sarr) else op.sarr
-        atomic = False
-        for leg in op.legs:
-            leg.ch = s._fenced(leg.peer)
-            if isinstance(leg.ch, shm_mod.ShmChannel):
-                # shm ops execute as ONE blocking _chunked_exchange
-                # step: the ring/carrier routing is a per-exchange
-                # size rule, so the engine must ship the EXACT same
-                # exchange schedule as the blocking path or the two
-                # ends of a mixed engine/blocking pair would route a
-                # tail chunk differently (ring on one side, carrier on
-                # the other) and deadlock
-                atomic = True
-        op.atomic = atomic
-        for leg in op.legs:
+        for leg, ch in zip(op.legs, chans):
+            leg.ch = ch
             if leg.dir == "send":
                 leg.src = (np.ascontiguousarray(sarr)
                            if sarr is not None else None)
-        if atomic:
-            op.armed = True
-            return
         if op.acc is not None and op.rbuf is None:
             op.rbuf = s._scratch.take(op.acc.dtype, op.acc.size)
         for leg in op.legs:
@@ -1364,8 +1486,14 @@ class ProgressScheduler:
                 dst = op.rbuf if op.acc is not None else op.rdst
                 leg.src = dst
                 leg.view = memoryview(_raw_view(dst)).cast("B")
+                # per-LINK chunk granularity (ISSUE 17): merge (and,
+                # on shm, wire) boundaries follow the tuner's adapted
+                # decision exactly like the blocking _chunked_exchange
+                # — merges are element-wise, so any partition is
+                # bit-exact; shm links pin the job default (_chunk_for)
                 leg.chunks = tuning.chunk_ranges(
-                    dst.size, dst.dtype.itemsize, s._chunk_bytes)
+                    dst.size, dst.dtype.itemsize,
+                    s._chunk_for(leg.peer))
             leg.n = len(leg.view)
             leg.last_progress = time.monotonic()
             if leg.ch not in touched:
@@ -1375,6 +1503,7 @@ class ProgressScheduler:
         op.armed = True
         if not op.legs:           # pragma: no cover - degenerate op
             self._op_done(op)
+        return True
 
     def _leg_start(self, leg: _Leg) -> None:
         """First-byte hooks: the send-side audit fold (BEFORE any
@@ -1518,53 +1647,57 @@ class ProgressScheduler:
                 it.name, time.perf_counter() - it.t0)
             self._finish(it, value=it.payload)
 
-    # -- atomic (shm) ops ----------------------------------------------
-    def _try_atomic(self, op: _Op, queues) -> bool:
-        """Execute an op whose channel(s) ride the shm rings through
-        the blocking chunked primitive, as ONE step: the rings are
-        same-host memcpys driven by ``duplex_exchange``'s own event
-        loop, and slicing them across scheduler passes would re-pay the
-        carrier-wakeup latency per slice. Requires every leg of the op
-        to be at its queue head (the wire-order invariant)."""
+    # -- shm chunk pumps (ISSUE 17) -------------------------------------
+    @staticmethod
+    def _leg_settled(leg: _Leg) -> bool:
+        """Retirable: every wire byte moved — for a shm pump leg that
+        includes owed carrier sync bytes, which must flush before a
+        later leg on the same (peer, dir) queue may touch the carrier
+        stream (the per-direction protocol order)."""
+        return leg.off >= leg.n and (leg.pump is None
+                                     or leg.pump.done)
+
+    def _pump_shm(self, leg: _Leg) -> int:
+        """Drive one shm engine leg through its nonblocking chunk pump
+        (:class:`transport.shm.SendPump`/``RecvPump``). The chunk
+        bounds are the SAME per-link schedule the blocking
+        ``_chunked_exchange`` derives (``_chunk_for``; shm links pin
+        the job default), and each chunk routes ring-vs-carrier by the
+        same size rule — so the per-direction wire streams are
+        bit-identical to the blocking twin's and a mixed
+        engine/blocking pair cannot desync."""
         s = self._s
-        for leg in op.legs:
-            q = queues.get((leg.peer, leg.dir))
-            if q is None or not q or q[0] is not leg:
-                return False
-        sarr = next((leg.src for leg in op.legs
-                     if leg.dir == "send"), None)
-        if op.acc is not None and op.rbuf is None:
-            op.rbuf = s._scratch.take(op.acc.dtype, op.acc.size)
-        rarr = op.rbuf if op.acc is not None else op.rdst
-        wire_on = s._audit is not None and s._audit.wire_on
-        with s._comm_stats.scope(op.item.name, op.item.seq):
-            # no on_chunk: the merge runs AFTER the exchange so the
-            # received bytes can fold into the item's own accumulator
-            # first (a ring merge mutates the scratch in place);
-            # element-wise the one-shot merge is identical
-            s._chunked_exchange(
-                op.sp if op.sp is not None else op.rp,
-                op.rp if op.rp is not None else op.sp,
-                sarr, rarr, on_chunk=None)
-        if wire_on:
-            # the primitive folded into the SHARED per-collective
-            # accumulators, which interleaved collectives cannot
-            # share — drop those and refold into the item's own
-            s._audit.reset_wire()
-            for leg in op.legs:
-                arr = sarr if leg.dir == "send" else rarr
-                if arr is not None:
-                    op.item.fold(leg.peer, leg.dir,
-                                 memoryview(_raw_view(arr)).cast("B"),
+        if not leg.started:
+            self._leg_start(leg)
+        pump = leg.pump
+        if pump is None:
+            # built AFTER _leg_start: an injected send corruption
+            # swaps leg.view, and the pump must ship what the fault
+            # actually put on the wire
+            isz = leg.src.dtype.itemsize
+            chunks = leg.chunks or tuning.chunk_ranges(
+                leg.src.size, isz, s._chunk_for(leg.peer))
+            bounds = [(lo * isz, hi * isz) for lo, hi in chunks]
+            cls = (shm_mod.SendPump if leg.dir == "send"
+                   else shm_mod.RecvPump)
+            pump = leg.pump = cls(leg.ch, leg.view, bounds)
+        prev = leg.off
+        t0 = time.perf_counter()
+        try:
+            moved = pump.pump()
+        finally:
+            leg.busy += time.perf_counter() - t0
+        leg.off = pump.off
+        if moved:
+            leg.last_progress = time.monotonic()
+        if leg.dir == "recv" and leg.off > prev:
+            if s._audit is not None and s._audit.wire_on:
+                # fold arrivals BEFORE any merge mutates the scratch
+                leg.op.item.fold(leg.peer, "recv",
+                                 leg.view[prev:leg.off],
                                  leg.ch.transport)
-        if op.acc is not None:
-            op.merge_chunk(s._comm_stats, op.item.name, 0,
-                           op.acc.size)
-        for leg in op.legs:
-            queues[(leg.peer, leg.dir)].popleft()
-        op.pending_legs = 0
-        self._op_done(op)
-        return True
+            self._merge_ready(leg)
+        return moved
 
 
 def _is_kill(e: BaseException) -> bool:
@@ -1596,15 +1729,13 @@ def engine_eligible(s, name: str, args: tuple, kwargs: dict) -> bool:
     native-transport build) without any cross-rank agreement."""
     if s._n <= 1 or s._use_twolevel():
         return False
-    if s._shm and s._fp and len(s._members) > 1:
-        # shm-paired jobs run i* INLINE in submit order: the shm
-        # ring/carrier routing makes every exchange a blocking step,
-        # and a scheduler blocked inside collective k+1's exchange
-        # cannot serve its collective-k legs on other channels — an
-        # interleave-induced cycle the all-TCP engine (nonblocking
-        # fds) is immune to. Inline execution is wire-identical to
-        # the blocking path and still asynchronous to the caller.
-        return False
+    # shm-paired jobs ride the engine too (ISSUE 17): a leg on a
+    # ShmChannel pumps the ring piece/sync-byte schedule chunk-
+    # granularly (transport.shm.SendPump/RecvPump) instead of
+    # executing the exchange as one blocking step, so the scheduler
+    # keeps serving collective k's legs while k+1's ring pieces
+    # stream — the interleave-induced cycle that once forced shm
+    # submissions inline cannot form against nonblocking pumps.
     if name not in ("allreduce_array", "reduce_scatter_array",
                     "allgather_array", "gather_array"):
         return False

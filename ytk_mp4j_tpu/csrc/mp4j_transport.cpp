@@ -290,8 +290,15 @@ int mp4j_progress_multi(const int32_t* fds, const int32_t* dirs,
 // both orderings the engine needs: the per-(peer, direction) FIFO (a
 // leg's queue predecessor) and the per-collective op sequence (the
 // previous op's legs); a leg joins the poll set only once every gate
-// leg has completed. A completed recv leg with a merge spec reduces
-// natively (mp4j_reduce) before its dependents unblock.
+// leg has completed. A recv leg with a merge spec reduces natively
+// (mp4j_reduce) CHUNK-GRANULARLY as bytes arrive: mchunk[i] is the
+// merge step in elements (the per-link tuner-adapted chunk schedule;
+// 0 = whole buffer), melems[i] the in-out merge cursor — every fully
+// received chunk merges in the same pass, so the tail chunk's merge
+// is all that remains at leg completion and dependents still only
+// unblock behind a fully merged accumulator. mp4j_reduce is
+// element-wise, so any chunk partition is bit-identical to the
+// whole-buffer merge.
 //
 // Returns: 1 = every leg complete; 0 = timeout tick (caller polls the
 // epoch fence and re-enters); 2 = wake_fd readable (new submissions to
@@ -302,13 +309,36 @@ int mp4j_progress_multi(const int32_t* fds, const int32_t* dirs,
 extern "C" int mp4j_reduce(int32_t dtype, int32_t op, void* acc,
                            const void* src, int64_t n);
 
+static void merge_avail(void** mdst, void** msrc, const int32_t* mdtype,
+                        const int32_t* mopcode, const int64_t* mcount,
+                        const int64_t* mchunk, int64_t* melems,
+                        const int64_t* lens, const int64_t* dones,
+                        int ri) {
+  if (mdst[ri] == nullptr || melems[ri] >= mcount[ri]) return;
+  const int64_t isz = mcount[ri] > 0 ? lens[ri] / mcount[ri] : 0;
+  if (isz <= 0) return;
+  const int64_t avail = dones[ri] / isz;
+  const int64_t step = mchunk[ri] > 0 ? mchunk[ri] : mcount[ri];
+  while (melems[ri] < mcount[ri]) {
+    int64_t hi = melems[ri] + step;
+    if (hi > mcount[ri]) hi = mcount[ri];
+    if (avail < hi) break;
+    mp4j_reduce(mdtype[ri], mopcode[ri],
+                static_cast<char*>(mdst[ri]) + melems[ri] * isz,
+                static_cast<const char*>(msrc[ri]) + melems[ri] * isz,
+                hi - melems[ri]);
+    melems[ri] = hi;
+  }
+}
+
 extern "C" int mp4j_run_legs(const int32_t* fds, const int32_t* dirs,
                              void** bufs, const int64_t* lens,
                              int64_t* dones, const int32_t* gates,
                              void** mdst, void** msrc,
                              const int32_t* mdtype,
                              const int32_t* mopcode,
-                             const int64_t* mcount, int8_t* merged,
+                             const int64_t* mcount,
+                             const int64_t* mchunk, int64_t* melems,
                              int8_t* status, int32_t nlegs,
                              int32_t wake_fd, int64_t timeout_ms) {
   const int64_t deadline = now_ms() + (timeout_ms < 0 ? 0 : timeout_ms);
@@ -388,11 +418,8 @@ extern "C" int mp4j_run_legs(const int32_t* fds, const int32_t* dirs,
           status[ri] = static_cast<int8_t>(rc);
           return rc;
         }
-        if (dones[ri] >= lens[ri] && mdst[ri] != nullptr && !merged[ri]) {
-          merged[ri] = 1;
-          mp4j_reduce(mdtype[ri], mopcode[ri], mdst[ri], msrc[ri],
-                      mcount[ri]);
-        }
+        merge_avail(mdst, msrc, mdtype, mopcode, mcount, mchunk,
+                    melems, lens, dones, ri);
       }
       int si = leg_send[j];
       if (si >= 0 && (rev & POLLOUT) && dones[si] < lens[si]) {

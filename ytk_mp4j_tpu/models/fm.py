@@ -45,7 +45,8 @@ from jax.sharding import PartitionSpec as P
 
 from ytk_mp4j_tpu.exceptions import Mp4jError
 from ytk_mp4j_tpu.models._base import (DataParallelTrainer,
-                                       EarlyStopper, per_example_loss)
+                                       EarlyStopper, StepStatsExchanger,
+                                       per_example_loss)
 from ytk_mp4j_tpu.operators import Operators
 from ytk_mp4j_tpu.ops import sparse as sparse_ops
 
@@ -554,7 +555,7 @@ class FMTrainer(DataParallelTrainer):
     def fit(self, feats, fields, vals, y, n_steps: int = 100, params=None,
             seed: int = 0, eval_set=None,
             early_stopping_rounds: int | None = None,
-            sample_weight=None):
+            sample_weight=None, comm=None):
         """Full-batch training; returns (params, losses).
 
         ``eval_set=(feats_va, fields_va, vals_va, y_va)`` evaluates the
@@ -563,6 +564,13 @@ class FMTrainer(DataParallelTrainer):
         k non-improving steps and returns the best round's params;
         ``sample_weight`` ([N]) weights each example's loss/gradient
         (integer weights == row duplication).
+
+        ``comm`` (an mp4j comm; every rank calls ``fit`` together)
+        syncs each step's training loss across the job into
+        ``self.sync_loss_history_`` — under ``MP4J_OVERLAP=1`` the
+        exchange is submitted nonblocking and overlaps the next step's
+        device compute (bit-identical results; see
+        ``models._base.StepStatsExchanger``).
         """
         if early_stopping_rounds is not None and eval_set is None:
             raise Mp4jError("early_stopping_rounds requires an eval_set")
@@ -583,17 +591,26 @@ class FMTrainer(DataParallelTrainer):
             va = self._prep_eval(*eval_set)
         stopper = EarlyStopper(early_stopping_rounds)
         self.eval_history_ = stopper.history
+        exchanger = StepStatsExchanger(comm)
         losses = []
         for i in range(n_steps):
             params, loss = self._step(params, *sharded)
             # bound in-flight programs; see models/linear.py fit()
-            losses.append(jax.block_until_ready(loss))
+            loss = jax.block_until_ready(loss)
+            # step k's host-stats exchange: blocking, or (MP4J_OVERLAP=1)
+            # in flight while step k+1 runs the device
+            exchanger.submit(np.array([float(loss)], np.float64))
+            losses.append(loss)
             if va is not None and stopper.update(
                     self._eval_loss(params, va), i, state=params):
                 if stopper.best_state is not None:
                     params = stopper.best_state
                     losses = losses[:stopper.best_round + 1]
                 break
+        exchanger.drain()
+        hist = exchanger.mean_history()
+        self.sync_loss_history_ = (hist[:, 0] if hist.size
+                                   else np.zeros(0, np.float64))
         return params, np.asarray(jax.device_get(losses))
 
     def fit_stream(self, batches, params=None, seed: int = 0,

@@ -844,3 +844,246 @@ def _eof(ch: ShmChannel, pending: int) -> None:
     raise Mp4jTransportError(
         f"peer closed shm carrier mid-exchange{ch._whom()} "
         f"({pending} bytes pending; peer process dead?)")
+
+
+# ----------------------------------------------------------------------
+# engine-leg pumps (ISSUE 17): ONE DIRECTION of the async engine's
+# chunk-granular shm schedule, nonblocking. The async raw engine
+# (comm/progress.py) decouples an exchange into independent send/recv
+# legs with per-(peer, direction) FIFO queues; these pumps give a shm
+# leg the same incremental, never-blocking contract a nonblocking TCP
+# socket gives a tcp leg — so shm-paired collectives can interleave on
+# the engine instead of executing as one atomic blocking step.
+#
+# Wire contract: the per-direction byte streams are IDENTICAL to the
+# blocking chunked exchange's. The leg's payload splits at the same
+# chunk boundaries (`_chunk_for(peer)` element ranges, passed in as
+# byte bounds), and each chunk routes exactly like one
+# `_exchange_raw` step: below `_RING_MIN` the chunk's raw bytes ride
+# the carrier; at or above, the chunk moves through the SPSC ring in
+# the shared `_pieces` schedule with ONE carrier sync byte per
+# completed piece. Chunks complete strictly in order — chunk k's
+# carrier traffic (payload or sync bytes) fully precedes chunk k+1's,
+# which is the per-direction stream order the blocking twin emits — so
+# a mixed engine/blocking pair can never desync.
+# ----------------------------------------------------------------------
+class SendPump:
+    """Nonblocking chunk-granular sender for one engine leg.
+
+    ``pump()`` moves whatever can move RIGHT NOW (ring space, carrier
+    writability) and returns the payload bytes shipped; ``done`` flips
+    only once the payload AND every owed sync byte are flushed —
+    retiring a leg with syncs pending would let the next leg on the
+    same (peer, send) queue jump the carrier stream. The caller owns
+    waits (select on ``want_carrier``, short ticks for ``ring_wait``)
+    and stall deadlines."""
+
+    __slots__ = ("ch", "view", "bounds", "ci", "off", "sync_due",
+                 "ring", "pieces", "piece_idx", "piece_end")
+
+    def __init__(self, ch: ShmChannel, view: memoryview,
+                 bounds: list[tuple[int, int]]):
+        self.ch = ch
+        self.view = view
+        self.bounds = bounds      # ascending byte (lo, hi) chunk bounds
+        self.ci = -1
+        self.off = 0              # payload bytes shipped (ring+carrier)
+        self.sync_due = 0         # sync bytes owed to the carrier
+        self.ring = False
+        self.pieces: list[int] = []
+        self.piece_idx = 0
+        self.piece_end = 0
+        self._next_chunk()
+
+    def _next_chunk(self) -> None:
+        self.ci += 1
+        if self.ci >= len(self.bounds):
+            return
+        lo, hi = self.bounds[self.ci]
+        self.ring = hi - lo >= _RING_MIN
+        if self.ring:
+            self.ch._check_poison("send")
+            self.pieces = self.ch._pieces(hi - lo)
+            self.piece_idx = 0
+            self.piece_end = lo + self.pieces[0]
+
+    @property
+    def done(self) -> bool:
+        return self.ci >= len(self.bounds) and self.sync_due == 0
+
+    @property
+    def want_carrier(self) -> bool:
+        """Parking hint: carrier writability would unblock us."""
+        return self.sync_due > 0 or (self.ci < len(self.bounds)
+                                     and not self.ring)
+
+    @property
+    def ring_wait(self) -> bool:
+        """Parking hint: blocked on ring SPACE only (peer reader
+        behind) — nothing selectable; the caller should tick short."""
+        return (self.sync_due == 0 and self.ci < len(self.bounds)
+                and self.ring)
+
+    def _flush_syncs(self) -> int:
+        try:
+            sent = self.ch.sock.send(b"\x01" * self.sync_due)
+        except (BlockingIOError, InterruptedError):
+            return 0
+        except OSError as e:
+            raise Mp4jTransportError(
+                f"shm carrier failed mid-send{self.ch._whom()}: {e}"
+            ) from None
+        self.sync_due -= sent
+        return sent
+
+    def pump(self) -> int:
+        ch = self.ch
+        moved = 0
+        while True:
+            # owed sync bytes first: they precede every later chunk's
+            # bytes in this direction's carrier stream
+            if self.sync_due:
+                if not self._flush_syncs():
+                    if ch._tx.poisoned or ch._rx.poisoned:
+                        ch._raise_poisoned(
+                            "send", self.bounds[-1][1] - self.off)
+                    return moved
+                if self.sync_due:
+                    return moved
+                continue
+            if self.ci >= len(self.bounds):
+                return moved
+            lo, hi = self.bounds[self.ci]
+            if not self.ring:
+                try:
+                    sent = ch.sock.send(self.view[self.off:hi])
+                except (BlockingIOError, InterruptedError):
+                    return moved
+                except OSError as e:
+                    raise Mp4jTransportError(
+                        f"shm carrier failed mid-send"
+                        f"{ch._whom()}: {e}") from None
+                if not sent:
+                    return moved
+                self.off += sent
+                moved += sent
+                if self.off >= hi:
+                    self._next_chunk()
+                continue
+            if self.off >= hi and self.piece_idx >= len(self.pieces):
+                # every piece written and synced: chunk complete
+                if ch.stats is not None:
+                    ch.stats.add("wire_bytes_shm_ring", hi - lo)
+                self._next_chunk()
+                continue
+            w = ch._tx.write_some(self.view, self.off,
+                                  self.piece_end - self.off)
+            if not w:
+                if ch._tx.poisoned or ch._rx.poisoned:
+                    ch._raise_poisoned("send",
+                                       self.bounds[-1][1] - self.off)
+                return moved      # ring full: reader behind but awake
+            self.off += w
+            moved += w
+            if self.off == self.piece_end:
+                # piece complete -> ONE kernel-grade wakeup owed
+                self.sync_due += 1
+                self.piece_idx += 1
+                if self.piece_idx < len(self.pieces):
+                    self.piece_end += self.pieces[self.piece_idx]
+
+
+class RecvPump:
+    """Nonblocking chunk-granular receiver for one engine leg (the
+    :class:`SendPump` mirror). Payload lands in ``view`` in ascending
+    contiguous order — ring pieces copy straight into the destination
+    (the zero-copy receive), so the caller can fold/merge the
+    ``[prev, off)`` delta after every ``pump()``. Never reads past the
+    current chunk's carrier traffic: a greedy read could swallow the
+    NEXT chunk's raw payload along with this one's sync bytes."""
+
+    __slots__ = ("ch", "view", "bounds", "ci", "off",
+                 "ring", "pieces", "piece_idx", "sync_got")
+
+    def __init__(self, ch: ShmChannel, view: memoryview,
+                 bounds: list[tuple[int, int]]):
+        self.ch = ch
+        self.view = view
+        self.bounds = bounds
+        self.ci = -1
+        self.off = 0              # payload bytes landed in view
+        self.ring = False
+        self.pieces: list[int] = []
+        self.piece_idx = 0
+        self.sync_got = 0         # synced pieces not yet drained
+        self._next_chunk()
+
+    def _next_chunk(self) -> None:
+        self.ci += 1
+        if self.ci >= len(self.bounds):
+            return
+        lo, hi = self.bounds[self.ci]
+        self.ring = hi - lo >= _RING_MIN
+        if self.ring:
+            self.ch._check_poison("recv")
+            self.pieces = self.ch._pieces(hi - lo)
+            self.piece_idx = 0
+            self.sync_got = 0
+
+    @property
+    def done(self) -> bool:
+        return self.ci >= len(self.bounds)
+
+    def pump(self) -> int:
+        ch = self.ch
+        moved = 0
+        while True:
+            if self.ci >= len(self.bounds):
+                return moved
+            lo, hi = self.bounds[self.ci]
+            if not self.ring:
+                try:
+                    got = ch.sock.recv_into(self.view[self.off:hi],
+                                            hi - self.off)
+                except (BlockingIOError, InterruptedError):
+                    return moved
+                except OSError as e:
+                    raise Mp4jTransportError(
+                        f"shm carrier failed mid-receive"
+                        f"{ch._whom()}: {e}") from None
+                if got == 0:
+                    _eof(ch, self.bounds[-1][1] - self.off)
+                self.off += got
+                moved += got
+                if self.off >= hi:
+                    self._next_chunk()
+                continue
+            # drain every synced ring piece straight into the view
+            while self.sync_got:
+                size = self.pieces[self.piece_idx]
+                ch._rx.read_exact(self.view, self.off, size)
+                self.off += size
+                self.piece_idx += 1
+                self.sync_got -= 1
+                moved += size
+            if self.off >= hi:
+                if ch.stats is not None:
+                    ch.stats.add("wire_bytes_shm_ring", hi - lo)
+                self._next_chunk()
+                continue
+            # sync bytes: bounded to THIS chunk's remaining pieces
+            want = len(self.pieces) - self.piece_idx - self.sync_got
+            try:
+                data = ch.sock.recv(want)
+            except (BlockingIOError, InterruptedError):
+                if ch._tx.poisoned or ch._rx.poisoned:
+                    ch._raise_poisoned(
+                        "recv", self.bounds[-1][1] - self.off)
+                return moved
+            except OSError as e:
+                raise Mp4jTransportError(
+                    f"shm carrier failed mid-receive"
+                    f"{ch._whom()}: {e}") from None
+            if not data:
+                _eof(ch, self.bounds[-1][1] - self.off)
+            self.sync_got += len(data)

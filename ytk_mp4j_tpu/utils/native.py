@@ -106,8 +106,8 @@ def _load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int64,
         ]
         lib.mp4j_parse_libsvm.restype = ctypes.c_int64
         lib.mp4j_parse_libsvm.argtypes = [
@@ -252,13 +252,16 @@ def progress_multi(fds: np.ndarray, dirs: np.ndarray, bufs,
 
 
 def run_legs(fds, dirs, bufs, lens, dones, gates, mdst, msrc, mdtype,
-             mopcode, mcount, merged, status, wake_fd: int,
+             mopcode, mcount, mchunk, melems, status, wake_fd: int,
              timeout: float) -> int:
     """Drive a whole engine batch's leg graph natively (ISSUE 11; see
-    ``csrc/mp4j_transport.cpp mp4j_run_legs``). Returns 1 (all legs
+    ``csrc/mp4j_transport.cpp mp4j_run_legs``). Reduce-merges run
+    chunk-granularly as bytes land: ``mchunk`` is the per-leg merge
+    step in elements (the tuner-adapted chunk schedule; 0 = whole
+    buffer), ``melems`` the in-out merge cursor. Returns 1 (all legs
     complete), 0 (timeout tick — poll the fence and re-enter) or 2
     (``wake_fd`` readable — new submissions to admit); raises on wire
-    failure. ``dones``/``merged`` are in-out, so the call is
+    failure. ``dones``/``melems`` are in-out, so the call is
     re-entrant."""
     lib = _load()
     rc = lib.mp4j_run_legs(
@@ -273,7 +276,8 @@ def run_legs(fds, dirs, bufs, lens, dones, gates, mdst, msrc, mdtype,
         ctypes.c_void_p(mdtype.ctypes.data),
         ctypes.c_void_p(mopcode.ctypes.data),
         ctypes.c_void_p(mcount.ctypes.data),
-        ctypes.c_void_p(merged.ctypes.data),
+        ctypes.c_void_p(mchunk.ctypes.data),
+        ctypes.c_void_p(melems.ctypes.data),
         ctypes.c_void_p(status.ctypes.data),
         int(fds.size), wake_fd, max(1, int(timeout * 1000)))
     if rc < 0:

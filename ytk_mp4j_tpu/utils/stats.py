@@ -86,12 +86,16 @@ _PHASES = ("wire_seconds", "reduce_seconds", "serialize_seconds")
 # frame-routed payload units) rather than the pair's TCP carrier — the
 # acceptance evidence that the framed/columnar-map planes actually
 # ride the rings for co-located pairs.
+# coalesced_elems (ISSUE 17): elements shipped by fused
+# allreduce_array_multi batches that merged >= 2 arrays — the array
+# plane's analogue of the map plane's keys-under-coalescing evidence.
 _COUNTERS = ("calls", "bytes_sent", "bytes_recv", "chunks", "keys",
              "retries", "reconnects", "aborts_seen",
              "replacements_seen", "shrinks_seen",
              "wire_bytes_tcp", "wire_bytes_shm",
              "wire_bytes_shm_ring",
              "outstanding_peak", "coalesced_frames",
+             "coalesced_elems",
              "async_inflight", "async_overlap")
 
 # transports the wire split books (ISSUE 7); anything else (bare test

@@ -88,7 +88,7 @@ COLLECTIVE_METHODS = (
     "allreduce_array", "reduce_array", "broadcast_array",
     "allgather_array", "gather_array", "scatter_array",
     "reduce_scatter_array", "allreduce_map", "allreduce_map_async",
-    "allreduce_map_multi",
+    "allreduce_map_multi", "allreduce_array_multi",
     "reduce_map", "broadcast_map", "gather_map", "allgather_map",
     "scatter_map", "reduce_scatter_map", "barrier", "thread_barrier",
 )

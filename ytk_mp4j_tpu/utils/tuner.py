@@ -76,6 +76,16 @@ CHUNK_TARGET_DIV = 4       # target chunk ~ avg transfer / 4
 # leader's LINK must gate (slow rows only) before demotion
 LEADER_WINDOW = 16
 LEADER_SHARE = 0.75
+# socket-buffer policy (ISSUE 17): on a sustained-bulk tcp link whose
+# applied sndbuf/rcvbuf sit below the observed bandwidth-delay
+# product, raise them toward it — one doubling per sustained verdict,
+# raise-only (shrinking buffers under load thrashes the kernel), and
+# capped. RTT is not measured per link; SOCKBUF_RTT_S is the assumed
+# in-flight window a bulk stream must cover (1 ms spans same-DC hops;
+# loopback links simply never sustain a BDP above their buffers).
+SOCKBUF_RTT_S = 1e-3
+SOCKBUF_BULK_BYTES = 4 * 1024 * 1024   # window floor to call it bulk
+SOCKBUF_MAX = 8 * 1024 * 1024
 
 
 # -- roster topology (shared with comm + master) ----------------------
@@ -241,6 +251,20 @@ def _proposals(delta: dict, state: dict, default_chunk: int) -> dict:
             elif target <= cur_chunk // 2 \
                     and cur_chunk // 2 >= CHUNK_MIN:
                 out["chunk_bytes"] = cur_chunk // 2
+    # socket buffers: a sustained-bulk tcp link whose applied buffers
+    # sit below the observed bandwidth-delay product cannot keep its
+    # pipe full — raise toward the BDP, one doubling per sustained
+    # verdict, raise-only, capped (SOCKBUF_MAX). The applied sizes are
+    # FACTS in the window (note_link re-reads them after every apply),
+    # so the ladder converges and never flaps.
+    if (delta.get("transport") == "tcp" and not delta.get("bytes_shm")
+            and secs > 0 and bytes_ >= SOCKBUF_BULK_BYTES):
+        bdp = bytes_ / secs * SOCKBUF_RTT_S
+        for key in ("so_sndbuf", "so_rcvbuf"):
+            cur_buf = int(delta.get(key) or 0)
+            if cur_buf and cur_buf < SOCKBUF_MAX \
+                    and bdp >= cur_buf * 2:
+                out[key] = min(SOCKBUF_MAX, cur_buf * 2)
     return out
 
 
@@ -271,8 +295,12 @@ def decide_link(delta: dict, state: dict, default_chunk: int
     if props.get("compress") is False:
         # the commit that starts (or continues) the probe phase
         state["probing"] = state.get("plain_gbs") is None
-    return state, {"compress": state["compress"],
-                   "chunk_bytes": state["chunk_bytes"]}
+    decision = {"compress": state["compress"],
+                "chunk_bytes": state["chunk_bytes"]}
+    for k in ("so_sndbuf", "so_rcvbuf"):
+        if state.get(k):
+            decision[k] = state[k]
+    return state, decision
 
 
 # -- leader demotion policy (the PR 9 follow-up) ----------------------

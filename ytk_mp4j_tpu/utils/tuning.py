@@ -148,12 +148,28 @@ Knobs (all validated where they are consumed; garbage raises
 - ``MP4J_COALESCE_USECS`` — the small-message coalescing window
   (ISSUE 11): ``iallreduce_map`` submissions arriving within this
   many microseconds fuse into ONE ``allreduce_map_multi`` negotiation
-  + columnar frame train, de-fused on completion. ``0`` (default)
-  disables fusion (every ``iallreduce_map`` runs the classic
-  single-map plane). JOB-wide like ``native_transport``: whether a
-  map collective call uses the count-negotiating multi protocol or
-  the classic one must match on every rank (the negotiated batch
-  size then absorbs ragged coalescing depth).
+  + columnar frame train, de-fused on completion. ISSUE 17 extends
+  the same window to the ARRAY plane: consecutive same-signature
+  small ``iallreduce`` submissions fuse into one count-negotiated
+  ``allreduce_array_multi`` exchange (tree schedule — the one their
+  sizes resolve to individually, so fused == sequential bit-exact).
+  ``0`` (default) disables fusion (every ``iallreduce_map`` runs the
+  classic single-map plane, every small ``iallreduce`` its own tree
+  walk). JOB-wide like ``native_transport``: whether a collective
+  call uses the count-negotiating multi protocol or the classic one
+  must match on every rank (the negotiated batch size then absorbs
+  ragged coalescing depth).
+- ``MP4J_OVERLAP`` — trainer-loop compute/communication overlap
+  (ISSUE 17; ``models/_base.py``): ``1`` submits each step's
+  host-statistics exchange as nonblocking ``iallreduce`` /
+  ``iallreduce_map`` futures and drains them at the NEXT step
+  boundary (``wait_all``), so the progression thread drives the wire
+  while the device runs step k+1; ``0`` (default) keeps today's
+  blocking per-step exchange bit-for-bit. A LOCAL execution-strategy
+  knob like ``MP4J_ASYNC``: submit order equals collective order on
+  every rank either way, only the wait point moves, so ranks need
+  not agree. Frozen bench legs pin it off (the shm/audit/sink/
+  health/autoscale/tuner precedent).
 - ``MP4J_MAX_OUTSTANDING`` — how many nonblocking collectives may be
   queued + in flight per slave before ``i*`` submission blocks
   (backpressure); also caps the engine batch and the coalescing
@@ -710,6 +726,21 @@ def coalesce_usecs() -> int:
     between the classic and the count-negotiating multi map protocol,
     so every rank must agree."""
     return env_int("MP4J_COALESCE_USECS", 0, minimum=0)
+
+
+def overlap_enabled() -> bool:
+    """Whether the trainer epoch loops overlap each step's host
+    statistics exchange with the next step's compute
+    (``MP4J_OVERLAP``); ``0``/unset keeps the blocking per-step
+    exchange. Local wait-point strategy — wire-identical either
+    way (submit order == collective order on every rank)."""
+    raw = os.environ.get("MP4J_OVERLAP")
+    if raw is None or raw.strip() == "":
+        return False
+    val = raw.strip()
+    if val not in ("0", "1"):
+        raise Mp4jError(f"MP4J_OVERLAP={raw!r} must be 0 or 1")
+    return val == "1"
 
 
 def max_outstanding() -> int:
