@@ -1256,6 +1256,103 @@ def bench_health_overhead(rounds=2):
     }
 
 
+def bench_fleet_scrape(procs=4, sweeps=60, size=65_536):
+    """ISSUE 18 observability figure: one full ``FleetPoller`` sweep
+    (fetch ``/metrics.json`` + ``/health.json``, fold the job summary,
+    rebuild the fleet model, run contention detection) against a LIVE
+    ``procs``-rank job running an allreduce loop in this process —
+    p50/p99 sweep latency plus the scrape loop's CPU share at the
+    default poll cadence. The poller rides HTTP out of band, so no
+    frozen socket leg arms it; this leg is the fleet plane's own
+    figure, gated via ``fleet_scrape_p99_ms`` so a fold/detector
+    regression (an accidental O(n^2) pass, an unbounded fetch) cannot
+    creep in silently.
+
+    CPU share is ``time.thread_time`` over the sweep loop (the fetches
+    block off-GIL, so the thread clock charges only the poller's own
+    fold work) divided by the default poll period — what one idle-free
+    sweep costs per cadence slot. The p99 on this shared 1-core host
+    carries the worker ranks' GIL interference; that contention IS the
+    deployment reality for an in-host scraper, so it stays in the
+    figure. Worker exit is agreed through a MIN allreduce (the R1
+    lesson: a rank-local break leaves ranks a collective apart)."""
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+    from ytk_mp4j_tpu.obs.fleet import FleetPoller
+    from ytk_mp4j_tpu.operands import Operands
+    from ytk_mp4j_tpu.operators import Operators
+    from ytk_mp4j_tpu.utils import tuning
+
+    master = Master(procs, timeout=60.0, metrics_port=0, elastic="off",
+                    health=False, autoscale="off",
+                    tuner="off").serve_in_thread()
+    stop = threading.Event()
+    errs = []
+
+    def worker():
+        try:
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=60.0, elastic="off",
+                async_collectives=False, health=False, tuner="off",
+                shm=False, audit="off", sink_dir="")
+            buf = np.ones(size, np.float32)
+            flag = np.zeros(1)
+            while True:
+                slave.allreduce_array(buf, Operands.FLOAT,
+                                      Operators.SUM)
+                flag[0] = 1.0 if stop.is_set() else 0.0
+                slave.allreduce_array(flag, Operands.DOUBLE,
+                                      Operators.MIN)
+                if flag[0] == 1.0:
+                    break
+            slave.close(0)
+        except Exception as e:  # pragma: no cover
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(procs)]
+    for t in threads:
+        t.start()
+    url = f"http://127.0.0.1:{master.metrics_port}"
+    poller = FleetPoller([url], poll_secs=0.05, stale_secs=30.0)
+    try:
+        poller.poll_once()      # warmup: connection + lazy-path setup
+        lat = []
+        w0 = time.perf_counter()
+        c0 = time.thread_time()
+        for _ in range(sweeps):
+            t0 = time.perf_counter()
+            poller.poll_once()
+            lat.append(time.perf_counter() - t0)
+        cpu = time.thread_time() - c0
+        wall = time.perf_counter() - w0
+        st = poller.model()["jobs"][url]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        master.join(10.0)
+    if errs:
+        raise RuntimeError(f"fleet scrape bench worker failed: {errs}")
+    if st["state"] != "LIVE" or st["summary"] is None:
+        raise RuntimeError(
+            f"fleet scrape bench: job never scraped LIVE "
+            f"(state={st['state']}) — latency figures would be bogus")
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    return {
+        "fleet_scrape_p50_ms": round(p50 * 1e3, 3),
+        "fleet_scrape_p99_ms": round(p99 * 1e3, 3),
+        "fleet_scrape_cpu_ms_per_sweep": round(cpu / sweeps * 1e3, 3),
+        "fleet_scrape_cpu_share_at_default_cadence": round(
+            cpu / sweeps / tuning.fleet_poll_secs(), 4),
+        "sweeps": sweeps,
+        "wall_secs": round(wall, 3),
+        "ranks_reporting": st["summary"]["ranks_reporting"],
+    }
+
+
 def bench_ffm_tpu(n=8192, n_features=100_000, n_fields=8, k=8,
                   max_nnz=8, steps=10):
     """FFM sparse embedding-gradient allreduce workload (BASELINE.md
@@ -1595,6 +1692,11 @@ def main():
     shrinkage = bench_socket_shrink_latency()
     planned_evict = bench_socket_planned_evict_ms()
     grow = bench_socket_grow_latency_ms()
+    # ISSUE 18 (mp4j-fleet): FleetPoller sweep latency + CPU share
+    # against a live 4-rank job in this process (threads, no fork —
+    # safe at any point in the socket block; the poller scrapes HTTP
+    # out of band so no frozen leg changes)
+    fleet_scrape = bench_fleet_scrape()
     (tpu_gbs, trees_per_sec, n_chips, gbdt_fps,
      gbdt_hist_fps) = bench_tpu(n=n_tpu)
     ffm_steps, ffm_fps = bench_ffm_tpu()
@@ -1740,6 +1842,14 @@ def main():
             "socket_planned_evict_ms": planned_evict[
                 "planned_evict_ms"],
             "socket_grow_latency_ms": grow["grow_latency_ms"],
+            # ISSUE 18 (mp4j-fleet): one full fleet sweep (both
+            # endpoint fetches + fold + contention detection) against
+            # a live 4-rank job; the p99 row is bench-diff-gated
+            # (lower is better) so a fold/detector regression cannot
+            # creep in silently
+            "fleet_scrape": fleet_scrape,
+            "fleet_scrape_p99_ms": fleet_scrape[
+                "fleet_scrape_p99_ms"],
             "socket_elastic": {"replace": replacement,
                                "shrink": shrinkage,
                                "planned_evict": planned_evict,

@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 27)]
+    assert ids == [f"R{i}" for i in range(1, 28)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -1997,6 +1997,86 @@ def test_r26_inline_suppression():
     """)
     assert not r.findings
     assert any(f.rule == "R26" for f in r.suppressed)
+
+
+# ----------------------------------------------------------------------
+# R27 — HTTP fetch without explicit timeout in obs/ scrape code
+# ----------------------------------------------------------------------
+def test_r27_fires_on_urlopen_without_timeout():
+    r = run_rule("R27", """
+        import urllib.request
+
+        def scrape(base):
+            with urllib.request.urlopen(base + "/metrics.json") as resp:
+                return resp.read()
+    """, path=OBS_PATH)
+    [f] = r.findings
+    assert f.rule == "R27" and f.line == 5
+    assert "timeout" in f.message
+
+
+def test_r27_fires_on_http_client_connection():
+    r = run_rule("R27", """
+        import http.client
+
+        def probe(host, port):
+            conn = http.client.HTTPConnection(host, port)
+            conn.request("GET", "/health.json")
+            return conn.getresponse().read()
+    """, path=OBS_PATH)
+    [f] = r.findings
+    assert f.rule == "R27" and "HTTPConnection" in f.message
+
+
+def test_r27_quiet_with_timeout_kwarg():
+    r = run_rule("R27", """
+        import urllib.request
+        import http.client
+
+        def scrape(base, deadline):
+            conn = http.client.HTTPSConnection("h", 443, timeout=2.0)
+            with urllib.request.urlopen(base, timeout=deadline) as resp:
+                return resp.read()
+    """, path=OBS_PATH)
+    assert not r.findings
+
+
+def test_r27_quiet_with_positional_timeout():
+    # urlopen(url, data, timeout) — the 3rd positional IS the bound
+    r = run_rule("R27", """
+        import urllib.request
+
+        def post(base, payload):
+            with urllib.request.urlopen(base, payload, 5.0) as resp:
+                return resp.read()
+    """, path=OBS_PATH)
+    assert not r.findings
+
+
+def test_r27_quiet_outside_obs():
+    # comm owns its socket discipline under R2; analysis/test fetches
+    # are not scrape loops
+    r = run_rule("R27", """
+        import urllib.request
+
+        def fetch(base):
+            with urllib.request.urlopen(base) as resp:
+                return resp.read()
+    """)
+    assert not r.findings
+
+
+def test_r27_inline_suppression():
+    r = run_rule("R27", """
+        import urllib.request
+
+        def fetch_forever(base):
+            # mp4j-lint: disable=R27 (interactive one-shot; ^C is the bound)
+            with urllib.request.urlopen(base) as resp:
+                return resp.read()
+    """, path=OBS_PATH)
+    assert not r.findings
+    assert any(f.rule == "R27" for f in r.suppressed)
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,477 @@
+"""mp4j-fleet tests (ISSUE 18): the cross-job fleet plane.
+
+Three layers:
+
+- pure folds: ``job_summary`` / ``fold_fleet`` / ``detect_contention``
+  over synthetic control documents (the contention semantics are fully
+  specified here — overlapping busy windows + simultaneous slow-link
+  verdicts on one host fingerprint);
+- the poller state machine (``LIVE -> STALE -> GONE``, restart via
+  job-id change, backoff, garbage absorption) driven deterministically
+  through the injectable ``fetch``/``now`` seams;
+- the acceptance criterion end-to-end: two REAL concurrent jobs
+  (separate masters, separate processes, ephemeral metrics ports) on
+  this host, the poller folds both and names the shared host with
+  per-job byte attribution; SIGKILL of one entire job degrades its
+  rows ``STALE -> GONE`` with zero poller exceptions while the
+  survivor stays LIVE; ``fleet-report`` reconstructs the merged
+  timeline including the death from crc-framed fleet segments, which
+  survive byte-level truncation (the sink torn-tail property).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tests.helpers import REPO_ROOT
+from ytk_mp4j_tpu.obs import fleet, sink as sink_mod, telemetry
+from ytk_mp4j_tpu.obs.cli import main as scope_main
+
+
+# ----------------------------------------------------------------------
+# synthetic control documents
+# ----------------------------------------------------------------------
+def _mdoc(jid, *, fp="hostA", bps=100.0, slow=True, nranks=2,
+          roster_gen=1, health_states=None):
+    """A minimal /metrics.json document: ``nranks`` ranks on one host
+    fingerprint, each moving ``bps`` bytes/s, with (optionally) a
+    tuner applied-decision on every rank — the slow-link verdict."""
+    ranks = {}
+    tuner = {"ranks": {}}
+    for i in range(nranks):
+        r = str(i)
+        ranks[r] = {
+            "host_fp": fp,
+            "stats": {"allreduce_array": {"bytes_sent": 1000,
+                                          "bytes_recv": 1000,
+                                          "retries": 1}},
+            "rates": {"bytes_per_sec": bps},
+        }
+        if slow:
+            tuner["ranks"][r] = {"applied": {
+                str((i + 1) % nranks): {"chunk_bytes": 4096,
+                                        "compress": None}}}
+    hs = health_states or {}
+    return {
+        "job_id": jid, "started_wall": 1.0, "roster_gen": roster_gen,
+        "slave_num": nranks, "ranks": ranks,
+        "cluster": {
+            "rates": {"bytes_per_sec": nranks * bps,
+                      "collectives_per_sec": 5.0, "keys_per_sec": 1.0},
+            "tuner": tuner,
+            "health": {"ranks": {r: {"state": s}
+                                 for r, s in hs.items()},
+                       "alerts_total": 0},
+        },
+    }
+
+
+# ----------------------------------------------------------------------
+# pure folds
+# ----------------------------------------------------------------------
+def test_job_summary_folds_hosts_health_and_bytes():
+    s = fleet.job_summary(_mdoc("aaaa", health_states={
+        "0": "HEALTHY", "1": "DEGRADED"}))
+    assert s["job_id"] == "aaaa" and s["slave_num"] == 2
+    h = s["hosts"]["hostA"]
+    assert h["ranks"] == [0, 1]
+    assert h["wire_bytes"] == 4000          # 2 ranks x (1000+1000)
+    assert h["bytes_per_sec"] == pytest.approx(200.0)
+    assert h["slow_links"] == ["0->1", "1->0"]
+    assert s["retries"] == 2
+    assert s["health"]["states"] == {"HEALTHY": 1, "DEGRADED": 1}
+
+
+def test_job_summary_health_falls_back_to_metrics_doc():
+    # health endpoint unreachable (hdoc None): the metrics doc's
+    # cluster.health section carries the same schema
+    doc = _mdoc("aaaa", health_states={"0": "CRITICAL", "1": "HEALTHY"})
+    s = fleet.job_summary(doc, None)
+    assert s["health"]["states"] == {"HEALTHY": 1, "CRITICAL": 1}
+    # an explicit health doc WINS over the embedded section
+    s2 = fleet.job_summary(doc, {"ranks": {"0": {"state": "HEALTHY"},
+                                           "1": {"state": "HEALTHY"}},
+                                 "alerts_total": 7})
+    assert s2["health"]["states"] == {"HEALTHY": 2}
+    assert s2["health"]["alerts_total"] == 7
+
+
+def test_fold_fleet_shared_host_contention_and_aggregate():
+    js = {u: {"url": u, "state": fleet.LIVE, "age": 0.1,
+              "summary": fleet.job_summary(_mdoc(j))}
+          for u, j in (("u1", "aaaa"), ("u2", "bbbb"))}
+    m = fleet.fold_fleet(js)
+    assert m["shared_hosts"] == ["hostA"]
+    row = m["hosts"]["hostA"]["jobs"]
+    assert set(row) == {"aaaa", "bbbb"}
+    assert all(j["wire_bytes"] == 4000 for j in row.values())
+    [c] = m["contention"]
+    assert c["host_fp"] == "hostA" and c["jobs"] == ["aaaa", "bbbb"]
+    assert set(c["slow"]) == {"aaaa", "bbbb"}
+    assert m["aggregate"]["live"] == 2 and m["aggregate"]["ranks"] == 4
+    assert m["aggregate"]["bytes_per_sec"] == pytest.approx(400.0)
+    # render: both ids, the shared host and the contention line
+    text = telemetry.format_fleet(m)
+    assert "aaaa" in text and "bbbb" in text
+    assert "shared host hostA" in text and "CONTENTION" in text
+
+
+def test_fold_fleet_stale_job_is_history_not_load():
+    """A STALE job's last summary still places its ranks on the host
+    (co-residency) but contributes NO byte rate — a frozen rate from
+    a wedged master must not manufacture phantom load or contention."""
+    js = {"u1": {"url": "u1", "state": fleet.LIVE, "age": 0.1,
+                 "summary": fleet.job_summary(_mdoc("aaaa"))},
+          "u2": {"url": "u2", "state": fleet.STALE, "age": 9.0,
+                 "summary": fleet.job_summary(_mdoc("bbbb"))}}
+    m = fleet.fold_fleet(js)
+    assert m["shared_hosts"] == ["hostA"]           # still co-resident
+    assert m["hosts"]["hostA"]["jobs"]["bbbb"]["bytes_per_sec"] == 0.0
+    assert m["contention"] == []                    # only one busy job
+    assert m["aggregate"]["live"] == 1
+    assert m["aggregate"]["bytes_per_sec"] == pytest.approx(200.0)
+
+
+def test_detect_contention_needs_two_busy_and_two_slow():
+    def host(jobs):
+        return {"fp": {"jobs": jobs}}
+    busy_slow = {"bytes_per_sec": 10.0, "slow_links": ["0->1"]}
+    busy_ok = {"bytes_per_sec": 10.0, "slow_links": []}
+    idle_slow = {"bytes_per_sec": 0.0, "slow_links": ["0->1"]}
+    # two busy, both slow -> contended
+    assert fleet.detect_contention(host({"a": busy_slow,
+                                         "b": busy_slow}))
+    # two busy, one slow -> not contended (no simultaneous verdicts)
+    assert not fleet.detect_contention(host({"a": busy_slow,
+                                             "b": busy_ok}))
+    # one busy one idle, both holding verdicts -> no overlapping busy
+    # window, not contended
+    assert not fleet.detect_contention(host({"a": busy_slow,
+                                             "b": idle_slow}))
+    # the "" fingerprint is the MP4J_SHM=0 opt-out, never a host
+    assert not fleet.detect_contention(
+        {"": {"jobs": {"a": busy_slow, "b": busy_slow}}})
+
+
+# ----------------------------------------------------------------------
+# the poller state machine (injected fetch + clock)
+# ----------------------------------------------------------------------
+def _stage():
+    return {"clock": [0.0], "alive": [True], "jid": ["cafe"],
+            "fetches": [0]}
+
+
+def _poller(st, **kw):
+    def fetch(url):
+        st["fetches"][0] += 1
+        if not st["alive"][0]:
+            raise OSError("connection refused")
+        return _mdoc(st["jid"][0]), None
+    kw.setdefault("poll_secs", 1.0)
+    kw.setdefault("stale_secs", 2.0)
+    return fleet.FleetPoller(["h:1"], fetch=fetch,
+                             now=lambda: st["clock"][0], **kw)
+
+
+def test_poller_live_stale_gone_ladder_and_recovery():
+    st = _stage()
+    p = _poller(st)
+    p.poll_once()
+    assert p.states() == {"http://h:1": fleet.LIVE}
+    st["alive"][0] = False
+    # GONE at 3x stale_secs after the last good scrape; the ladder
+    # advances every sweep even while backoff skips the fetch itself
+    for t in (1.5, 3.0, 5.5, 10.0, 20.0, 40.0):
+        st["clock"][0] = t
+        p.poll_once()
+    assert p.states() == {"http://h:1": fleet.GONE}
+    assert p.scrape_errors > 0
+    # a model is still produced, with the last summary flagged GONE
+    m = p.model()
+    assert m["jobs"]["http://h:1"]["state"] == fleet.GONE
+    assert m["jobs"]["http://h:1"]["summary"]["job_id"] == "cafe"
+    # recovery under the SAME job id: back, not a restart
+    st["alive"][0] = True
+    st["clock"][0] = 60.0
+    p.poll_once()
+    assert p.states() == {"http://h:1": fleet.LIVE}
+    kinds = [e["kind"] for e in p.events()]
+    assert kinds == ["job_up", "job_stale", "job_gone", "job_back"]
+
+
+def test_poller_detects_restart_via_job_id_change():
+    st = _stage()
+    p = _poller(st)
+    p.poll_once()
+    st["jid"][0] = "beef"                   # master restarted in place
+    st["clock"][0] = 1.0
+    p.poll_once()
+    ev = p.events()[-1]
+    assert ev["kind"] == "job_restart"
+    assert "cafe" in ev["msg"] and "beef" in ev["msg"]
+    assert p.states() == {"http://h:1": fleet.LIVE}
+
+
+def test_poller_backoff_skips_probes_of_a_dead_master():
+    st = _stage()
+    p = _poller(st)
+    p.poll_once()
+    st["alive"][0] = False
+    # many sweeps in a short window: capped exponential backoff must
+    # collapse most of them into no-fetch staleness bookkeeping
+    for i in range(1, 40):
+        st["clock"][0] = i * 0.5
+        p.poll_once()
+    assert st["fetches"][0] < 20            # 1 good + a backoff tail
+    assert p.states() == {"http://h:1": fleet.GONE}
+
+
+def test_poller_absorbs_garbage_documents():
+    """poll_once never raises: torn JSON, wrong types and exploding
+    fetches are each that job's staleness problem, not the poller's."""
+    docs = [ValueError("torn json"), 42, ["not", "a", "doc"],
+            OSError("reset"), KeyError("x")]
+    def fetch(url):
+        d = docs.pop(0) if docs else {"job_id": "ok", "slave_num": 0,
+                                      "ranks": {}, "cluster": {}}
+        if isinstance(d, Exception):
+            raise d
+        return d, None
+    clock = [0.0]
+    p = fleet.FleetPoller(["h:1"], poll_secs=0.1, stale_secs=10.0,
+                          fetch=fetch, now=lambda: clock[0])
+    for i in range(40):
+        clock[0] = i * 10.0                 # defeats backoff entirely
+        p.poll_once()
+    assert p.scrape_errors == 5
+    assert p.states() == {"http://h:1": fleet.LIVE}
+
+
+def test_poller_thread_lifecycle():
+    """start()/stop(): the background sweep thread is a daemon, makes
+    progress without any manual poll_once, and joins cleanly."""
+    def fetch(url):
+        return _mdoc("cafe"), None
+    p = fleet.FleetPoller(["h:1"], poll_secs=0.02, stale_secs=5.0,
+                          fetch=fetch)
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while p.model() is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert p.model() is not None
+        assert p._thread.daemon
+    finally:
+        p.stop()
+    assert p._thread is None                # joined and released
+
+
+# ----------------------------------------------------------------------
+# FleetSink — durability properties
+# ----------------------------------------------------------------------
+def test_fleet_sink_torn_tail_at_every_byte(tmp_path):
+    """The sink torn-tail property holds for fleet segments: truncate
+    the (single) segment at ANY byte inside the final record — every
+    prior record is recovered, exactly one torn tail, no crash."""
+    d = tmp_path / "fleet"
+    fs = fleet.FleetSink(str(d), budget_bytes=1 << 20)
+    recs = [{"t": "fleet_event", "wall": float(i), "kind": "job_up",
+             "msg": f"job {i}"} for i in range(4)]
+    offs = []
+    for r in recs:
+        fs.append(r)
+        offs.append(fs.bytes_written)
+    fs.close()
+    assert fs.dropped_records == 0
+    [seg] = sink_mod.list_segments(str(d))
+    blob = open(seg, "rb").read()
+    assert len(blob) == offs[-1]
+    stored = sink_mod.read_segment(seg)[0]
+    assert [r["kind"] == "job_up" for r in stored] == [True] * 4
+
+    start_last = offs[-2]
+    for cut in range(start_last + 1, len(blob)):
+        with open(seg, "wb") as fh:
+            fh.write(blob[:cut])
+        got, end, torn = sink_mod.read_segment(seg)
+        assert [g["wall"] for g in got] == [0.0, 1.0, 2.0], \
+            f"cut at {cut} lost intact records"
+        assert torn, f"cut at {cut} not reported as torn"
+        assert end == start_last
+        # the report layer sees the same three events and counts the tear
+        rep = fleet.fleet_report(str(d))
+        assert len(rep["events"]) == 3 and rep["torn"] == 1
+
+
+def test_fleet_sink_rotation_eviction_and_reader(tmp_path):
+    d = tmp_path / "fleet"
+    budget = 512 * 1024
+    fs = fleet.FleetSink(str(d), budget_bytes=budget)
+    big = "x" * 2048
+    for i in range(600):
+        fs.append({"t": "fleet", "wall": float(i), "pad": big})
+    fs.close()
+    segs = sink_mod.list_segments(str(d))
+    assert len(segs) > 1                    # rotated
+    total = sum(os.path.getsize(p) for p in segs)
+    assert total <= budget                  # evicted under the budget
+    doc = fleet.read_fleet(str(d))
+    assert doc["torn"] == 0
+    walls = [r["wall"] for r in doc["records"]]
+    assert walls == sorted(walls)           # oldest-first, gap at head
+    assert walls[-1] == 599.0               # newest survived eviction
+    assert fs.dropped_records == 0
+
+
+def test_fleet_sink_append_never_raises(tmp_path):
+    # a FILE where the directory should be: every append degrades to
+    # a counted drop, the poller must never see an exception
+    f = tmp_path / "not_a_dir"
+    f.write_text("x")
+    fs = fleet.FleetSink(str(f), budget_bytes=1 << 20)
+    fs.append({"t": "fleet", "wall": 0.0})
+    fs.append({"t": "fleet", "wall": 1.0})
+    fs.close()
+    assert fs.dropped_records == 2
+    assert fs.last_error
+
+
+# ----------------------------------------------------------------------
+# end-to-end: two real jobs, one SIGKILL (the acceptance criterion)
+# ----------------------------------------------------------------------
+_JOB_DRIVER = """
+import json, sys, threading, time
+import numpy as np
+from ytk_mp4j_tpu.comm.master import Master
+from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+from ytk_mp4j_tpu.operands import Operands
+from ytk_mp4j_tpu.operators import Operators
+
+n = int(sys.argv[1])
+master = Master(n, timeout=120.0, metrics_port=0).serve_in_thread()
+
+def worker():
+    slave = ProcessCommSlave("127.0.0.1", master.port, timeout=120.0)
+    arr = np.ones(8192)
+    while True:
+        slave.allreduce_array(arr, Operands.DOUBLE, Operators.SUM)
+        time.sleep(0.02)
+
+for _ in range(n):
+    threading.Thread(target=worker, daemon=True).start()
+print(json.dumps({"metrics_port": master.metrics_port,
+                  "job_id": master.job_id}), flush=True)
+threading.Event().wait()        # run until SIGKILLed by the test
+"""
+
+
+def _spawn_job(nranks=2):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "MP4J_HEARTBEAT_SECS": "0.1",
+           "PYTHONPATH": REPO_ROOT}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _JOB_DRIVER, str(nranks)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        cwd=REPO_ROOT, env=env, text=True)
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise AssertionError(
+            f"job driver died at startup: {proc.stderr.read()[-2000:]}")
+    head = json.loads(line)
+    return proc, f"http://127.0.0.1:{head['metrics_port']}", \
+        head["job_id"]
+
+
+def test_fleet_two_real_jobs_shared_host_then_sigkill(tmp_path, capsys):
+    """ISSUE 18 acceptance: two real concurrent jobs on this host ->
+    the fleet fold names the shared host fingerprint with BOTH job
+    ids and per-job byte attribution; SIGKILL of one entire job walks
+    its rows STALE -> GONE with zero poller exceptions while the
+    survivor stays LIVE; the fleet-report reconstructs the merged
+    timeline including the death from the crc-framed segments."""
+    nranks = 2
+    proc_a = proc_b = None
+    sink_dir = str(tmp_path / "fleet")
+    try:
+        proc_a, url_a, jid_a = _spawn_job(nranks)
+        proc_b, url_b, jid_b = _spawn_job(nranks)
+        fs = fleet.FleetSink(sink_dir, budget_bytes=4 << 20)
+        poller = fleet.FleetPoller([url_a, url_b], poll_secs=0.2,
+                                   stale_secs=0.6, sink=fs)
+
+        # -- phase 1: both jobs folded, shared host, byte attribution
+        deadline = time.monotonic() + 60.0
+        model = None
+        while time.monotonic() < deadline:
+            model = poller.poll_once()      # never raises, by contract
+            jobs = model["jobs"]
+            ok = [j for j in jobs.values()
+                  if j["state"] == fleet.LIVE and j["summary"]
+                  and j["summary"]["ranks_reporting"] == nranks
+                  and j["summary"]["wire_bytes"] > 0]
+            if len(ok) == 2 and model["shared_hosts"]:
+                break
+            time.sleep(0.1)
+        assert model is not None and model["shared_hosts"], \
+            f"no shared host observed: {json.dumps(model, default=str)[:800]}"
+        [fp] = model["shared_hosts"]
+        row = model["hosts"][fp]["jobs"]
+        assert set(row) == {jid_a, jid_b}   # both job ids, one host
+        for jid in (jid_a, jid_b):
+            assert row[jid]["wire_bytes"] > 0       # per-job bytes
+            assert sorted(row[jid]["ranks"]) == list(range(nranks))
+        frame = telemetry.format_fleet(model)
+        assert jid_a in frame and jid_b in frame
+        assert f"shared host {fp}" in frame
+
+        # the CLI one-shot sees the same shared host (own poller)
+        assert scope_main(["fleet", url_a, url_b, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert jid_a in out and jid_b in out and "shared host" in out
+
+        # -- phase 2: SIGKILL job B entirely (master + slaves die)
+        proc_b.kill()
+        proc_b.wait(10.0)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            poller.poll_once()              # must absorb the corpse
+            if poller.states()[url_b] == fleet.GONE:
+                break
+            time.sleep(0.1)
+        states = poller.states()
+        assert states[url_b] == fleet.GONE, states
+        assert states[url_a] == fleet.LIVE, states      # survivor
+        surv = poller.model()["jobs"][url_a]["summary"]
+        assert surv["job_id"] == jid_a
+        assert surv["ranks_reporting"] == nranks        # unaffected
+        kinds = [e["kind"] for e in poller.events()]
+        assert "job_stale" in kinds and "job_gone" in kinds
+        poller.stop()                       # closes the sink too
+
+        # -- phase 3: offline reconstruction from the fleet segments
+        rep = fleet.fleet_report(sink_dir)
+        assert rep["snapshots"] > 0 and rep["torn"] == 0
+        by_kind = {}
+        for ev in rep["events"]:
+            by_kind.setdefault(ev["kind"], []).append(ev)
+        assert {jid_a, jid_b} <= {e["job_id"]
+                                  for e in by_kind["job_up"]}
+        assert any(e["job_id"] == jid_b for e in by_kind["job_gone"])
+        assert rep["jobs"][url_b]["state"] == fleet.GONE
+        assert rep["jobs"][url_a]["state"] == fleet.LIVE
+        assert scope_main(["fleet-report", sink_dir]) == 0
+        out = capsys.readouterr().out
+        assert "job_gone" in out and jid_b in out
+    finally:
+        for proc in (proc_a, proc_b):
+            if proc is not None:
+                proc.kill()
+                proc.wait(10.0)

@@ -28,6 +28,7 @@
 | R24 | error   | resource leaked on an exception path (whole-program) |
 | R25 | error   | thread started without join/daemon/stop (whole-program) |
 | R26 | warning | in-loop i* submit awaited with no compute (overlap defeated) |
+| R27 | warning | HTTP fetch without explicit timeout in obs/ scrape code |
 
 R19-R21 and R23-R25 are
 :class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule` instances: they
@@ -82,6 +83,8 @@ from ytk_mp4j_tpu.analysis.rules.r25_thread_lifecycle import (
     R25ThreadLifecycle)
 from ytk_mp4j_tpu.analysis.rules.r26_immediate_await import (
     R26ImmediateAwait)
+from ytk_mp4j_tpu.analysis.rules.r27_http_timeout import (
+    R27HttpNoTimeout)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -110,6 +113,7 @@ ALL_RULES = [
     R24ResourceLeak,
     R25ThreadLifecycle,
     R26ImmediateAwait,
+    R27HttpNoTimeout,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

@@ -225,6 +225,17 @@ class Master:
         # every shm segment this job's peer pairs create, so two jobs
         # on one host can never collide on a segment name
         self.job_id = secrets.token_hex(4)
+        # job identity stamps (ISSUE 18): the fleet poller correlates
+        # a job's /metrics.json and /health.json documents and detects
+        # a master restart (new job_id at the same URL) without
+        # heuristics. Wall clock: identity for humans/scrapers across
+        # hosts, never duration arithmetic
+        # mp4j-lint: disable=R11 (identity timestamp, not a duration)
+        self.started_wall = time.time()
+        # bumped under the lock at every roster publication
+        # (rendezvous, replace, shrink, grow) — scrapers distinguish
+        # "same job, new roster" from "same roster, fresh numbers"
+        self._roster_gen = 0
         # rendezvous listen socket — sanctioned raw-socket site: the
         # master IS the control plane the transport SPI is negotiated
         # over (mp4j-lint R12 baseline)
@@ -587,6 +598,7 @@ class Master:
         # sends stay OUTSIDE it — send_obj blocks on the peer
         with self._lock:
             self._roster = roster
+            self._roster_gen += 1
             self._slots.extend(slots)
         for rank, (ch, _) in enumerate(pending):
             ch.send_obj({"rank": rank, "roster": roster,
@@ -1002,6 +1014,7 @@ class Master:
             new_ranks = sorted(gs["adopted"])
             old_n = self.slave_num
             self._roster = gs["roster"]
+            self._roster_gen += 1
             self.slave_num = len(self._roster)
             self._rank_width = max(
                 1, len(str(max(self.slave_num - 1, 0))))
@@ -1735,6 +1748,7 @@ class Master:
         release before the epoch go."""
         repl = {r: rec.entry for r, rec in self._round_adopted.items()}
         self._roster = membership_mod.swap_roster(self._roster, repl)
+        self._roster_gen += 1
         joiners = sorted(self._round_adopted)
         extra_lines: list[str] = []
         evict_notify: list[tuple[_Slot, int, str]] = []
@@ -1797,6 +1811,7 @@ class Master:
             new_slots[new] = slot
         self._slots = new_slots
         self._roster = new_roster
+        self._roster_gen += 1
         self.slave_num = len(mapping)
         self._rank_width = max(1, len(str(max(self.slave_num - 1, 0))))
         self._exit_codes = {mapping[r]: c for r, c
@@ -2499,9 +2514,15 @@ class Master:
                     # the verdict document over HTTP (ISSUE 13
                     # satellite): external orchestrators — a k8s
                     # operator, a cron — read evict recommendations
-                    # without being in-process; JSON `null` when the
-                    # master runs MP4J_HEALTH=0
-                    body = json.dumps(master.health_status()).encode()
+                    # without being in-process. Stamped with the job
+                    # identity (ISSUE 18) so a fleet scraper can
+                    # correlate it with /metrics.json and detect a
+                    # master restart; the health keys stay `enabled:
+                    # false` (not JSON null) under MP4J_HEALTH=0 so
+                    # the stamp always has a document to ride
+                    hdoc = master.health_status() or {"enabled": False}
+                    body = json.dumps(
+                        {**hdoc, **master.job_doc()}).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)
@@ -2509,6 +2530,10 @@ class Master:
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # control-plane responses are point-in-time telemetry:
+                # any intermediary cache would hand a fleet scraper a
+                # stale document that looks fresh (ISSUE 18 satellite)
+                self.send_header("Cache-Control", "no-store")
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -2540,6 +2565,19 @@ class Master:
             "keys": sum(e.get("keys", 0) for e in stats.values()),
         }
 
+    def job_doc(self) -> dict:
+        """The job-identity stamp (ISSUE 18) both control-plane
+        endpoints carry at top level: ``job_id`` (fresh per master —
+        a changed id at the same URL IS a restart), the master's
+        start wall time and the roster generation (bumped at every
+        roster publication). Everything a fleet scraper needs to
+        correlate the two documents and detect restarts without
+        heuristics."""
+        with self._lock:
+            return {"job_id": self.job_id,
+                    "started_wall": self.started_wall,
+                    "roster_gen": self._roster_gen}
+
     def metrics_doc(self) -> dict:
         """The metrics document both endpoint formats serve: per-rank
         progress/stats/rates plus the cluster aggregate (summed stats,
@@ -2554,6 +2592,8 @@ class Master:
                             if self._autoscaler is not None else None)
         tuner_status = self.tuner_status()
         with self._lock:
+            roster_gen = self._roster_gen
+            roster = self._roster
             ranks: dict[str, dict] = {}
             for r in sorted(self._telemetry):
                 t = self._telemetry[r]
@@ -2580,6 +2620,12 @@ class Master:
                         "counters", {}),
                     "gauges": (t.get("metrics") or {}).get(
                         "gauges", {}),
+                    # roster host fingerprint (ISSUE 18): the key the
+                    # fleet poller folds co-residency on — two jobs'
+                    # ranks with EQUAL non-empty fingerprints share a
+                    # host; "" means the rank opted out (MP4J_SHM=0)
+                    "host_fp": (str(roster[r][2])
+                                if 0 <= r < len(roster) else ""),
                 }
             cluster_rates = self._cluster_window.rates()
             cluster_metrics = self._cluster_metrics
@@ -2593,6 +2639,13 @@ class Master:
             info["audit_seq"] = int(
                 audit_status["rank_seq"].get(r, 0))
         return {
+            # job identity at top level (ISSUE 18): same fields as
+            # job_doc(), sampled under the SAME lock hold as the rank
+            # table so a scraper never sees a roster_gen from one
+            # roster paired with ranks from another
+            "job_id": self.job_id,
+            "started_wall": self.started_wall,
+            "roster_gen": roster_gen,
             "slave_num": self.slave_num,
             "window_secs": self._metrics_window,
             # heartbeat period (ISSUE 12 satellite): the live view

@@ -104,6 +104,14 @@ THRESHOLDS: dict[str, float] = {
     # wall-clock caveat and wide budget as the membership rows above
     "socket_planned_evict_ms": 1.0,
     "socket_grow_latency_ms": 1.0,
+    # ISSUE 18 (mp4j-fleet): one full FleetPoller sweep against a
+    # live 4-rank job (both endpoint fetches + summary fold + model
+    # rebuild + contention detection), p99 over the sweep loop —
+    # LOWER is better. Wide budget: the tail rides loopback-HTTP
+    # scheduler wakeups on the shared 1-core bench host; the gate
+    # exists to catch a fold/detector complexity regression, which
+    # shows as a multiple, not a percent
+    "fleet_scrape_p99_ms": 1.0,
     # ISSUE 16: mp4j-lint v3 (R23-R25 lockset/resource whole-program
     # passes) over v2 (R19-R21) — a RATIO, so already normalized
     # against host speed; the budget bounds growth of the marginal
@@ -120,6 +128,7 @@ LOWER_IS_BETTER = frozenset({
     "socket_shrink_latency_ms",
     "socket_planned_evict_ms",
     "socket_grow_latency_ms",
+    "fleet_scrape_p99_ms",
     "lint_v3_over_v2_ratio",
 })
 

@@ -11,6 +11,8 @@ Usage::
     mp4j-scope health /path/to/MP4J_SINK_DIR | http://master:PORT
     mp4j-scope tuner /path/to/MP4J_SINK_DIR | http://master:PORT
     mp4j-scope tail /path/to/MP4J_SINK_DIR [--interval 1.0] [--once]
+    mp4j-scope fleet URL [URL ...] [--interval 2.0] [--once] [--sink DIR]
+    mp4j-scope fleet-report /path/to/FLEET_SINK_DIR
     mp4j-scope bench-diff BENCH_rA.json BENCH_rB.json [--threshold PCT]
     python -m ytk_mp4j_tpu.obs report ...
 
@@ -63,6 +65,18 @@ audit trips from the alert stream); given a master URL it shows the
 live tuner document (mode, leader overrides, per-rank applied
 decisions, trip state).
 
+``fleet`` (ISSUE 18) scrapes N job masters' ``/metrics.json`` +
+``/health.json`` endpoints on a cadence and renders the cross-job
+fleet table: one row per job (staleness state ``LIVE``/``STALE``/
+``GONE``, ranks, rates, retries, health-ladder tally), shared-host
+blocks with per-job byte attribution on each co-resident host
+fingerprint, and cross-job ``CONTENTION`` rows. ``--sink DIR`` (or
+``MP4J_FLEET_SINK_DIR``) additionally lands the fleet history
+durably as crc-framed segments; ``fleet-report`` reconstructs the
+merged fleet event timeline (job up/stale/gone/restart, health
+transitions, autoscaler actions, contention episodes) offline from
+such a directory.
+
 ``bench-diff`` compares two ``bench.py`` JSON outputs against
 per-metric regression budgets (``obs.benchdiff``); exit 1 on a
 regression — the perf gate.
@@ -82,8 +96,10 @@ import urllib.error
 import urllib.request
 
 from ytk_mp4j_tpu.obs import (audit, benchdiff, critpath,
+                              fleet as fleet_mod,
                               health as health_mod, postmortem,
                               sink as sink_mod, spans, telemetry)
+from ytk_mp4j_tpu.utils import tuning
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -161,6 +177,31 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="poll period in seconds (default 1.0)")
     tl.add_argument("--once", action="store_true",
                     help="print the current backlog and exit")
+
+    fl = sub.add_parser("fleet",
+                        help="scrape N job masters and render the "
+                             "cross-job fleet table (shared hosts, "
+                             "contention, per-job health)")
+    fl.add_argument("urls", nargs="+", metavar="URL",
+                    help="master endpoint bases, e.g. "
+                         "http://127.0.0.1:9090 (scheme optional)")
+    fl.add_argument("--interval", type=float, default=None,
+                    help="poll period in seconds (default "
+                         "MP4J_FLEET_POLL_SECS, 2.0)")
+    fl.add_argument("--once", action="store_true",
+                    help="one scrape sweep + one frame, then exit")
+    fl.add_argument("--sink", default=None, metavar="DIR",
+                    help="land fleet history durably in DIR as "
+                         "crc-framed segments (default "
+                         "MP4J_FLEET_SINK_DIR; empty = no sink)")
+
+    fr = sub.add_parser("fleet-report",
+                        help="merged fleet event timeline + "
+                             "contention episodes from a fleet sink "
+                             "directory, offline")
+    fr.add_argument("dir", help="fleet sink dir (seg_*.mp4j)")
+    fr.add_argument("--json", action="store_true",
+                    help="emit the raw reconstruction as JSON")
 
     bd = sub.add_parser("bench-diff",
                         help="compare two bench.py JSON outputs "
@@ -341,8 +382,24 @@ def _tuner(args) -> int:
 
 
 def _live(args) -> int:
+    last_frame: str | None = None
+    last_ok: float | None = None
     while True:
-        frame = telemetry.format_live(_fetch_doc(args.url))
+        try:
+            last_frame = telemetry.format_live(_fetch_doc(args.url))
+            last_ok = time.monotonic()
+            frame = last_frame
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            # mid-watch endpoint death is a FACT to render, not a
+            # traceback to die with (ISSUE 18 satellite) — but an
+            # endpoint that never answered once is a usage error and
+            # keeps the exit-2 path
+            if args.once or last_ok is None:
+                raise
+            frame = (last_frame + "\n" if last_frame else "") + (
+                f"STALE (last seen "
+                f"{time.monotonic() - last_ok:.0f}s ago) — "
+                f"{args.url}: {e}")
         if args.once:
             print(frame)
             return 0
@@ -352,6 +409,41 @@ def _live(args) -> int:
             time.sleep(max(args.interval, 0.1))
         except KeyboardInterrupt:
             return 0
+
+
+def _fleet(args) -> int:
+    """The cross-job fleet watch (ISSUE 18): one FleetPoller sweep
+    per interval, rendered via ``telemetry.format_fleet``. Staleness
+    handling lives in the poller — a dead master degrades its own
+    row (LIVE -> STALE -> GONE), never this loop."""
+    sink_dir = args.sink if args.sink is not None \
+        else tuning.fleet_sink_dir()
+    fs = fleet_mod.FleetSink(sink_dir) if sink_dir else None
+    poller = fleet_mod.FleetPoller(args.urls, poll_secs=args.interval,
+                                   sink=fs)
+    try:
+        while True:
+            frame = telemetry.format_fleet(poller.poll_once())
+            if args.once:
+                print(frame)
+                return 0
+            print("\x1b[2J\x1b[H" + frame, flush=True)
+            try:
+                time.sleep(max(poller.poll_secs, 0.1))
+            except KeyboardInterrupt:
+                return 0
+    finally:
+        if fs is not None:
+            fs.close()
+
+
+def _fleet_report(args) -> int:
+    report = fleet_mod.fleet_report(args.dir)
+    if args.json:
+        print(json.dumps(report, sort_keys=True, default=str))
+    else:
+        print(telemetry.format_fleet_report(report))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -379,6 +471,10 @@ def main(argv=None) -> int:
             return _tuner(args)
         if args.cmd == "tail":
             return _tail(args)
+        if args.cmd == "fleet":
+            return _fleet(args)
+        if args.cmd == "fleet-report":
+            return _fleet_report(args)
         if args.cmd == "bench-diff":
             thr = (None if args.threshold is None
                    else args.threshold / 100.0)

@@ -204,11 +204,15 @@ def list_segments(rdir: str) -> list[str]:
     return [os.path.join(rdir, n) for n in names]
 
 
-def read_rank(rdir: str) -> dict:
-    """Every intact record across a rank dir's segments, oldest first:
-    ``{"records": [...], "segments": int, "torn": int, "bytes": int}``.
-    A torn tail in a NON-final segment (the writer crashed, restarted
-    and rotated) is counted too — each segment is independent."""
+def read_dir(rdir: str) -> dict:
+    """Every intact record across ONE directory of crc-framed
+    segments, oldest first: ``{"records": [...], "segments": int,
+    "torn": int, "bytes": int}``. A torn tail in a NON-final segment
+    (the writer crashed, restarted and rotated) is counted too — each
+    segment is independent. The generic reader: per-rank sink dirs
+    (:func:`read_rank`) and the fleet history dir
+    (:mod:`ytk_mp4j_tpu.obs.fleet`) are both plain segment
+    directories under this framing."""
     records: list[dict] = []
     torn = 0
     nbytes = 0
@@ -230,6 +234,14 @@ def read_rank(rdir: str) -> dict:
             nbytes += end
     return {"records": records, "segments": len(segs), "torn": torn,
             "bytes": nbytes}
+
+
+def read_rank(rdir: str) -> dict:
+    """One rank's sink history — a rank dir IS a plain segment dir
+    (kept as its own name: every analyzer call site reads as
+    per-rank, and the fleet reader must not look like it reads
+    ranks)."""
+    return read_dir(rdir)
 
 
 def load_job(root: str) -> dict[int, dict]:

@@ -27,6 +27,7 @@ waiting for.
 from __future__ import annotations
 
 import statistics
+import time
 
 from ytk_mp4j_tpu.obs.health import SHORT_BY_NAME as _STATE_SHORT
 
@@ -279,6 +280,137 @@ def format_live(doc: dict) -> str:
             f"{retries:>4} "
             f"{health_col:>6}  "
             f"{badge:<8.8}  {age:.1f}s")
+    return "\n".join(lines)
+
+
+def _health_tally(ladder: dict[str, int]) -> str:
+    """Compress a health-ladder tally (``{"HEALTHY": 3, "DEGRADED":
+    1}``) into the fleet table's cell: ``3H1D``; ``-`` when the job
+    reports no health plane."""
+    if not ladder:
+        return "-"
+    order = {"HEALTHY": 0, "SUSPECT": 1, "DEGRADED": 2, "CRITICAL": 3}
+    parts = []
+    for name in sorted(ladder, key=lambda n: order.get(n, 9)):
+        parts.append(f"{ladder[name]}{_STATE_SHORT.get(name, name[:1])}")
+    return "".join(parts)
+
+
+def _fleet_state_cell(state: str, age: float) -> str:
+    """``LIVE`` / ``STALE(4.2s)`` / ``GONE(44s)`` — a non-LIVE row
+    always says how old its facts are."""
+    if state == "LIVE":
+        return "LIVE"
+    return f"{state}({age:.0f}s)" if age >= 9.5 else \
+        f"{state}({age:.1f}s)"
+
+
+def format_fleet(model: dict) -> str:
+    """The ``mp4j-scope fleet`` frame: one view of a fleet model
+    (:func:`ytk_mp4j_tpu.obs.fleet.fold_fleet`) — the aggregate
+    head-line, one row per job (identity, staleness state, ranks,
+    rates, retries, health-ladder tally, roster generation), then one
+    block per SHARED host fingerprint with each co-resident job's
+    ranks / wire bytes / live rate / slow-link verdicts, and a
+    ``CONTENTION`` line per flagged host. Pure over the model dict."""
+    agg = model.get("aggregate") or {}
+    jobs = model.get("jobs") or {}
+    head = (f"mp4j fleet — {agg.get('live', 0)}/{agg.get('jobs', 0)} "
+            f"job(s) LIVE | {agg.get('ranks', 0)} ranks | "
+            f"{agg.get('bytes_per_sec', 0.0) / 1e9:.3f} GB/s | "
+            f"{agg.get('collectives_per_sec', 0.0):.1f} coll/s")
+    lines = [head,
+             f"{'job':<10} {'state':<12} {'ranks':>6} {'MB/s':>8} "
+             f"{'coll/s':>7} {'rtry':>4} {'health':>7} {'gen':>3}  url"]
+    for key in sorted(jobs):
+        st = jobs[key]
+        s = st.get("summary")
+        cell = _fleet_state_cell(st.get("state") or "?",
+                                 float(st.get("age", 0.0)))
+        if s is None:
+            lines.append(f"{'-':<10} {cell:<12} {'-':>6} {'-':>8} "
+                         f"{'-':>7} {'-':>4} {'-':>7} {'-':>3}  "
+                         f"{st.get('url', key)} (never scraped)")
+            continue
+        ranks_cell = f"{s['ranks_reporting']}/{s['slave_num']}"
+        lines.append(
+            f"{(s['job_id'] or '-'):<10.10} {cell:<12} "
+            f"{ranks_cell:>6} "
+            f"{s['bytes_per_sec'] / 1e6:>8.2f} "
+            f"{s['collectives_per_sec']:>7.1f} "
+            f"{s['retries']:>4d} "
+            f"{_health_tally(s['health']['states']):>7} "
+            f"{s['roster_gen']:>3d}  {st.get('url', key)}")
+    hosts = model.get("hosts") or {}
+    for fp in model.get("shared_hosts") or []:
+        lines.append(f"shared host {fp}:")
+        for jid in sorted(hosts.get(fp, {}).get("jobs", {})):
+            j = hosts[fp]["jobs"][jid]
+            ranks = ",".join(map(str, j["ranks"]))
+            slow = ",".join(j["slow_links"]) or "-"
+            lines.append(
+                f"  job {jid:<10.10} ranks [{ranks}]  "
+                f"{j['wire_bytes'] / 1e6:.2f} MB wire  "
+                f"{j['bytes_per_sec'] / 1e6:.2f} MB/s  "
+                f"slow links: {slow}")
+    for c in model.get("contention") or []:
+        verdicts = "; ".join(f"{j}: {','.join(v)}"
+                             for j, v in c["slow"].items())
+        lines.append(
+            f"CONTENTION host {c['host_fp']}: "
+            f"{', '.join(c['jobs'])} busy simultaneously, "
+            f"each holding slow-link verdicts ({verdicts})")
+    return "\n".join(lines)
+
+
+def _wall_hms(wall) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(wall)))
+    except (TypeError, ValueError, OverflowError, OSError):
+        return "??:??:??"
+
+
+def format_fleet_report(report: dict) -> str:
+    """The ``mp4j-scope fleet-report`` view: jobs ever seen with their
+    last-known state, the merged event timeline (job up/stale/gone/
+    restart, health transitions, autoscaler actions, contention
+    on/off) and contention episodes, from
+    :func:`ytk_mp4j_tpu.obs.fleet.fleet_report`'s dict. Pure."""
+    lines = [f"fleet report — {report.get('snapshots', 0)} "
+             f"snapshot(s), {len(report.get('events') or [])} "
+             f"event(s), {report.get('segments', 0)} segment(s), "
+             f"{report.get('torn', 0)} torn tail(s)"]
+    jobs = report.get("jobs") or {}
+    if jobs:
+        lines.append("jobs:")
+        for key in sorted(jobs):
+            j = jobs[key]
+            lines.append(
+                f"  job {(j.get('job_id') or '-'):<10} "
+                f"{(j.get('state') or '?'):<6} "
+                f"{j.get('slave_num', '?')} rank(s)  "
+                f"gen {j.get('roster_gen', '?')}  {j.get('url', key)}")
+    events = report.get("events") or []
+    if events:
+        lines.append("timeline:")
+        for ev in events:
+            lines.append(f"  {_wall_hms(ev.get('wall'))}  "
+                         f"{ev.get('kind', '?'):<14} "
+                         f"{ev.get('msg', '')}")
+    else:
+        lines.append("timeline: (no events recorded)")
+    eps = report.get("episodes") or []
+    if eps:
+        lines.append("contention episodes:")
+        for ep in eps:
+            onset = ep.get("onset_wall")
+            clear = ep.get("clear_wall")
+            span = (f"{_wall_hms(onset)}..{_wall_hms(clear)} "
+                    f"({float(clear) - float(onset):.1f}s)"
+                    if clear is not None
+                    else f"{_wall_hms(onset)}.. (unresolved at end "
+                         "of history)")
+            lines.append(f"  host {ep.get('host_fp')}: {span}")
     return "\n".join(lines)
 
 

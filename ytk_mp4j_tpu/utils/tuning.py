@@ -229,6 +229,14 @@ Knobs (all validated where they are consumed; garbage raises
   per-link stats into a decision window; hysteresis is counted in
   these windows (a decision changes only after
   ``tuner.SUSTAIN_WINDOWS`` consecutive windows agree).
+- ``MP4J_FLEET_POLL_SECS`` / ``MP4J_FLEET_STALE_SECS`` /
+  ``MP4J_FLEET_SINK_DIR`` — the cross-job fleet poller (ISSUE 18;
+  ``obs/fleet.py`` behind ``mp4j-scope fleet``): sweep period, the
+  seconds-without-a-scrape bound that degrades a job ``LIVE ->
+  STALE`` (``GONE`` at 3x), and the durable fleet-history directory
+  (crc-framed segments, ``mp4j-scope fleet-report``; empty disables
+  it). SCRAPER-side knobs — they configure the observer machine, not
+  the jobs, so no job-wide-agreement requirement applies.
 - ``MP4J_SO_BUF_MAP`` — explicit PER-LINK socket buffer overrides:
   ``"peer:sndbuf[/rcvbuf],..."`` (e.g. ``"2:262144,3:524288/1048576"``)
   applies those buffer sizes to the TCP link with that peer rank at
@@ -946,6 +954,47 @@ def so_buf_map() -> dict[int, tuple[int, int]]:
                 f"MP4J_SO_BUF_MAP entry {tok!r} has a negative value")
         out[rank] = (snd, rcv)
     return out
+
+
+# -- fleet observability (ISSUE 18: mp4j-fleet) ------------------------
+# The cross-job fleet poller (obs/fleet.py) scrapes N job masters'
+# /metrics.json + /health.json control surfaces on a cadence. These
+# knobs configure the SCRAPER, not the jobs: they live on the machine
+# running `mp4j-scope fleet`, so unlike the transport knobs above they
+# carry no job-wide-agreement requirement.
+DEFAULT_FLEET_POLL_SECS = 2.0
+DEFAULT_FLEET_STALE_SECS = 10.0
+
+
+def fleet_poll_secs() -> float:
+    """Fleet poller sweep period (``MP4J_FLEET_POLL_SECS``); must be
+    positive — the poller is stopped by exiting it, not by a zero
+    period."""
+    return env_float("MP4J_FLEET_POLL_SECS", DEFAULT_FLEET_POLL_SECS,
+                     minimum=0.05)
+
+
+def fleet_stale_secs() -> float:
+    """Seconds without a successful scrape before a job's fleet state
+    degrades ``LIVE -> STALE`` (``MP4J_FLEET_STALE_SECS``); ``GONE``
+    follows at 3x this bound (obs.fleet.GONE_FACTOR). Must exceed the
+    poll period in practice or every job flaps STALE between sweeps —
+    the floor only guards nonsense values."""
+    return env_float("MP4J_FLEET_STALE_SECS", DEFAULT_FLEET_STALE_SECS,
+                     minimum=0.1)
+
+
+def fleet_sink_dir() -> str:
+    """The fleet poller's durable history directory
+    (``MP4J_FLEET_SINK_DIR``); empty disables the fleet sink.
+    Validated like ``MP4J_SINK_DIR`` (must not name an existing
+    regular file); creation happens lazily at the first append."""
+    raw = os.environ.get("MP4J_FLEET_SINK_DIR", "").strip()
+    if raw and os.path.isfile(raw):
+        raise Mp4jError(
+            f"MP4J_FLEET_SINK_DIR={raw!r} names an existing regular "
+            "file, not a directory")
+    return raw
 
 
 def fault_plan_spec() -> str:
