@@ -36,6 +36,7 @@ small-rep timings are dominated by that, not device work.
 Prints exactly one JSON line.
 """
 
+import collections
 import json
 import os
 import queue as pyqueue
@@ -1353,6 +1354,273 @@ def bench_fleet_scrape(procs=4, sweeps=60, size=65_536):
     }
 
 
+def _serve_fm_servable(n_features=4096, k=8, seed=7):
+    """A synthesized (numpy-only) FM servable for the serve legs: the
+    serve plane never trains, it pulls rows — random parameters
+    exercise exactly the same dispatch/caching/scoring paths as a
+    trained table, without touching the device runtime (the chaos leg
+    forks, so nothing here may initialize a backend)."""
+    from ytk_mp4j_tpu.models.fm import FMConfig, FMServable
+
+    rng = np.random.default_rng(seed)
+    cfg = FMConfig(n_features=n_features, k=k, model="fm")
+    w0 = np.float32(0.1)
+    w = rng.standard_normal(n_features).astype(np.float32)
+    V = (0.05 * rng.standard_normal((n_features, k))).astype(
+        np.float32)
+    return FMServable((w0, w, V), cfg)
+
+
+def _serve_gbdt_servable(n_features=16, n_bins=16, depth=4,
+                         n_trees=32, seed=5):
+    """A synthesized (numpy-only) GBDT servable: random level-ordered
+    trees in the trainer's component layout — the reduce dispatch
+    cares about routing + margin reduction, not about the split
+    quality, and synthesizing keeps the fork-safety of this block."""
+    from ytk_mp4j_tpu.models.gbdt import GBDTConfig, GBDTServable
+
+    rng = np.random.default_rng(seed)
+    cfg = GBDTConfig(n_features=n_features, n_bins=n_bins,
+                     depth=depth, n_trees=n_trees, loss="logistic",
+                     hist_mode="flat")
+    n_internal = 2 ** depth - 1
+    trees = []
+    for _ in range(n_trees):
+        trees.append((
+            rng.integers(0, n_features, n_internal).astype(np.int32),
+            rng.integers(1, n_bins - 1, n_internal).astype(np.int32),
+            np.zeros(n_internal, np.int32),
+            (0.1 * rng.standard_normal(2 ** depth)).astype(
+                np.float32)))
+    return GBDTServable(trees, cfg)
+
+
+def _serve_fm_requests(n_reqs, n_features, nnz=16, seed=11):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, n_features, nnz).astype(np.int64),
+             np.zeros(nnz, np.int32),
+             rng.standard_normal(nnz).astype(np.float32))
+            for _ in range(n_reqs)]
+
+
+def _serve_threads_job(procs, servable, frontend_body, max_batch,
+                       deadline_ms=2.0, cache_rows=0):
+    """One live serve job on threads (master + ``procs`` slave
+    threads, no fork — the bench_fleet_scrape harness shape): the
+    rank-0 thread builds the :class:`ServeFrontend` and runs
+    ``frontend_body(fe, slave)``; every other rank answers rounds in
+    :func:`serve_worker` until the frontend's STOP. Returns the
+    frontend body's result."""
+    from ytk_mp4j_tpu.comm.master import Master
+    from ytk_mp4j_tpu.comm.process_comm import ProcessCommSlave
+    from ytk_mp4j_tpu.serve import ServeFrontend, serve_worker
+
+    master = Master(procs, timeout=60.0, elastic="off", health=False,
+                    autoscale="off", tuner="off").serve_in_thread()
+    out = {}
+    errs = []
+
+    def worker():
+        try:
+            slave = ProcessCommSlave(
+                "127.0.0.1", master.port, timeout=60.0, elastic="off",
+                async_collectives=False, health=False, tuner="off",
+                shm=False, audit="off", sink_dir="")
+            if slave.rank == 0:
+                fe = ServeFrontend(slave, servable,
+                                   deadline_ms=deadline_ms,
+                                   max_batch=max_batch,
+                                   cache_rows=cache_rows)
+                try:
+                    out["result"] = frontend_body(fe, slave)
+                finally:
+                    fe.close()
+            else:
+                serve_worker(slave, servable, max_batch=max_batch)
+            slave.close(0)
+        except Exception as e:  # pragma: no cover
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(procs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    master.join(10.0)
+    if errs:
+        raise RuntimeError(f"serve bench job failed: {errs}")
+    if any(t.is_alive() for t in threads) or "result" not in out:
+        raise RuntimeError("serve bench job hung")
+    return out["result"]
+
+
+def bench_serve_latency_qps(procs=4, reqs=512):
+    """ISSUE 19 acceptance workload: the micro-batching A/B. Three
+    full serve jobs over the same synthesized FM servable and the
+    same request stream, cache OFF for the first two so every batch
+    pays the pull round (the amortization is the figure, not the
+    cache):
+
+    - **batched** (``max_batch=32``, open loop): per-request latency
+      (enqueue -> resolve, the batcher's own ``on_latency`` hook) is
+      the p50/p99 figure; QPS is requests over wall.
+    - **unbatched** (``max_batch=1``, same open loop): one pull round
+      per REQUEST — the latency-optimal, throughput-terrible corner
+      the batcher exists to escape. The batched/unbatched QPS ratio
+      is ``serve_speedup`` (acceptance: >= 3x at bit-exact results —
+      bitwise equality itself is tier-1's job, tests/test_serve.py).
+    - **warm cache** (``max_batch=32``, cache sized to the table):
+      pass 1 fills, pass 2 replays the stream — the hit-rate and the
+      zero-collective warm QPS figure.
+    """
+    servable = _serve_fm_servable()
+    requests = _serve_fm_requests(reqs, servable.n_rows)
+
+    def open_loop(fe, _slave):
+        # bounded in-flight window: deep enough to keep full batches
+        # forming, shallow enough that the latency series measures
+        # the serve plane, not the submitter's own queue
+        window = 64
+        lats = []
+        orig = fe._batcher._on_latency
+        fe._batcher._on_latency = \
+            lambda s: (lats.append(s), orig(s))
+        t0 = time.perf_counter()
+        futs = collections.deque()
+        for r in requests:
+            futs.append(fe.submit(r))
+            if len(futs) >= window:
+                futs.popleft().wait(120.0)
+        while futs:
+            futs.popleft().wait(120.0)
+        wall = time.perf_counter() - t0
+        return {"wall": wall, "lats": lats,
+                "batches": fe._batcher.batches}
+
+    def warm_loop(fe, _slave):
+        for f in [fe.submit(r) for r in requests]:
+            f.wait(120.0)
+        cold = fe.cache_stats()
+        t0 = time.perf_counter()
+        for f in [fe.submit(r) for r in requests]:
+            f.wait(120.0)
+        wall = time.perf_counter() - t0
+        warm = fe.cache_stats()
+        return {"wall": wall, "cold": cold, "warm": warm}
+
+    batched = _serve_threads_job(procs, servable, open_loop,
+                                 max_batch=32)
+    unbatched = _serve_threads_job(procs, servable, open_loop,
+                                   max_batch=1)
+    cached = _serve_threads_job(procs, servable, warm_loop,
+                                max_batch=32,
+                                cache_rows=servable.n_rows)
+    lat = sorted(batched["lats"])
+    if len(lat) != reqs:
+        raise RuntimeError(
+            f"serve bench: {len(lat)} latencies for {reqs} requests")
+    qps_b = reqs / batched["wall"]
+    qps_u = reqs / unbatched["wall"]
+    speedup = qps_b / qps_u
+    if speedup < 1.5:
+        # the batched plane not clearly beating one-round-per-request
+        # means the amortization is structurally broken (an extra
+        # collective crept into the batch path), not host noise
+        raise RuntimeError(
+            f"serve bench: batched {qps_b:.0f} QPS vs unbatched "
+            f"{qps_u:.0f} QPS (x{speedup:.2f}) — batching is not "
+            "amortizing the pull round")
+    d = {k: cached["warm"][k] - cached["cold"][k]
+         for k in ("hits", "misses")}
+    warm_lookups = d["hits"] + d["misses"]
+    return {
+        "serve_batched_qps": round(qps_b, 1),
+        "serve_unbatched_qps": round(qps_u, 1),
+        "serve_speedup": round(speedup, 2),
+        "serve_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        "serve_p99_ms": round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3),
+        "serve_batches": batched["batches"],
+        "serve_warm_qps": round(reqs / cached["wall"], 1),
+        "serve_warm_hit_rate": round(
+            d["hits"] / warm_lookups, 4) if warm_lookups else 1.0,
+        "serve_cold_hit_rate": round(
+            cached["cold"]["hit_rate"], 4),
+        "reqs": reqs,
+        "procs": procs,
+    }
+
+
+def bench_serve_chaos(procs=3, reqs=48):
+    """ISSUE 19 chaos leg: kill a serving rank mid-stream with a warm
+    spare registered (the PR 10 replace machinery) and measure the
+    blip a CALLER sees. GBDT reduce dispatch — every round is one
+    fixed-shape allreduce, so the adopted spare just joins the next
+    round and the batch the dead rank could not score is DELIVERED
+    degraded (bitmap gap), never hung. ``max_batch=1`` so every
+    request dispatches immediately ("full") and the per-request
+    latency series brackets the recovery window exactly; the p99 over
+    the stream IS the blip."""
+    servable = _serve_gbdt_servable()
+    rng = np.random.default_rng(3)
+    requests = [rng.integers(0, 16, 16).astype(np.int64)
+                for _ in range(reqs)]
+
+    def body(slave, _r):
+        from ytk_mp4j_tpu.serve import ServeFrontend, serve_worker
+        if slave.rank == 0:
+            fe = ServeFrontend(slave, servable, deadline_ms=5.0,
+                               max_batch=1)
+            lats = []
+            for req in requests:
+                t0 = time.perf_counter()
+                fe.predict(req, timeout=60.0)
+                lats.append(time.perf_counter() - t0)
+            degraded = fe.degraded_batches
+            fe.close()
+            return {"lats": lats, "degraded": degraded}
+        return serve_worker(slave, servable, max_batch=1)
+
+    def spare_body(sp):
+        from ytk_mp4j_tpu.serve import serve_worker
+        return serve_worker(sp, servable, max_batch=1)
+
+    # rank 1 answers ~2 serve rounds per request (announce + flush):
+    # nth=reqs lands the kill mid-stream
+    results, killed = _run_elastic_job(
+        procs, body, f"kill:rank=1:nth={reqs}", "replace",
+        spare_body=spare_body, shm=False, audit="off", sink_dir="")
+    if killed != [1] or len(results) != procs:
+        raise RuntimeError(
+            f"serve chaos bench: expected rank 1 killed + {procs} "
+            f"finishers, got killed={killed} "
+            f"results={sorted(results)}")
+    fe_out = results[0]
+    spare_out = results[1]        # the spare reports under rank 1
+    if spare_out.get("rounds", 0) < 1:
+        raise RuntimeError(
+            "serve chaos bench: adopted spare answered no serve "
+            "rounds — the recovery never reached the serve plane")
+    lats = fe_out["lats"]
+    if len(lats) != reqs:
+        raise RuntimeError(
+            f"serve chaos bench: frontend delivered {len(lats)} of "
+            f"{reqs} predictions")
+    s = sorted(lats)
+    median = s[len(s) // 2]
+    return {
+        "serve_chaos_p99_ms": round(
+            s[min(len(s) - 1, int(len(s) * 0.99))] * 1e3, 3),
+        "serve_chaos_healthy_p50_ms": round(median * 1e3, 3),
+        "serve_chaos_blip_ms": round((max(lats) - median) * 1e3, 3),
+        "serve_chaos_degraded_batches": fe_out["degraded"],
+        "serve_chaos_spare_rounds": spare_out["rounds"],
+        "reqs": reqs,
+        "procs": procs,
+    }
+
+
 def bench_ffm_tpu(n=8192, n_features=100_000, n_fields=8, k=8,
                   max_nnz=8, steps=10):
     """FFM sparse embedding-gradient allreduce workload (BASELINE.md
@@ -1697,6 +1965,12 @@ def main():
     # safe at any point in the socket block; the poller scrapes HTTP
     # out of band so no frozen leg changes)
     fleet_scrape = bench_fleet_scrape()
+    # ISSUE 19 (mp4j-serve): the inference plane. The A/B leg runs on
+    # threads; the chaos leg forks worker processes, so both stay in
+    # this socket block ahead of any device-runtime init (the
+    # servables are synthesized numpy-only for exactly that reason)
+    serve_ab = bench_serve_latency_qps()
+    serve_chaos = bench_serve_chaos()
     (tpu_gbs, trees_per_sec, n_chips, gbdt_fps,
      gbdt_hist_fps) = bench_tpu(n=n_tpu)
     ffm_steps, ffm_fps = bench_ffm_tpu()
@@ -1850,6 +2124,14 @@ def main():
             "fleet_scrape": fleet_scrape,
             "fleet_scrape_p99_ms": fleet_scrape[
                 "fleet_scrape_p99_ms"],
+            "serve": serve_ab,
+            "serve_chaos": serve_chaos,
+            "serve_batched_qps": serve_ab["serve_batched_qps"],
+            "serve_unbatched_qps": serve_ab["serve_unbatched_qps"],
+            "serve_speedup": serve_ab["serve_speedup"],
+            "serve_p50_ms": serve_ab["serve_p50_ms"],
+            "serve_p99_ms": serve_ab["serve_p99_ms"],
+            "serve_chaos_p99_ms": serve_chaos["serve_chaos_p99_ms"],
             "socket_elastic": {"replace": replacement,
                                "shrink": shrinkage,
                                "planned_evict": planned_evict,

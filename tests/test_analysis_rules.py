@@ -455,7 +455,7 @@ def test_syntax_error_reported_as_finding():
 
 def test_rule_catalogue_complete():
     ids = [cls.rule_id for cls in ALL_RULES]
-    assert ids == [f"R{i}" for i in range(1, 28)]
+    assert ids == [f"R{i}" for i in range(1, 29)]
     with pytest.raises(KeyError):
         get_rules(["R99"])
 
@@ -2077,6 +2077,95 @@ def test_r27_inline_suppression():
     """, path=OBS_PATH)
     assert not r.findings
     assert any(f.rule == "R27" for f in r.suppressed)
+
+
+# ----------------------------------------------------------------------
+# R28 — serve-path wait without a deadline / wall clock in serve/
+# ----------------------------------------------------------------------
+SERVE_PATH = "ytk_mp4j_tpu/serve/snippet.py"
+
+
+def test_r28_fires_on_unbounded_wait():
+    r = run_rule("R28", """
+        class Batcher:
+            def flush(self):
+                self._ready.wait()
+    """, path=SERVE_PATH)
+    [f] = r.findings
+    assert f.rule == "R28" and f.line == 4
+    assert "timeout" in f.message
+
+
+def test_r28_fires_on_each_unbounded_blocker():
+    r = run_rule("R28", """
+        def drain(self):
+            self._lock.acquire()
+            self._thread.join()
+            return self._fut.result()
+    """, path=SERVE_PATH)
+    assert [f.line for f in r.findings] == [3, 4, 5]
+    assert all(f.rule == "R28" for f in r.findings)
+
+
+def test_r28_fires_on_wall_clock():
+    r = run_rule("R28", """
+        import time
+        import datetime
+
+        def stamp(self):
+            self.t0 = time.time()
+            self.day = datetime.datetime.now()
+    """, path=SERVE_PATH)
+    assert [f.line for f in r.findings] == [6, 7]
+    assert "monotonic" in r.findings[0].message
+
+
+def test_r28_fires_on_bare_time_import():
+    r = run_rule("R28", """
+        from time import time
+
+        def stamp(self):
+            return time()
+    """, path=SERVE_PATH)
+    [f] = r.findings
+    assert f.rule == "R28" and f.line == 5
+
+
+def test_r28_quiet_with_timeouts_and_monotonic():
+    r = run_rule("R28", """
+        import time
+
+        def flush(self, w):
+            due = time.monotonic() + self.deadline
+            self._cv.wait(timeout=w)
+            self._fut.result(w)
+            self._thread.join(w)
+            return ",".join(["a", "b"])
+    """, path=SERVE_PATH)
+    assert not r.findings
+
+
+def test_r28_quiet_outside_serve():
+    # comm/obs keep their own discipline (R2/R11/R18); R28 is the
+    # serve plane's tighter contract only
+    r = run_rule("R28", """
+        import time
+
+        def wait_all(self):
+            self._done.wait()
+            return time.time()
+    """)
+    assert not r.findings
+
+
+def test_r28_inline_suppression():
+    r = run_rule("R28", """
+        def close(self):
+            # mp4j-lint: disable=R28 (process teardown, not serve path)
+            self._thread.join()
+    """, path=SERVE_PATH)
+    assert not r.findings
+    assert any(f.rule == "R28" for f in r.suppressed)
 
 
 # ----------------------------------------------------------------------

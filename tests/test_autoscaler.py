@@ -141,6 +141,66 @@ def test_decide_quiet_without_mode_or_verdicts():
     assert autoscaler.decide(None, None, provisionable=True) == []
 
 
+def _serve_doc(qps, active=True):
+    return {"active": active, "qps": qps}
+
+
+_LOAD_KW = dict(idle_qps=1.0, busy_qps=100.0, idle_secs=60.0)
+
+
+def test_decide_load_shrink_needs_sustained_idle():
+    # first idle sample only ARMS the window
+    props, since = autoscaler.decide_load(
+        _serve_doc(0.2), _ms_doc(), None, 1000.0, **_LOAD_KW)
+    assert props == [] and since == 1000.0
+    # mid-window: still quiet, window keeps its origin
+    props, since = autoscaler.decide_load(
+        _serve_doc(0.2), _ms_doc(), since, 1030.0, **_LOAD_KW)
+    assert props == [] and since == 1000.0
+    # window elapsed: propose the shrink
+    props, since = autoscaler.decide_load(
+        _serve_doc(0.2), _ms_doc(), since, 1061.0, **_LOAD_KW)
+    assert [p["action"] for p in props] == ["serve_shrink"]
+    assert "over-provisioned" in props[0]["why"]
+    # a traffic burst DISARMS the window
+    props, since = autoscaler.decide_load(
+        _serve_doc(50.0), _ms_doc(), 1000.0, 1061.0, **_LOAD_KW)
+    assert props == [] and since is None
+
+
+def test_decide_load_grow_on_busy_rate_with_spares():
+    props, since = autoscaler.decide_load(
+        _serve_doc(250.0), _ms_doc(spares=2), None, 5.0, **_LOAD_KW)
+    assert [p["action"] for p in props] == ["serve_grow"]
+    assert "resize_point" in props[0]["why"]
+    assert since is None
+    # no spares: nothing to pace in, so no proposal
+    props, _ = autoscaler.decide_load(
+        _serve_doc(250.0), _ms_doc(spares=0), None, 5.0, **_LOAD_KW)
+    assert props == []
+
+
+def test_decide_load_quiet_for_batch_jobs():
+    assert autoscaler.decide_load(
+        None, _ms_doc(), 1.0, 2.0, **_LOAD_KW) == ([], None)
+    assert autoscaler.decide_load(
+        _serve_doc(0.0, active=False), _ms_doc(), 1.0, 2.0,
+        **_LOAD_KW) == ([], None)
+
+
+def test_serve_actions_are_observe_first_even_in_act_mode():
+    """The ACTIONS vocabulary carries the serve pair, and the state
+    ledger counts them under `observed` — by construction the tick
+    wiring routes them through _observe() only (never _execute), so
+    the ledger is the contract an act-mode job can rely on."""
+    assert "serve_shrink" in autoscaler.ACTIONS
+    assert "serve_grow" in autoscaler.ACTIONS
+    st = autoscaler.ControllerState()
+    assert st.serve_idle_since is None
+    assert st.observed["serve_shrink"] == 0
+    assert st.actions["serve_grow"] == 0
+
+
 def test_gate_rails():
     st = autoscaler.ControllerState()
     kw = dict(cooldown_secs=10.0, budget=2, audit=None)

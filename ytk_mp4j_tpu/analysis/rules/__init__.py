@@ -29,6 +29,7 @@
 | R25 | error   | thread started without join/daemon/stop (whole-program) |
 | R26 | warning | in-loop i* submit awaited with no compute (overlap defeated) |
 | R27 | warning | HTTP fetch without explicit timeout in obs/ scrape code |
+| R28 | error   | serve-path wait without deadline / wall clock in serve/ |
 
 R19-R21 and R23-R25 are
 :class:`~ytk_mp4j_tpu.analysis.engine.ProgramRule` instances: they
@@ -85,6 +86,8 @@ from ytk_mp4j_tpu.analysis.rules.r26_immediate_await import (
     R26ImmediateAwait)
 from ytk_mp4j_tpu.analysis.rules.r27_http_timeout import (
     R27HttpNoTimeout)
+from ytk_mp4j_tpu.analysis.rules.r28_serve_deadline import (
+    R28ServeDeadline)
 
 ALL_RULES = [
     R1RankConditionalCollective,
@@ -114,6 +117,7 @@ ALL_RULES = [
     R25ThreadLifecycle,
     R26ImmediateAwait,
     R27HttpNoTimeout,
+    R28ServeDeadline,
 ]
 
 RULES_BY_ID = {cls.rule_id: cls for cls in ALL_RULES}

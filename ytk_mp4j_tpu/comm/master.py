@@ -2638,6 +2638,8 @@ class Master:
         for r, info in ranks.items():
             info["audit_seq"] = int(
                 audit_status["rank_seq"].get(r, 0))
+        serve_status = _serve_section(ranks,
+                                      cluster_metrics["histograms"])
         return {
             # job identity at top level (ISSUE 18): same fields as
             # job_doc(), sampled under the SAME lock hold as the rank
@@ -2661,8 +2663,18 @@ class Master:
                 "health": health_status,
                 "autoscale": autoscale_status,
                 "tuner": tuner_status,
+                "serve": serve_status,
             },
         }
+
+    def serve_status(self) -> dict | None:
+        """The master's serve-roster surface (ISSUE 19): the folded
+        serve section of :meth:`metrics_doc` — QPS, latency
+        quantiles, cache hit rate, degraded-batch count — or ``None``
+        when no rank has reported serve traffic (a pure training
+        job). The autoscaler's load-following policy and
+        ``mp4j-scope live/fleet`` read exactly this."""
+        return self.metrics_doc()["cluster"]["serve"]
 
     def _membership_status_locked(self) -> dict:
         """ONE definition of the membership snapshot (availability
@@ -2909,6 +2921,55 @@ class Master:
         # a barrier arrival can complete an armed eviction fence (a
         # rank idling in a barrier IS at a boundary — ISSUE 13)
         self._check_fence()
+
+
+def _serve_section(ranks: dict, cluster_hists: dict) -> dict | None:
+    """Fold the per-rank serve counters/gauges into the cluster serve
+    section (ISSUE 19): ``None`` for a job that never served a
+    request (no zero-noise in docs or Prometheus), else QPS (the
+    frontend's sliding-window gauge), p50/p99 request latency from
+    the folded ``latency/serve_request`` histogram, cache hit rate
+    and the degraded-batch count. Pure function of the already-built
+    doc pieces — called outside the master lock."""
+    counters: dict[str, float] = {}
+    qps = 0.0
+    for info in ranks.values():
+        for k, v in (info.get("counters") or {}).items():
+            if k.startswith("serve/"):
+                counters[k] = counters.get(k, 0) + v
+        g = (info.get("gauges") or {}).get("serve/qps")
+        if g is not None:
+            # one frontend owns the gauge; max() tolerates a stale
+            # zero from a rank that briefly fronted earlier
+            qps = max(qps, float(g))
+    if not counters:
+        return None
+    h = cluster_hists.get("latency/serve_request")
+    p50 = metrics_mod.hist_quantile(h, 0.50) if h else 0.0
+    p99 = metrics_mod.hist_quantile(h, 0.99) if h else 0.0
+    if h:
+        # overflow-bucket quantiles come back +Inf; clamp to the
+        # histogram's top finite edge so the doc stays strict JSON
+        top = h["lo"] * 2.0 ** h["n"]
+        p50 = min(p50, top)
+        p99 = min(p99, top)
+    hits = counters.get("serve/cache_hits", 0)
+    misses = counters.get("serve/cache_misses", 0)
+    return {
+        "active": True,
+        "qps": qps,
+        "requests": int(counters.get("serve/requests", 0)),
+        "batches": int(counters.get("serve/batches", 0)),
+        "batch_deadline": int(counters.get("serve/batch_deadline", 0)),
+        "batch_full": int(counters.get("serve/batch_full", 0)),
+        "p50_ms": p50 * 1e3,
+        "p99_ms": p99 * 1e3,
+        "hit_rate": (hits / (hits + misses)
+                     if (hits + misses) else None),
+        "stale_rows": int(counters.get("serve/cache_stale", 0)),
+        "degraded_batches": int(
+            counters.get("serve/degraded_batches", 0)),
+    }
 
 
 def main(argv=None) -> int:

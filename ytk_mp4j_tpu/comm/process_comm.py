@@ -557,6 +557,14 @@ class ProcessCommSlave(CommSlave):
     def slave_num(self) -> int:
         return self._n
 
+    def metrics_registry(self):
+        """This rank's live :class:`~ytk_mp4j_tpu.obs.metrics.
+        MetricsRegistry` — the sanctioned write surface for planes
+        layered ON the comm (the serve frontend's latency/QPS/cache
+        families ride the same heartbeat deltas as the collective
+        stats; ISSUE 19)."""
+        return self._comm_stats.metrics
+
     def _master_send(self, obj) -> None:
         """Serialized master-channel send (shared by the caller's
         control messages and the heartbeat thread)."""

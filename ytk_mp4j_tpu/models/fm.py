@@ -769,3 +769,110 @@ class FMTrainer(DataParallelTrainer):
         return np.asarray(predict(params, jnp.asarray(feats),
                                   jnp.asarray(fields), jnp.asarray(vals),
                                   jnp.asarray(mask), self.cfg))
+
+
+# ----------------------------------------------------------------------
+# serve adapter (ISSUE 19): the pull-mode sharded entry point
+# ----------------------------------------------------------------------
+class FMServable:
+    """Row-pull serve adapter for a trained FM / FFM model — the host
+    twin of :meth:`FMTrainer._build_sharded_predict` (the AOT
+    ``ffm/sharded_serve`` program): the full table is never
+    materialized on the frontend; a batch pulls exactly the rows it
+    touches, owner-routed by ``row_id % size`` over the columnar map
+    plane, and hot rows come out of the frontend cache instead.
+
+    A pull ROW is one feature's whole serve payload: ``[w[f]]`` +
+    its embedding row(s) — ``1 + k`` floats for FM, ``1 +
+    n_fields * k`` for FFM (feature f's rows against every field,
+    flattened). Scoring is per example in slot order, so batched and
+    sequential serve predictions are bitwise identical by
+    construction.
+    """
+
+    kind = "pull"
+
+    def __init__(self, params, cfg: FMConfig):
+        w0, w, V = params
+        self.cfg = cfg
+        self.family = cfg.model
+        self._w0 = float(jax.device_get(w0))
+        self._w = np.asarray(jax.device_get(w), np.float32)
+        V = np.asarray(jax.device_get(V), np.float32)
+        nf = cfg.n_fields if cfg.model == "ffm" else 1
+        # [n_features, nf * k]: feature f's embedding payload
+        self._E = np.ascontiguousarray(
+            V[:cfg.n_features * nf].reshape(cfg.n_features,
+                                            nf * cfg.k))
+        self.n_rows = cfg.n_features
+        self.row_width = 1 + nf * cfg.k
+        self.resp_width = 1
+
+    def row_ids(self, req) -> np.ndarray:
+        """Unique features an instance's ACTIVE slots touch."""
+        feats, _fields, vals = req
+        return np.unique(np.asarray(feats, np.int64)[
+            np.asarray(vals, np.float32) != 0])
+
+    def rows(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        return np.concatenate(
+            [self._w[ids, None], self._E[ids]],
+            axis=1).astype(np.float64)
+
+    def predict_sharded(self, reqs, rowmap) -> list:
+        out = []
+        k = self.cfg.k
+        zero = np.zeros(self.row_width, np.float32)
+        for feats, fields, vals in reqs:
+            feats = np.asarray(feats, np.int64)
+            fields = np.asarray(fields, np.int32)
+            vals = np.asarray(vals, np.float32)
+            act = np.flatnonzero(vals != 0)
+            rows = [rowmap.get(int(feats[a]))
+                    for a in act]
+            rows = [zero if r is None else r.astype(np.float32)
+                    for r in rows]
+            z = np.float32(self._w0)
+            for r, a in zip(rows, act):
+                z += r[0] * vals[a]
+            if self.cfg.model == "fm":
+                # 0.5 * ((sum_a v_a x_a)^2 - sum_a (v_a x_a)^2) over k
+                s = np.zeros(k, np.float32)
+                ss = np.zeros(k, np.float32)
+                for r, a in zip(rows, act):
+                    ex = r[1:] * vals[a]
+                    s += ex
+                    ss += ex * ex
+                z += np.float32(0.5) * np.sum(s * s - ss)
+            else:
+                # FFM: sum_{a<b} <E[f_a, fl_b], E[f_b, fl_a]> x_a x_b
+                for i in range(len(act)):
+                    for j in range(i + 1, len(act)):
+                        a, b = act[i], act[j]
+                        ra = rows[i][1 + fields[b] * k:
+                                     1 + (fields[b] + 1) * k]
+                        rb = rows[j][1 + fields[a] * k:
+                                     1 + (fields[a] + 1) * k]
+                        z += np.dot(ra, rb) * vals[a] * vals[b]
+            out.append(_serve_link(z, self.cfg.loss))
+        return out
+
+
+def _serve_link(z, loss: str) -> np.ndarray:
+    """Overflow-safe host link on a scalar margin."""
+    z = float(z)
+    if loss == "logistic":
+        if z >= 0:
+            p = 1.0 / (1.0 + np.exp(-z))
+        else:
+            e = np.exp(z)
+            p = e / (1.0 + e)
+        return np.asarray([p], np.float64)
+    return np.asarray([z], np.float64)
+
+
+def servable(params, cfg: FMConfig) -> FMServable:
+    """The serve plane's per-family entry point (ISSUE 19) — covers
+    both ``model="fm"`` and ``model="ffm"``."""
+    return FMServable(params, cfg)

@@ -338,3 +338,94 @@ class LinearTrainer(DataParallelTrainer):
     def predict(self, params, x: np.ndarray) -> np.ndarray:
         x = jnp.asarray(np.asarray(x, np.float32))
         return np.asarray(predict(params, x, self.cfg))
+
+
+# ----------------------------------------------------------------------
+# serve adapter (ISSUE 19): the pull-mode sharded entry point
+# ----------------------------------------------------------------------
+class LinearServable:
+    """Row-pull serve adapter for a trained linear model.
+
+    ``kind="pull"``: the serve dispatcher shards the weight table by
+    ``row_id % size`` across the job's ranks and the frontend pulls
+    only the rows a batch touches over the columnar map plane —
+    mirroring the owner-routed row fetch of the FFM AOT
+    ``sharded_serve`` program on the host substrate. A row here is
+    one feature's weight(s): width 1, or ``n_classes`` for softmax.
+    Scoring is per example (never across the batch), so batched and
+    sequential serve predictions are bitwise identical by
+    construction.
+    """
+
+    kind = "pull"
+    family = "linear"
+
+    def __init__(self, params, cfg: LinearConfig):
+        w, b = params
+        self.cfg = cfg
+        w = np.asarray(jax.device_get(w), np.float32)
+        self._w = w if w.ndim == 2 else w[:, None]     # [D, width]
+        self._b = np.atleast_1d(
+            np.asarray(jax.device_get(b), np.float32))
+        self.n_rows = self._w.shape[0]
+        self.row_width = self._w.shape[1]
+        self.resp_width = (cfg.n_classes if cfg.loss == "softmax"
+                          else 1)
+
+    def row_ids(self, req) -> np.ndarray:
+        """Unique table rows one request touches (active slots only —
+        a zero-valued slot contributes nothing, so its row is never
+        pulled)."""
+        ids, _fields, vals = req
+        return np.unique(np.asarray(ids, np.int64)[
+            np.asarray(vals, np.float32) != 0])
+
+    def rows(self, ids) -> np.ndarray:
+        """Float64 row vectors for the pull plane (the wire operand of
+        ``allreduce_map`` is DOUBLE)."""
+        return self._w[np.asarray(ids, np.int64)].astype(np.float64)
+
+    def predict_sharded(self, reqs, rowmap) -> list:
+        """Score a batch from pulled rows; one float64 vector per
+        request. A row missing from ``rowmap`` scores as zeros — the
+        degraded-but-deliverable contract the dispatcher's status byte
+        reports."""
+        out = []
+        zero = np.zeros(self.row_width, np.float32)
+        for ids, _fields, vals in reqs:
+            ids = np.asarray(ids, np.int64)
+            vals = np.asarray(vals, np.float32)
+            z = self._b.astype(np.float32).copy()
+            if self.cfg.loss != "softmax":
+                z = z[:1].copy()
+            for a in range(ids.shape[0]):
+                if vals[a] == 0:
+                    continue
+                row = rowmap.get(int(ids[a]))
+                row = zero if row is None else row.astype(np.float32)
+                z += row * vals[a]
+            out.append(_link(z, self.cfg.loss))
+        return out
+
+
+def _link(z: np.ndarray, loss: str) -> np.ndarray:
+    """The prediction link on a host margin vector (numpy mirror of
+    :func:`predict`'s heads, overflow-safe)."""
+    z = np.asarray(z, np.float32)
+    if loss == "logistic":
+        p = np.empty_like(z, np.float64)
+        pos = z >= 0
+        p[pos] = 1.0 / (1.0 + np.exp(-z[pos].astype(np.float64)))
+        e = np.exp(z[~pos].astype(np.float64))
+        p[~pos] = e / (1.0 + e)
+        return p
+    if loss == "softmax":
+        s = z.astype(np.float64) - z.max()
+        e = np.exp(s)
+        return e / e.sum()
+    return z.astype(np.float64)
+
+
+def servable(params, cfg: LinearConfig) -> LinearServable:
+    """The serve plane's per-family entry point (ISSUE 19)."""
+    return LinearServable(params, cfg)

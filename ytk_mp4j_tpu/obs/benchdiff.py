@@ -112,6 +112,21 @@ THRESHOLDS: dict[str, float] = {
     # exists to catch a fold/detector complexity regression, which
     # shows as a multiple, not a percent
     "fleet_scrape_p99_ms": 1.0,
+    # ISSUE 19 (mp4j-serve): the inference plane. The QPS rows gate
+    # the micro-batched and unbatched throughputs (loopback noise
+    # floor, like the other socket figures) and the speedup row gates
+    # the batching win itself — a RATIO, already normalized against
+    # host speed. The latency rows (LOWER is better, see below) carry
+    # the membership-row caveat: single-digit-ms tails on a shared
+    # 1-core host swing run to run, so the gate exists to catch a
+    # protocol regression (an extra collective per batch, a lost
+    # deadline), which shows as a multiple, not a percent
+    "serve_batched_qps": 0.25,
+    "serve_unbatched_qps": 0.25,
+    "serve_speedup": 0.25,
+    "serve_p50_ms": 1.0,
+    "serve_p99_ms": 1.0,
+    "serve_chaos_p99_ms": 1.0,
     # ISSUE 16: mp4j-lint v3 (R23-R25 lockset/resource whole-program
     # passes) over v2 (R19-R21) — a RATIO, so already normalized
     # against host speed; the budget bounds growth of the marginal
@@ -130,6 +145,9 @@ LOWER_IS_BETTER = frozenset({
     "socket_grow_latency_ms",
     "fleet_scrape_p99_ms",
     "lint_v3_over_v2_ratio",
+    "serve_p50_ms",
+    "serve_p99_ms",
+    "serve_chaos_p99_ms",
 })
 
 

@@ -137,6 +137,10 @@ def job_summary(metrics_doc: dict, health_doc: dict | None = None
     for s in hstates.values():
         ladder[s] = ladder.get(s, 0) + 1
     asc = cl.get("autoscale") or {}
+    # serve summary (ISSUE 19): carried whole so the fleet view can
+    # render serve jobs distinctly (QPS cell); None for batch jobs
+    serve = cl.get("serve") if (cl.get("serve") or {}).get("active") \
+        else None
     return {
         "job_id": str(metrics_doc.get("job_id") or ""),
         "started_wall": metrics_doc.get("started_wall"),
@@ -160,6 +164,7 @@ def job_summary(metrics_doc: dict, health_doc: dict | None = None
         "autoscale_actions": int(
             sum((asc.get("actions") or {}).values())
             + sum((asc.get("observed") or {}).values())),
+        "serve": serve,
     }
 
 

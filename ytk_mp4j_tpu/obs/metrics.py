@@ -84,6 +84,26 @@ METRICS_DOC: dict[str, str] = {
                          "on this rank's scheduler (ISSUE 11)",
     "tuner/decisions": "per-link tuner decisions APPLIED at collective "
                        "boundaries on this rank (ISSUE 15)",
+    # -- serve plane (ISSUE 19) — the latency/serve_request histogram
+    # rides the latency/<family> row above
+    "serve/requests": "requests the serve frontend completed",
+    "serve/batches": "micro-batches dispatched",
+    "serve/batch_full": "batches dispatched because max_batch filled",
+    "serve/batch_deadline": "batches dispatched at the accumulation "
+                            "deadline (MP4J_SERVE_DEADLINE_MS)",
+    "serve/cache_hits": "hot-key cache row hits",
+    "serve/cache_misses": "hot-key cache row misses (pulled over the "
+                          "columnar map plane)",
+    "serve/cache_stale": "cached rows dropped past the staleness "
+                         "bound (MP4J_SERVE_STALE_VERSIONS)",
+    "serve/cache_rows": "rows resident in the hot-key cache now",
+    "serve/pull_rows": "rows pulled from the sharded table",
+    "serve/degraded_batches": "batches delivered with an incomplete "
+                              "contributor set (replacement warming "
+                              "up / out-of-vocabulary rows) — "
+                              "delivered, not hung, but say so",
+    "serve/qps": "serve requests per second (sliding window)",
+    "serve/worker_rounds": "serve rounds a worker rank answered",
     # -- Prometheus series (the /metrics endpoint) --------------------
     "mp4j_ranks_reporting": "ranks whose heartbeats the master holds",
     "mp4j_slave_num": "the job's configured rank count",
@@ -150,6 +170,20 @@ METRICS_DOC: dict[str, str] = {
                               "breaker tripped it back to "
                               "recommend-only (two consecutive "
                               "failed actions)",
+    # -- serve plane (ISSUE 19) -----------------------------------------
+    "mp4j_serve_requests_total": "serve requests completed per rank "
+                                 "(+ cluster total)",
+    "mp4j_serve_batches_total": "serve micro-batches dispatched per "
+                                "rank (+ cluster total)",
+    "mp4j_serve_cache_hits_total": "serve hot-key cache hits per rank "
+                                   "(+ cluster total)",
+    "mp4j_serve_cache_misses_total": "serve hot-key cache misses per "
+                                     "rank (+ cluster total)",
+    "mp4j_serve_degraded_batches_total": "serve batches delivered "
+                                         "degraded per rank (+ "
+                                         "cluster total)",
+    "mp4j_serve_qps": "cluster serve requests per second (frontend "
+                      "sliding window)",
     # -- self-tuning data plane (ISSUE 15) ------------------------------
     "mp4j_tuner_decisions_total": "per-link tuner decisions applied "
                                   "per rank (+ cluster total)",
@@ -626,6 +660,35 @@ def to_prometheus(doc: dict) -> str:
         out.append("# TYPE mp4j_tuner_tripped gauge")
         out.append(f"mp4j_tuner_tripped "
                    f"{1 if tun.get('tripped') else 0}")
+
+    # serve plane (ISSUE 19): per-rank request/batch/cache counters
+    # (frontend families, worker rounds fold into the same names) plus
+    # the frontend's sliding-window QPS gauge — present only for
+    # serving jobs (no zero-noise for pure training jobs)
+    for key, metric in (
+            ("serve/requests", "mp4j_serve_requests_total"),
+            ("serve/batches", "mp4j_serve_batches_total"),
+            ("serve/cache_hits", "mp4j_serve_cache_hits_total"),
+            ("serve/cache_misses", "mp4j_serve_cache_misses_total"),
+            ("serve/degraded_batches",
+             "mp4j_serve_degraded_batches_total")):
+        block = []
+        total = 0.0
+        for r in whos:
+            v = doc["ranks"][r].get("counters", {}).get(key)
+            if v:
+                total += v
+                block.append(f'{metric}{{rank="{_esc(r)}"}} '
+                             f"{_fmt(float(v))}")
+        if block:
+            block.append(f'{metric}{{rank="cluster"}} '
+                         f"{_fmt(float(total))}")
+            out.append(f"# TYPE {metric} counter")
+            out.extend(block)
+    srv = doc.get("cluster", {}).get("serve")
+    if srv is not None and srv.get("active"):
+        out.append("# TYPE mp4j_serve_qps gauge")
+        out.append(f"mp4j_serve_qps {_fmt(float(srv.get('qps', 0.0)))}")
 
     # autoscaler (ISSUE 13): per-action dispatch counters + the
     # circuit-breaker gauge — present whenever the master runs a

@@ -174,6 +174,21 @@ def format_live(doc: dict) -> str:
                      f"{ev.get('from')}->{ev.get('to')} "
                      f"({ev.get('detector')}) "
                      f"{str(ev.get('msg', ''))[:60]}")
+    # serve head-line (ISSUE 19): the inference plane's QPS / tail
+    # latency / cache hit-rate / degraded tally; absent entirely for
+    # training jobs (no serve/* counters anywhere in the registry)
+    sv = cl.get("serve") or {}
+    if sv.get("active"):
+        hr = sv.get("hit_rate")
+        head += (f"\nserve: {sv.get('qps', 0.0):.1f} QPS | "
+                 f"p50 {sv.get('p50_ms', 0.0):.2f}ms "
+                 f"p99 {sv.get('p99_ms', 0.0):.2f}ms | "
+                 f"{sv.get('requests', 0)} req in "
+                 f"{sv.get('batches', 0)} batch(es) | cache "
+                 + (f"{100.0 * hr:.0f}% hit" if hr is not None
+                    else "off")
+                 + (f" | {sv['degraded_batches']} DEGRADED"
+                    if sv.get("degraded_batches") else ""))
     # autoscaler head-line (ISSUE 13): mode, trip state, action tally;
     # absent entirely when MP4J_AUTOSCALE=off (no controller exists)
     asc = cl.get("autoscale") or {}
@@ -321,7 +336,8 @@ def format_fleet(model: dict) -> str:
             f"{agg.get('collectives_per_sec', 0.0):.1f} coll/s")
     lines = [head,
              f"{'job':<10} {'state':<12} {'ranks':>6} {'MB/s':>8} "
-             f"{'coll/s':>7} {'rtry':>4} {'health':>7} {'gen':>3}  url"]
+             f"{'coll/s':>7} {'QPS':>7} {'rtry':>4} {'health':>7} "
+             f"{'gen':>3}  url"]
     for key in sorted(jobs):
         st = jobs[key]
         s = st.get("summary")
@@ -329,15 +345,22 @@ def format_fleet(model: dict) -> str:
                                  float(st.get("age", 0.0)))
         if s is None:
             lines.append(f"{'-':<10} {cell:<12} {'-':>6} {'-':>8} "
-                         f"{'-':>7} {'-':>4} {'-':>7} {'-':>3}  "
-                         f"{st.get('url', key)} (never scraped)")
+                         f"{'-':>7} {'-':>7} {'-':>4} {'-':>7} "
+                         f"{'-':>3}  {st.get('url', key)} "
+                         f"(never scraped)")
             continue
         ranks_cell = f"{s['ranks_reporting']}/{s['slave_num']}"
+        # serve jobs read distinctly from batch jobs (ISSUE 19): the
+        # QPS cell is a number only when the job runs the inference
+        # plane; "-" for pure training jobs
+        sv = s.get("serve")
+        qps_cell = f"{sv['qps']:.1f}" if sv else "-"
         lines.append(
             f"{(s['job_id'] or '-'):<10.10} {cell:<12} "
             f"{ranks_cell:>6} "
             f"{s['bytes_per_sec'] / 1e6:>8.2f} "
             f"{s['collectives_per_sec']:>7.1f} "
+            f"{qps_cell:>7} "
             f"{s['retries']:>4d} "
             f"{_health_tally(s['health']['states']):>7} "
             f"{s['roster_gen']:>3d}  {st.get('url', key)}")

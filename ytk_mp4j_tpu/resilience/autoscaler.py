@@ -39,6 +39,15 @@ self-healing substrate. Four actions:
    controller back to recommend-only with a structured alert —
    degraded advice is strictly safer than a flapping actuator.
 
+ISSUE 19 adds **load-following** over the serve plane
+(:func:`decide_load`): ``serve_shrink`` when serve QPS stays under
+``MP4J_SERVE_IDLE_QPS`` for ``MP4J_SERVE_IDLE_SECS`` straight,
+``serve_grow`` (pace a spare in at the app's next ``resize_point()``)
+when QPS crosses ``MP4J_SERVE_BUSY_QPS`` with spares registered. Both
+ship OBSERVE-FIRST: they ride the gate (pacing) and the alert pipe,
+and never the actuator — even under ``act`` — until the
+recommendations prove out in the field.
+
 The policy core — :func:`decide`, :func:`gate`, :func:`resolve_pending`
 — is pure functions over ``health_status()`` / ``membership_status()``
 / ``audit_status()`` snapshots (the health-engine convention: tests
@@ -73,8 +82,13 @@ import time
 
 from ytk_mp4j_tpu.utils import tuning
 
-# the controller's action vocabulary (the Prometheus `action` label)
-ACTIONS = ("evict_replace", "provision", "grow")
+# the controller's action vocabulary (the Prometheus `action` label).
+# serve_shrink / serve_grow are the load-following pair (ISSUE 19):
+# OBSERVE-FIRST by design — even under MP4J_AUTOSCALE=act they route
+# through the alert pipe only, never the actuator, until the
+# recommendations prove trustworthy in the field
+ACTIONS = ("evict_replace", "provision", "grow",
+           "serve_shrink", "serve_grow")
 
 # how long a dispatched action may stay pending before it counts as
 # FAILED, as a multiple of the adoption deadline (the slowest step an
@@ -109,6 +123,9 @@ class ControllerState:
         # the ONE in-flight action: {"action", "rank"?, "since" (mono),
         # "deadline" (mono), "baseline" (membership counter snapshot)}
         self.pending: dict | None = None
+        # monotonic instant the serve plane's QPS first dipped under
+        # the idle threshold; None while busy (load-following hysteresis)
+        self.serve_idle_since: float | None = None
         self.events: collections.deque = collections.deque(maxlen=64)
 
 
@@ -181,6 +198,50 @@ def decide(health: dict | None, membership: dict | None,
                 "action": "provision",
                 "why": "warm-spare pool drained to 0"})
     return out
+
+
+def decide_load(serve: dict | None, membership: dict | None,
+                idle_since: float | None, now: float, *,
+                idle_qps: float, busy_qps: float,
+                idle_secs: float) -> tuple[list[dict], float | None]:
+    """The load-following policy over the serve plane (ISSUE 19) —
+    pure, like :func:`decide`. Two proposals:
+
+    - ``serve_shrink`` when the inference plane's QPS has stayed at or
+      under ``idle_qps`` for ``idle_secs`` straight (the sustained-idle
+      window is the hysteresis: a single quiet scrape proposes
+      nothing);
+    - ``serve_grow`` the moment QPS reaches ``busy_qps`` while warm
+      spares are registered — growth happens at the app's next
+      :meth:`resize_point`, so the proposal is the *recommendation to
+      pace one in*, not an adoption.
+
+    Returns ``(proposals, new_idle_since)``; the caller stores the
+    second element back into :class:`ControllerState` — the function
+    itself owns no clock and no state."""
+    if not serve or not serve.get("active"):
+        return [], None
+    qps = float(serve.get("qps", 0.0) or 0.0)
+    if qps >= busy_qps:
+        out = []
+        if int((membership or {}).get("spares_available", 0) or 0) > 0:
+            out.append({
+                "action": "serve_grow",
+                "why": (f"serve QPS {qps:.1f} >= busy threshold "
+                        f"{busy_qps:.1f} — pace a spare in at the "
+                        "next resize_point()")})
+        return out, None
+    if qps > idle_qps:
+        return [], None
+    if idle_since is None:
+        return [], now
+    if now - idle_since >= idle_secs:
+        return [{
+            "action": "serve_shrink",
+            "why": (f"serve QPS {qps:.1f} <= idle threshold "
+                    f"{idle_qps:.1f} for {now - idle_since:.0f}s — "
+                    "the replica set is over-provisioned")}], idle_since
+    return [], idle_since
 
 
 def resolve_pending(pending: dict, membership: dict | None,
@@ -262,6 +323,11 @@ class Autoscaler:
         self._tick = max(0.05, min(float(tick_secs), 1.0))
         self._deadline_secs = max(
             _DEADLINE_FLOOR, _DEADLINE_ADOPTS * master._adopt_secs)
+        # load-following thresholds (ISSUE 19), frozen at construction
+        # like the cooldown/budget knobs
+        self._serve_idle_qps = tuning.serve_idle_qps()
+        self._serve_busy_qps = tuning.serve_busy_qps()
+        self._serve_idle_secs = tuning.serve_idle_secs()
         self._lock = threading.Lock()
         self.state = ControllerState()
         self._alert_seq = 0
@@ -333,6 +399,30 @@ class Autoscaler:
                     st.pending, membership, now)
                 if verdict != "pending":
                     self._settle_locked(verdict, detail, now)
+        # load-following (ISSUE 19): sample the serve section and run
+        # the pure policy; proposals route through the SAME gate (so a
+        # persistent verdict is one line per cooldown) and then through
+        # _observe UNCONDITIONALLY — serve actions ship observe-first,
+        # even in act mode (module docstring / ACTIONS comment)
+        serve_fn = getattr(m, "serve_status", None)
+        serve = serve_fn() if serve_fn is not None else None
+        with self._lock:
+            idle_since = self.state.serve_idle_since
+        load_props, idle_since = decide_load(
+            serve, membership, idle_since, now,
+            idle_qps=self._serve_idle_qps,
+            busy_qps=self._serve_busy_qps,
+            idle_secs=self._serve_idle_secs)
+        with self._lock:
+            self.state.serve_idle_since = idle_since
+        for prop in load_props:
+            with self._lock:
+                allowed, _ = gate(
+                    self.state, now, prop["action"],
+                    cooldown_secs=self.cooldown_secs,
+                    budget=self.budget, audit=audit)
+            if allowed:
+                self._observe(prop["action"], prop, now)
         provisionable = (self._provision_hook is not None
                          or bool(self._provision_cmd))
         for prop in decide(health, membership,

@@ -1,0 +1,24 @@
+"""mp4j-serve (ISSUE 19): the sharded low-latency inference plane.
+
+The first workload after 18 PRs of training substrate: a micro-
+batching front end (``batcher``), a hot-key row cache keyed through
+the persistent keycodec vocabularies (``cache``), binary
+request/response framing (``framing``) and the collective-substrate
+dispatch planes (``dispatcher`` — pull rows for the embedding
+families, reduce margins for GBDT). See README "Serving".
+"""
+
+from ytk_mp4j_tpu.serve.batcher import MicroBatcher, ServeFuture
+from ytk_mp4j_tpu.serve.cache import HotKeyCache
+from ytk_mp4j_tpu.serve.dispatcher import ServeFrontend, serve_worker
+from ytk_mp4j_tpu.serve.framing import (STATUS_DEGRADED, STATUS_ERROR,
+                                        STATUS_OK, decode_request,
+                                        decode_response, encode_request,
+                                        encode_response)
+
+__all__ = [
+    "MicroBatcher", "ServeFuture", "HotKeyCache", "ServeFrontend",
+    "serve_worker", "encode_request", "decode_request",
+    "encode_response", "decode_response", "STATUS_OK", "STATUS_ERROR",
+    "STATUS_DEGRADED",
+]

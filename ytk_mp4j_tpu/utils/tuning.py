@@ -922,6 +922,116 @@ def tuner_window_secs() -> float:
                      DEFAULT_TUNER_WINDOW_SECS, minimum=0.05)
 
 
+# Serve-plane defaults (ISSUE 19): the micro-batcher holds the first
+# request of a batch at most DEADLINE_MS before dispatching whatever
+# has accumulated (tail latency bound), and never accumulates past
+# MAX_BATCH (queueing bound). The cache rows/staleness knobs bound the
+# frontend's hot-key row cache: CACHE_ROWS caps resident rows (LRU),
+# STALE_VERSIONS is the published staleness bound — a cached row may
+# lag the live table by at most that many model-version bumps before a
+# lookup treats it as a miss. The load-following thresholds feed the
+# autoscaler's observe-first serve policy (idle QPS below IDLE_QPS for
+# IDLE_SECS proposes a shrink; QPS above BUSY_QPS proposes a grow).
+DEFAULT_SERVE_DEADLINE_MS = 2.0
+DEFAULT_SERVE_MAX_BATCH = 32
+DEFAULT_SERVE_CACHE_ROWS = 100_000
+DEFAULT_SERVE_STALE_VERSIONS = 0
+DEFAULT_SERVE_IDLE_QPS = 1.0
+DEFAULT_SERVE_BUSY_QPS = 1000.0
+DEFAULT_SERVE_IDLE_SECS = 60.0
+
+
+def serve_deadline_ms(override=None) -> float:
+    """Micro-batch accumulation deadline in milliseconds
+    (``MP4J_SERVE_DEADLINE_MS``): the longest the batcher may hold the
+    OLDEST queued request before dispatching a partial batch. Must be
+    positive — a zero deadline is the unbatched loop, spelled
+    ``MP4J_SERVE_MAX_BATCH=1``. ``override`` is the explicit
+    constructor value (``MicroBatcher(deadline_ms=...)``) — it bypasses
+    the env read but gets the same validation."""
+    if override is None:
+        return env_float("MP4J_SERVE_DEADLINE_MS",
+                         DEFAULT_SERVE_DEADLINE_MS, minimum=0.01)
+    val = float(override)
+    if val <= 0:
+        raise Mp4jError(
+            f"serve deadline_ms={override} must be positive")
+    return val
+
+
+def serve_max_batch(override=None) -> int:
+    """Micro-batch size cap (``MP4J_SERVE_MAX_BATCH``): a full batch
+    dispatches immediately without waiting out the deadline. ``1``
+    IS the unbatched reference loop (the bench A/B arm)."""
+    if override is None:
+        return env_int("MP4J_SERVE_MAX_BATCH",
+                       DEFAULT_SERVE_MAX_BATCH, minimum=1)
+    val = int(override)
+    if val < 1:
+        raise Mp4jError(f"serve max_batch={override} must be >= 1")
+    return val
+
+
+def serve_cache_rows(override=None) -> int:
+    """Hot-key row cache capacity in ROWS (``MP4J_SERVE_CACHE_ROWS``);
+    ``0`` disables the cache (every request pulls its rows — the bench
+    A/B knob for the cache figure)."""
+    if override is None:
+        return env_int("MP4J_SERVE_CACHE_ROWS",
+                       DEFAULT_SERVE_CACHE_ROWS, minimum=0)
+    val = int(override)
+    if val < 0:
+        raise Mp4jError(f"serve cache_rows={override} must be >= 0")
+    return val
+
+
+def serve_stale_versions(override=None) -> int:
+    """The cache's published staleness bound
+    (``MP4J_SERVE_STALE_VERSIONS``): a cached row whose stamp lags the
+    live model version by MORE than this many bumps is treated as a
+    miss (and counted ``serve/cache_stale``). ``0`` (default) means a
+    version bump invalidates everything cached under older stamps."""
+    if override is None:
+        return env_int("MP4J_SERVE_STALE_VERSIONS",
+                       DEFAULT_SERVE_STALE_VERSIONS, minimum=0)
+    val = int(override)
+    if val < 0:
+        raise Mp4jError(
+            f"serve stale_versions={override} must be >= 0")
+    return val
+
+
+def serve_idle_qps() -> float:
+    """Load-following shrink threshold (``MP4J_SERVE_IDLE_QPS``):
+    sustained serve QPS below this proposes releasing a serve rank
+    (observe mode first — ISSUE 19)."""
+    return env_float("MP4J_SERVE_IDLE_QPS", DEFAULT_SERVE_IDLE_QPS,
+                     minimum=0.0)
+
+
+def serve_busy_qps() -> float:
+    """Load-following grow threshold (``MP4J_SERVE_BUSY_QPS``): serve
+    QPS at or above this proposes growing the roster at the next
+    ``resize_point()``. Must exceed the idle threshold — a crossed
+    pair would flap."""
+    idle = serve_idle_qps()
+    val = env_float("MP4J_SERVE_BUSY_QPS", DEFAULT_SERVE_BUSY_QPS,
+                    minimum=0.0)
+    if val <= idle:
+        raise Mp4jError(
+            f"MP4J_SERVE_BUSY_QPS={val} must exceed "
+            f"MP4J_SERVE_IDLE_QPS={idle}")
+    return val
+
+
+def serve_idle_secs() -> float:
+    """How long serve QPS must stay below the idle threshold before
+    the shrink proposal fires (``MP4J_SERVE_IDLE_SECS``) — sustained
+    idleness, not one quiet window."""
+    return env_float("MP4J_SERVE_IDLE_SECS", DEFAULT_SERVE_IDLE_SECS,
+                     minimum=0.0)
+
+
 def so_buf_map() -> dict[int, tuple[int, int]]:
     """Explicit per-link socket buffer overrides (``MP4J_SO_BUF_MAP``,
     ISSUE 15 satellite): ``"peer:sndbuf[/rcvbuf],..."`` parsed into
